@@ -1,0 +1,14 @@
+"""Force tests onto a virtual 8-device CPU mesh before JAX is imported.
+
+Mirrors the reference's test stance (SURVEY §4): everything runs in-process
+without cluster/TPU hardware; multi-device behavior is exercised on host
+devices. Real-chip benchmarking happens in bench.py, not here.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,23 @@
+"""veneur_tpu — a TPU-native metrics-aggregation framework.
+
+A from-scratch re-design of the capabilities of stripe/veneur (the reference,
+a distributed DogStatsD/SSF aggregation pipeline, pure Go) as a JAX/XLA/Pallas
+framework:
+
+- the per-key sampler state (counters, gauges, sets/HLL, timers/histograms/
+  t-digest — reference ``samplers/samplers.go``) lives in fixed-capacity,
+  hash-addressed device arrays (:mod:`veneur_tpu.aggregation.table`),
+- ingest is a jitted batched scatter step (reference ``worker.go:344``
+  ``Worker.ProcessMetric``) built on the TPU-friendly
+  sort → segment-reduce → unique-scatter pattern,
+- the two-tier local→global aggregation (reference ``flusher.go`` /
+  ``importsrv/``) becomes XLA collectives over a device mesh
+  (:mod:`veneur_tpu.parallel`),
+- sketches (t-digest, HyperLogLog, count-min) are batched fixed-shape JAX
+  kernels (:mod:`veneur_tpu.ops`).
+
+The host pipeline (listeners, parsers, sinks, config, CLIs) mirrors the
+reference's behavior with Python/C++ where the reference used Go.
+"""
+
+__version__ = "0.1.0"

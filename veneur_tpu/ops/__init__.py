@@ -1,0 +1,3 @@
+from veneur_tpu.ops import hll, tdigest
+
+__all__ = ["hll", "tdigest"]

@@ -1,0 +1,128 @@
+"""Batched HyperLogLog for TPU.
+
+The reference's Set sampler holds one axiomhq/hyperloglog sketch (2^14
+registers) per set key and does Insert / Merge(union = register max) /
+Estimate (reference samplers/samplers.go:367-463). Here a batch of sketches is
+one uint8 array [..., R]:
+
+- insert: the host hashes the member string to 64 bits (metrohash in the
+  reference's vendored lib; we use xxhash-style splitmix on the host) and
+  ships (register_index, rho) pairs; the device does a deduplicated
+  scatter-max (sort by register → segment-max → unique-index scatter),
+- merge/union: elementwise ``maximum`` — which over a device mesh is exactly
+  ``lax.pmax``, making the reference's global set-union (worker.go:438-495
+  ImportMetricGRPC → Set.Merge) a single ICI collective,
+- estimate: the classic HLL harmonic-mean estimator with linear counting for
+  the small range, vectorized over keys.
+
+Precision p=14 (R=16384) matches the reference's default
+(samplers/samplers.go:383).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+DEFAULT_PRECISION = 14
+
+
+def num_registers(precision: int = DEFAULT_PRECISION) -> int:
+    return 1 << precision
+
+
+def empty_registers(key_shape, precision: int = DEFAULT_PRECISION) -> jax.Array:
+    key_shape = (key_shape,) if isinstance(key_shape, int) else tuple(key_shape)
+    return jnp.zeros(key_shape + (num_registers(precision),), jnp.uint8)
+
+
+def _alpha(m: int) -> float:
+    if m == 16:
+        return 0.673
+    if m == 32:
+        return 0.697
+    if m == 64:
+        return 0.709
+    return 0.7213 / (1.0 + 1.079 / m)
+
+
+def split_hash(hashes64, precision: int = DEFAULT_PRECISION):
+    """Host-side helper: split uint64 hashes (as a numpy/int array) into
+    (register index, rho) — rho = 1 + leading-zero-count of the remaining
+    64-p bits, capped at 64-p+1."""
+    import numpy as np
+    h = np.asarray(hashes64, dtype=np.uint64)
+    p = precision
+    reg = (h >> np.uint64(64 - p)).astype(np.int32)
+    rest = h << np.uint64(p)  # top 64-p payload bits in the high positions
+    # rho = leading zeros of rest (within 64-p bits) + 1
+    rho = np.zeros(h.shape, np.int32)
+    cur = rest
+    # binary leading-zero count on uint64
+    lz = np.full(h.shape, 0, np.int32)
+    for shift in (32, 16, 8, 4, 2, 1):
+        mask = cur < (np.uint64(1) << np.uint64(64 - shift))
+        lz = np.where(mask, lz + shift, lz)
+        cur = np.where(mask, cur << np.uint64(shift), cur)
+    lz = np.where(rest == 0, 64, lz)
+    rho = np.minimum(lz, 64 - p) + 1
+    return reg, rho.astype(np.uint8)
+
+
+@partial(jax.jit, static_argnames=("precision",))
+def insert_batch(registers, slot, reg, rho, *, precision: int = DEFAULT_PRECISION):
+    """Scatter-max a batch of (slot, register, rho) into registers [K, R].
+
+    slot: i32[B] key-table slot (slot >= K → dropped padding),
+    reg:  i32[B] register index in [0, R),
+    rho:  u8[B] rank value.
+
+    Dedup first (sort by flat index, segment-max) so the final scatter has
+    unique indices — the fast path on TPU.
+    """
+    k = registers.shape[0]
+    # 2D scatter indices (slot, reg) — avoids int32 overflow of a flattened
+    # slot*R+reg index for large key tables (K*R can exceed 2^31).
+    slot = jnp.where((slot >= 0) & (slot < k), slot, k)
+    order = jnp.lexsort((reg, slot))
+    ss = slot[order]
+    gs = reg[order]
+    rs = rho[order]
+    same = (ss[:-1] == ss[1:]) & (gs[:-1] == gs[1:])
+    is_last = jnp.concatenate([~same, jnp.ones((1,), bool)])
+    # running max within runs of equal (slot, reg)
+    seg_start = jnp.concatenate([jnp.ones((1,), bool), ~same])
+    seg_id = jnp.cumsum(seg_start.astype(jnp.int32)) - 1
+    run_max = jax.ops.segment_max(rs.astype(jnp.int32), seg_id,
+                                  num_segments=slot.shape[0],
+                                  indices_are_sorted=True)
+    upd_slot = jnp.where(is_last, ss, k)
+    upd_val = run_max[seg_id].astype(jnp.uint8)
+    return registers.at[upd_slot, gs].max(jnp.where(is_last, upd_val, 0),
+                                          mode="drop")
+
+
+def merge(a, b):
+    """Union of two register tables (reference Set.Merge, samplers.go:461)."""
+    return jnp.maximum(a, b)
+
+
+@partial(jax.jit, static_argnames=("precision",))
+def estimate(registers, *, precision: int = DEFAULT_PRECISION):
+    """Cardinality estimate per key: f32[...] over registers [..., R].
+
+    Classic HLL: alpha·m²/Σ2^-M_j, with linear counting m·ln(m/V) when the
+    raw estimate is below 5/2·m and zero registers exist. The reference's
+    vendored lib uses the LogLog-Beta variant; both sit inside the ~0.8%
+    standard error at p=14, which is what the tests assert.
+    """
+    m = num_registers(precision)
+    regs = registers.astype(jnp.float32)
+    inv = jnp.sum(jnp.exp2(-regs), axis=-1)
+    raw = _alpha(m) * m * m / inv
+    zeros = jnp.sum((registers == 0).astype(jnp.float32), axis=-1)
+    lin = m * jnp.log(m / jnp.maximum(zeros, 1.0))
+    use_lin = (raw <= 2.5 * m) & (zeros > 0)
+    return jnp.where(use_lin, lin, raw)

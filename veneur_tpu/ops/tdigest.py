@@ -1,0 +1,281 @@
+"""Batched fixed-shape t-digest for TPU.
+
+The reference maintains one Dunning merging t-digest per timer/histogram key
+(reference tdigest/merging_digest.go: data-dependent centroid counts, a temp
+buffer, and a sequential greedy merge pass). That formulation is hostile to
+XLA: variable length, data-dependent control flow, pointer-chasing merge.
+
+This module re-derives the *same mathematical object* — centroids sized by the
+arcsine scale function k1(q) = δ/(2π)·asin(2q−1) (reference
+merging_digest.go:259-262 ``indexEstimate``) — as a fully parallel,
+fixed-shape computation:
+
+  1. each digest is a fixed array of C (mean, weight) slots; weight == 0 marks
+     an empty slot,
+  2. "merge" = sort the combined centroids of each row by mean, take the
+     per-row cumulative weight, assign every centroid to the k-cell
+     ``floor(cells_per_k · (k1(q_mid) − k1(0)))`` of its weight midpoint, and
+     segment-reduce (weighted mean) each cell,
+  3. all reductions use the sort → cumsum → unique-index scatter → running-max
+     → diff pattern, which XLA tiles well on TPU (no serialized scatter-adds).
+
+Bucketing by unit k-cells satisfies the same Δk ≤ 1 merge invariant the
+reference enforces greedily; ``cells_per_k = 2`` (half cells) gives headroom so
+quantile accuracy strictly dominates the reference's envelope (reference
+tdigest/histo_test.go:27 asserts median within 2% at δ=1000; BASELINE demands
+≤1% p99 error at δ=100). Unlike the reference — whose ``Merge`` shuffles
+centroid insertion order with rand.Perm to avoid bias
+(merging_digest.go:374-389) — this merge is deterministic and order-free:
+the same multiset of centroids always produces the same digest.
+
+All functions operate on arrays with an arbitrary batch of leading dims and a
+trailing centroid dim C, so one jitted program updates every key in a sharded
+key table at once.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from veneur_tpu.utils.numerics import two_sum, twofloat_add, twofloat_merge
+
+DEFAULT_COMPRESSION = 100.0
+DEFAULT_CELLS_PER_K = 2
+
+
+def centroid_capacity(compression: float = DEFAULT_COMPRESSION,
+                      cells_per_k: int = DEFAULT_CELLS_PER_K) -> int:
+    """Number of centroid slots per digest.
+
+    k1 spans δ/2 total k-units over q∈[0,1], so there are at most
+    ceil(δ/2 · cells_per_k) + 1 occupied cells. Rounded up to a multiple of 8
+    for TPU sublane friendliness.
+    """
+    c = int(math.ceil(compression / 2.0 * cells_per_k)) + 2
+    return (c + 7) // 8 * 8
+
+
+class TDigestTable(NamedTuple):
+    """A batch of t-digests plus the exact scalar aggregates the reference
+    keeps alongside each Histo (reference samplers/samplers.go:477-481:
+    LocalWeight/Min/Max/Sum/ReciprocalSum).
+
+    Leading dims = key axis (arbitrary shape); trailing dim of mean/weight = C.
+    Sums use two-float compensated accumulation (see utils.numerics) in place
+    of the reference's float64.
+    """
+    mean: jax.Array      # f32[..., C]
+    weight: jax.Array    # f32[..., C]; 0 = empty slot
+    min: jax.Array       # f32[...]
+    max: jax.Array       # f32[...]
+    count_hi: jax.Array  # f32[...]  total weight (scaled by 1/sample_rate)
+    count_lo: jax.Array
+    sum_hi: jax.Array    # f32[...]  Σ w·v
+    sum_lo: jax.Array
+    recip_hi: jax.Array  # f32[...]  Σ w/v (for harmonic mean)
+    recip_lo: jax.Array
+
+
+def empty_table(key_shape, compression: float = DEFAULT_COMPRESSION,
+                cells_per_k: int = DEFAULT_CELLS_PER_K) -> TDigestTable:
+    key_shape = tuple(key_shape) if not isinstance(key_shape, int) else (key_shape,)
+    c = centroid_capacity(compression, cells_per_k)
+    f = jnp.float32
+    return TDigestTable(
+        mean=jnp.zeros(key_shape + (c,), f),
+        weight=jnp.zeros(key_shape + (c,), f),
+        min=jnp.full(key_shape, jnp.inf, f),
+        max=jnp.full(key_shape, -jnp.inf, f),
+        count_hi=jnp.zeros(key_shape, f),
+        count_lo=jnp.zeros(key_shape, f),
+        sum_hi=jnp.zeros(key_shape, f),
+        sum_lo=jnp.zeros(key_shape, f),
+        recip_hi=jnp.zeros(key_shape, f),
+        recip_lo=jnp.zeros(key_shape, f),
+    )
+
+
+def _k1(q, compression):
+    # arcsine scale function; same family as reference merging_digest.go:259.
+    q = jnp.clip(q, 0.0, 1.0)
+    return compression / (2.0 * jnp.pi) * jnp.arcsin(2.0 * q - 1.0)
+
+
+def compress_rows(mean, weight, *, compression: float = DEFAULT_COMPRESSION,
+                  cells_per_k: int = DEFAULT_CELLS_PER_K, out_c: int | None = None):
+    """Compress each row of (mean, weight) centroids to ≤ out_c k-cell centroids.
+
+    mean, weight: f32[..., M] with weight == 0 marking empties. Rows need not
+    be sorted. Returns (mean', weight') of shape [..., out_c]; occupied cells
+    appear in ascending-mean order at their cell index, empties have weight 0.
+
+    This is the whole merge: equivalent to the reference's mergeAllTemps
+    (merging_digest.go:140-224) but parallel across rows and within a row.
+    """
+    if out_c is None:
+        out_c = centroid_capacity(compression, cells_per_k)
+    lead = mean.shape[:-1]
+    m_in = mean.reshape((-1, mean.shape[-1]))
+    w_in = weight.reshape((-1, weight.shape[-1]))
+    n, m_len = m_in.shape
+
+    occupied = w_in > 0
+    sort_key = jnp.where(occupied, m_in, jnp.inf)
+    order = jnp.argsort(sort_key, axis=1)
+    m = jnp.take_along_axis(m_in, order, axis=1)
+    w = jnp.where(jnp.take_along_axis(occupied, order, axis=1),
+                  jnp.take_along_axis(w_in, order, axis=1), 0.0)
+
+    tot = jnp.sum(w, axis=1, keepdims=True)
+    cum = jnp.cumsum(w, axis=1)
+    q_mid = (cum - 0.5 * w) / jnp.maximum(tot, jnp.float32(1e-30))
+    k0 = -compression / 4.0  # k1(0)
+    cell = jnp.floor((_k1(q_mid, compression) - k0) * cells_per_k).astype(jnp.int32)
+    cell = jnp.clip(cell, 0, out_c - 1)
+    # empties → out-of-bounds cell so their scatter is dropped
+    cell = jnp.where(w > 0, cell, out_c)
+
+    # Per-(row, cell) sums via cumulative-scatter-diff: cells are sorted within
+    # each row, so scatter each run's *trailing cumulative* at a unique index,
+    # forward-fill empty cells with a running max, and difference.
+    cum_wm = jnp.cumsum(w * m, axis=1)
+    is_last = jnp.concatenate(
+        [cell[:, :-1] != cell[:, 1:], jnp.ones((n, 1), bool)], axis=1)
+    rows = jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32)[:, None], (n, m_len))
+    flat = jnp.where(is_last, rows * out_c + jnp.minimum(cell, out_c - 1),
+                     n * out_c)
+    flat = jnp.where(cell < out_c, flat, n * out_c)
+
+    end_w = jnp.zeros((n * out_c,), w.dtype).at[flat.ravel()].max(
+        cum.ravel(), mode="drop").reshape(n, out_c)
+    # in-bounds indices are unique (one per run end) but the drop sentinel is
+    # duplicated, so no unique_indices hint — mode="drop" discards sentinels.
+    end_wm = jnp.zeros((n * out_c,), w.dtype).at[flat.ravel()].set(
+        cum_wm.ravel(), mode="drop").reshape(n, out_c)
+    # forward-fill: empty cells carry the previous cumulative
+    fill_w = jax.lax.cummax(end_w, axis=1)
+    has = end_w > 0
+    # cum_wm can legitimately be non-monotone only if means are negative; track
+    # occupancy explicitly instead of relying on positivity.
+    end_wm = jnp.where(has, end_wm, 0.0)
+    idx = jax.lax.cummax(jnp.where(has, jnp.arange(out_c, dtype=jnp.int32)[None, :], 0), axis=1)
+    fill_wm = jnp.take_along_axis(end_wm, idx, axis=1)
+    w_out = fill_w - jnp.concatenate(
+        [jnp.zeros((n, 1), w.dtype), fill_w[:, :-1]], axis=1)
+    wm_out = fill_wm - jnp.concatenate(
+        [jnp.zeros((n, 1), w.dtype), fill_wm[:, :-1]], axis=1)
+    m_out = jnp.where(w_out > 0, wm_out / jnp.maximum(w_out, 1e-30), 0.0)
+    return (m_out.reshape(lead + (out_c,)), w_out.reshape(lead + (out_c,)))
+
+
+def merge_tables(a: TDigestTable, b: TDigestTable, *,
+                 compression: float = DEFAULT_COMPRESSION,
+                 cells_per_k: int = DEFAULT_CELLS_PER_K) -> TDigestTable:
+    """Key-wise merge of two digest tables (the global-aggregation merge;
+    reference samplers/samplers.go:726 Histo.Merge → tdigest Merge)."""
+    out_c = a.mean.shape[-1]
+    m = jnp.concatenate([a.mean, b.mean], axis=-1)
+    w = jnp.concatenate([a.weight, b.weight], axis=-1)
+    m2, w2 = compress_rows(m, w, compression=compression,
+                           cells_per_k=cells_per_k, out_c=out_c)
+    ch, cl = twofloat_merge(a.count_hi, a.count_lo, b.count_hi, b.count_lo)
+    sh, sl = twofloat_merge(a.sum_hi, a.sum_lo, b.sum_hi, b.sum_lo)
+    rh, rl = twofloat_merge(a.recip_hi, a.recip_lo, b.recip_hi, b.recip_lo)
+    return TDigestTable(
+        mean=m2, weight=w2,
+        min=jnp.minimum(a.min, b.min), max=jnp.maximum(a.max, b.max),
+        count_hi=ch, count_lo=cl, sum_hi=sh, sum_lo=sl,
+        recip_hi=rh, recip_lo=rl)
+
+
+def _quantiles_one(mean, weight, mn, mx, qs):
+    """Quantiles of a single digest [C] at qs [Q] via midpoint interpolation
+    (reference merging_digest.go:302 Quantile)."""
+    order = jnp.argsort(jnp.where(weight > 0, mean, jnp.inf))
+    m = mean[order]
+    w = jnp.where(weight[order] > 0, weight[order], 0.0)
+    tot = jnp.sum(w)
+    cum = jnp.cumsum(w)
+    mid = cum - 0.5 * w
+    # append virtual endpoints (0 → min, tot → max); empties collapse onto max
+    xs = jnp.where(w > 0, mid, tot)
+    ys = jnp.where(w > 0, m, mx)
+    xs = jnp.concatenate([jnp.zeros((1,), xs.dtype), xs, tot[None]])
+    ys = jnp.concatenate([mn[None], ys, mx[None]])
+    t = qs * tot
+    out = jnp.interp(t, xs, ys)
+    return jnp.where(tot > 0, out, jnp.float32(jnp.nan))
+
+
+def quantiles(table: TDigestTable, qs) -> jax.Array:
+    """Quantiles for every digest: returns f32[..., Q]."""
+    qs = jnp.asarray(qs, jnp.float32)
+    lead = table.mean.shape[:-1]
+    flat = jax.vmap(_quantiles_one, in_axes=(0, 0, 0, 0, None))(
+        table.mean.reshape((-1, table.mean.shape[-1])),
+        table.weight.reshape((-1, table.weight.shape[-1])),
+        table.min.reshape((-1,)), table.max.reshape((-1,)), qs)
+    return flat.reshape(lead + (qs.shape[0],))
+
+
+def _cdf_one(mean, weight, mn, mx, xs_q):
+    order = jnp.argsort(jnp.where(weight > 0, mean, jnp.inf))
+    m = mean[order]
+    w = jnp.where(weight[order] > 0, weight[order], 0.0)
+    tot = jnp.sum(w)
+    cum = jnp.cumsum(w)
+    mid = cum - 0.5 * w
+    xs = jnp.where(w > 0, m, mx)
+    ys = jnp.where(w > 0, mid, tot)
+    xs = jnp.concatenate([mn[None], xs, mx[None]])
+    ys = jnp.concatenate([jnp.zeros((1,), ys.dtype), ys, tot[None]])
+    out = jnp.interp(xs_q, xs, ys) / jnp.maximum(tot, 1e-30)
+    return jnp.where(tot > 0, out, jnp.float32(jnp.nan))
+
+
+def cdf(table: TDigestTable, xs) -> jax.Array:
+    """CDF at points xs for every digest: returns f32[..., len(xs)]."""
+    xs = jnp.asarray(xs, jnp.float32)
+    lead = table.mean.shape[:-1]
+    flat = jax.vmap(_cdf_one, in_axes=(0, 0, 0, 0, None))(
+        table.mean.reshape((-1, table.mean.shape[-1])),
+        table.weight.reshape((-1, table.weight.shape[-1])),
+        table.min.reshape((-1,)), table.max.reshape((-1,)), xs)
+    return flat.reshape(lead + (xs.shape[0],))
+
+
+@partial(jax.jit, static_argnames=("compression", "cells_per_k"))
+def add_batch_single(table: TDigestTable, values, weights, *,
+                     compression: float = DEFAULT_COMPRESSION,
+                     cells_per_k: int = DEFAULT_CELLS_PER_K) -> TDigestTable:
+    """Add a batch of samples to a SINGLE digest (table with scalar key shape ()).
+
+    Used for tests and small-scale paths; the key-table ingest in
+    aggregation/step.py handles the many-keys case.
+    """
+    values = jnp.asarray(values, jnp.float32)
+    weights = jnp.asarray(weights, jnp.float32)
+    out_c = table.mean.shape[-1]
+    m = jnp.concatenate([table.mean, values], axis=-1)
+    w = jnp.concatenate([table.weight, weights], axis=-1)
+    m2, w2 = compress_rows(m[None, :], w[None, :], compression=compression,
+                           cells_per_k=cells_per_k, out_c=out_c)
+    live = weights > 0
+    vmasked = jnp.where(live, values, jnp.inf)
+    ch, cl = table.count_hi, table.count_lo
+    sh, sl = table.sum_hi, table.sum_lo
+    rh, rl = table.recip_hi, table.recip_lo
+    ch, cl = twofloat_add(ch, cl, jnp.sum(weights))
+    sh, sl = twofloat_add(sh, sl, jnp.sum(jnp.where(live, weights * values, 0.0)))
+    rh, rl = twofloat_add(rh, rl, jnp.sum(jnp.where(live, weights / jnp.where(live, values, 1.0), 0.0)))
+    return TDigestTable(
+        mean=m2[0], weight=w2[0],
+        min=jnp.minimum(table.min, jnp.min(vmasked)),
+        max=jnp.maximum(table.max, jnp.max(jnp.where(live, values, -jnp.inf))),
+        count_hi=ch, count_lo=cl, sum_hi=sh, sum_lo=sl,
+        recip_hi=rh, recip_lo=rl)

@@ -1,0 +1,8 @@
+from veneur_tpu.utils.numerics import (
+    two_sum,
+    twofloat_add,
+    twofloat_merge,
+    twofloat_total,
+)
+
+__all__ = ["two_sum", "twofloat_add", "twofloat_merge", "twofloat_total"]

@@ -1,0 +1,42 @@
+"""Compensated float32 accumulation for TPU.
+
+TPUs have no fast float64; the reference keeps counter values as int64 and
+histogram scalar aggregates as float64 (reference samplers/samplers.go:131,
+477-481). To preserve the same effective precision over a flush interval we
+store running sums as an unevaluated pair (hi, lo) of float32 — "two-float"
+(double-single) arithmetic. Error-free transformation via Knuth's TwoSum,
+so each accumulated addition is exact to ~48 bits of significand, well above
+what a 10s flush interval of increments needs.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def two_sum(a, b):
+    """Knuth TwoSum: returns (s, err) with s = fl(a+b) and a+b = s + err exactly."""
+    s = a + b
+    bb = s - a
+    err = (a - (s - bb)) + (b - bb)
+    return s, err
+
+
+def twofloat_add(hi, lo, x):
+    """Add x to the two-float accumulator (hi, lo). Returns new (hi, lo)."""
+    s, e = two_sum(hi, x)
+    lo = lo + e
+    # renormalize so hi carries the leading bits
+    hi, e2 = two_sum(s, lo)
+    return hi, e2
+
+
+def twofloat_total(hi, lo):
+    """Collapse the accumulator to a single float (float32)."""
+    return hi + lo
+
+
+def twofloat_merge(hi_a, lo_a, hi_b, lo_b):
+    """Merge two accumulators (e.g. across devices)."""
+    hi, lo = two_sum(hi_a, hi_b)
+    return twofloat_add(hi, lo, lo_a + lo_b)
