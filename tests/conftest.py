@@ -12,3 +12,12 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The environment's TPU-tunnel plugin (axon) registers itself at interpreter
+# start and force-selects jax_platforms="axon,cpu", so backends() would
+# lazily initialize the tunnel client even for CPU-only tests — and hang the
+# whole suite if the tunnel is unhealthy. Pin the config back to cpu before
+# any JAX dispatch; bench.py (real chip) is the only TPU consumer.
+import jax
+
+jax.config.update("jax_platforms", "cpu")

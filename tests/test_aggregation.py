@@ -1,0 +1,248 @@
+"""Aggregation engine tests: key table + scatter ingest step + flush.
+
+Modeled on the reference's samplers_test.go (per-type sample/flush fidelity,
+sample-rate weighting, cross-instance merge) and worker_test.go (ProcessMetric
+routing), but against exact numpy oracles.
+"""
+
+import numpy as np
+import pytest
+
+from veneur_tpu.aggregation import (
+    Batch, Batcher, DeviceState, KeyTable, TableSpec, compact, empty_state,
+    flush_compute, fold_scalars, ingest_step)
+from veneur_tpu.aggregation.host import BatchSpec
+
+
+SPEC = TableSpec(counter_capacity=256, gauge_capacity=64, status_capacity=16,
+                 set_capacity=16, histo_capacity=64, hll_precision=12)
+BSPEC = BatchSpec(counter=1024, gauge=256, status=64, set=2048, histo=4096)
+
+
+def _empty_batch(spec, bspec):
+    return Batch(
+        counter_slot=np.full(bspec.counter, spec.counter_capacity, np.int32),
+        counter_inc=np.zeros(bspec.counter, np.float32),
+        gauge_slot=np.full(bspec.gauge, spec.gauge_capacity, np.int32),
+        gauge_val=np.zeros(bspec.gauge, np.float32),
+        status_slot=np.full(bspec.status, spec.status_capacity, np.int32),
+        status_val=np.zeros(bspec.status, np.float32),
+        set_slot=np.full(bspec.set, spec.set_capacity, np.int32),
+        set_reg=np.zeros(bspec.set, np.int32),
+        set_rho=np.zeros(bspec.set, np.uint8),
+        histo_slot=np.full(bspec.histo, spec.histo_capacity, np.int32),
+        histo_val=np.zeros(bspec.histo, np.float32),
+        histo_wt=np.zeros(bspec.histo, np.float32),
+    )
+
+
+def test_counter_exact_vs_numpy():
+    rng = np.random.RandomState(0)
+    state = empty_state(SPEC)
+    oracle = np.zeros(SPEC.counter_capacity, np.float64)
+    for step in range(20):
+        b = _empty_batch(SPEC, BSPEC)
+        n = 700
+        slots = rng.randint(0, 32, n).astype(np.int32)
+        incs = rng.randint(1, 1000, n).astype(np.float32)
+        b.counter_slot[:n] = slots
+        b.counter_inc[:n] = incs
+        np.add.at(oracle, slots, incs.astype(np.float64))
+        state = ingest_step(state, b, spec=SPEC)
+        if step % 7 == 6:
+            state = fold_scalars(state)
+    state = fold_scalars(state)
+    state = compact(state, spec=SPEC)
+    out = flush_compute(state, np.array([0.5], np.float32), spec=SPEC)
+    got = np.asarray(out["counter"], np.float64)
+    np.testing.assert_allclose(got[:32], oracle[:32], rtol=1e-6)
+    assert got[32:].sum() == 0
+
+
+def test_counter_sample_rate_weighting():
+    # reference samplers.go:142-144: value scaled by 1/rate
+    state = empty_state(SPEC)
+    b = _empty_batch(SPEC, BSPEC)
+    b.counter_slot[:2] = [0, 0]
+    b.counter_inc[:2] = [5 * (1 / 0.5), 3 * (1 / 0.1)]
+    state = fold_scalars(ingest_step(state, b, spec=SPEC))
+    out = flush_compute(compact(state, spec=SPEC),
+                        np.array([0.5], np.float32), spec=SPEC)
+    assert float(out["counter"][0]) == pytest.approx(10 + 30)
+
+
+def test_gauge_last_write_wins():
+    state = empty_state(SPEC)
+    b = _empty_batch(SPEC, BSPEC)
+    # slot 3 written three times in one batch: last (42) must win
+    b.gauge_slot[:4] = [3, 3, 5, 3]
+    b.gauge_val[:4] = [1.0, 7.0, 9.0, 42.0]
+    state = ingest_step(state, b, spec=SPEC)
+    # a later batch overwrites slot 5
+    b2 = _empty_batch(SPEC, BSPEC)
+    b2.gauge_slot[:1] = [5]
+    b2.gauge_val[:1] = [-2.0]
+    state = ingest_step(state, b2, spec=SPEC)
+    out = flush_compute(compact(fold_scalars(state), spec=SPEC),
+                        np.array([0.5], np.float32), spec=SPEC)
+    assert float(out["gauge"][3]) == 42.0
+    assert float(out["gauge"][5]) == -2.0
+
+
+def test_status_last_write_wins():
+    state = empty_state(SPEC)
+    b = _empty_batch(SPEC, BSPEC)
+    b.status_slot[:2] = [1, 1]
+    b.status_val[:2] = [0.0, 2.0]  # OK then CRITICAL; CRITICAL wins
+    state = ingest_step(state, b, spec=SPEC)
+    out = flush_compute(compact(fold_scalars(state), spec=SPEC),
+                        np.array([0.5], np.float32), spec=SPEC)
+    assert float(out["status"][1]) == 2.0
+
+
+def test_set_cardinality_table():
+    from veneur_tpu.utils.hashing import hll_reg_rho
+    state = empty_state(SPEC)
+    rng = np.random.RandomState(5)
+    true_card = 5000
+    members = [b"user-%d" % i for i in range(true_card)]
+    # feed each member 1-3 times across batches into slot 2
+    feed = members * 2 + [members[i] for i in rng.randint(0, true_card, 3000)]
+    rng.shuffle(feed)
+    i = 0
+    while i < len(feed):
+        b = _empty_batch(SPEC, BSPEC)
+        chunk = feed[i:i + BSPEC.set]
+        for j, m in enumerate(chunk):
+            reg, rho = hll_reg_rho(m, SPEC.hll_precision)
+            b.set_slot[j] = 2
+            b.set_reg[j] = reg
+            b.set_rho[j] = rho
+        i += len(chunk)
+        state = ingest_step(state, b, spec=SPEC)
+    out = flush_compute(compact(fold_scalars(state), spec=SPEC),
+                        np.array([0.5], np.float32), spec=SPEC)
+    est = float(out["set_estimate"][2])
+    assert est == pytest.approx(true_card, rel=0.05)
+    assert float(out["set_estimate"][3]) == 0.0
+
+
+def _run_histo(data_by_slot, compact_every=4, spec=SPEC, bspec=BSPEC,
+               qs=(0.5, 0.9, 0.99)):
+    state = empty_state(spec)
+    streams = {s: list(v) for s, v in data_by_slot.items()}
+    flat = [(s, v) for s, vs in streams.items() for v in vs]
+    rng = np.random.RandomState(9)
+    rng.shuffle(flat)
+    step = 0
+    i = 0
+    while i < len(flat):
+        b = _empty_batch(spec, bspec)
+        chunk = flat[i:i + bspec.histo]
+        b.histo_slot[:len(chunk)] = [s for s, _ in chunk]
+        b.histo_val[:len(chunk)] = [v for _, v in chunk]
+        b.histo_wt[:len(chunk)] = 1.0
+        i += len(chunk)
+        state = ingest_step(state, b, spec=spec)
+        step += 1
+        if step % compact_every == 0:
+            state = compact(state, spec=spec)
+    state = compact(fold_scalars(state), spec=spec)
+    return flush_compute(state, np.array(qs, np.float32), spec=spec)
+
+
+def test_histo_quantiles_uniform_two_keys():
+    rng = np.random.RandomState(1)
+    data = {0: rng.uniform(0, 1, 30_000).astype(np.float32),
+            7: rng.uniform(0, 1, 30_000).astype(np.float32)}
+    out = _run_histo(data)
+    for slot in (0, 7):
+        got = np.asarray(out["histo_quantiles"][slot])
+        exact = np.quantile(data[slot], [0.5, 0.9, 0.99])
+        err = np.abs(got - exact)
+        assert err[0] < 0.02, f"slot {slot} p50 err {err}"
+        assert err[2] < 0.01, f"slot {slot} p99 err {err}"
+
+
+def test_histo_quantiles_lognormal():
+    rng = np.random.RandomState(2)
+    data = {3: rng.lognormal(3.0, 1.0, 40_000).astype(np.float32)}
+    out = _run_histo(data)
+    got = np.asarray(out["histo_quantiles"][3])
+    exact = np.quantile(data[3], [0.5, 0.9, 0.99])
+    rel = np.abs(got - exact) / exact
+    assert rel[0] < 0.02, f"p50 rel err {rel}"
+    assert rel[1] < 0.02, f"p90 rel err {rel}"
+    assert rel[2] < 0.015, f"p99 rel err {rel}"
+
+
+def test_histo_aggregates_exact():
+    rng = np.random.RandomState(3)
+    vals = rng.exponential(10.0, 20_000).astype(np.float32)
+    out = _run_histo({4: vals})
+    v64 = vals.astype(np.float64)
+    assert float(out["histo_count"][4]) == pytest.approx(len(vals), rel=1e-6)
+    assert float(out["histo_min"][4]) == pytest.approx(v64.min(), rel=1e-6)
+    assert float(out["histo_max"][4]) == pytest.approx(v64.max(), rel=1e-6)
+    assert float(out["histo_sum"][4]) == pytest.approx(v64.sum(), rel=1e-4)
+    assert float(out["histo_avg"][4]) == pytest.approx(v64.mean(), rel=1e-4)
+    hmean = len(vals) / (1.0 / v64).sum()
+    assert float(out["histo_hmean"][4]) == pytest.approx(hmean, rel=1e-3)
+
+
+def test_histo_compact_cadence_consistency():
+    # same data, different compaction cadence -> quantiles agree closely
+    rng = np.random.RandomState(4)
+    data = {0: rng.normal(100.0, 15.0, 20_000).astype(np.float32)}
+    a = _run_histo(data, compact_every=2)
+    b = _run_histo(data, compact_every=16)
+    qa = np.asarray(a["histo_quantiles"][0])
+    qb = np.asarray(b["histo_quantiles"][0])
+    exact = np.quantile(data[0], [0.5, 0.9, 0.99])
+    assert np.all(np.abs(qa - exact) / exact < 0.01)
+    assert np.all(np.abs(qb - exact) / exact < 0.01)
+
+
+def test_keytable_and_batcher_end_to_end():
+    table = KeyTable(SPEC, n_shards=4)
+    batches = []
+    batcher = Batcher(SPEC, BSPEC, on_batch=batches.append)
+    from veneur_tpu.utils.hashing import fnv1a_32
+
+    def digest(name, t, tags):
+        return fnv1a_32((name + t + ",".join(tags)).encode())
+
+    s1 = table.slot_for("counter", "a.b", ("x:1",), 0, digest("a.b", "c", ("x:1",)))
+    s2 = table.slot_for("counter", "a.b", ("x:1",), 0, digest("a.b", "c", ("x:1",)))
+    s3 = table.slot_for("counter", "a.b", ("x:2",), 0, digest("a.b", "c", ("x:2",)))
+    assert s1 == s2 and s1 != s3
+    sh = table.slot_for("timer", "lat", (), 0, digest("lat", "ms", ()))
+    sh2 = table.slot_for("histogram", "lat", (), 0, digest("lat", "h", ()))
+    assert sh != sh2  # distinct namespaces share the histo table
+
+    batcher.add_counter(s1, 5.0, 1.0)
+    batcher.add_counter(s3, 2.0, 0.5)
+    batcher.add_histo(sh, 100.0, 1.0)
+    batcher.add_set(table.slot_for("set", "uids", (), 0, 123), b"u1")
+    batcher.emit()
+    assert len(batches) == 1
+    state = empty_state(SPEC)
+    state = ingest_step(state, batches[0], spec=SPEC)
+    out = flush_compute(compact(fold_scalars(state), spec=SPEC),
+                        np.array([0.5], np.float32), spec=SPEC)
+    assert float(out["counter"][s1]) == 5.0
+    assert float(out["counter"][s3]) == 4.0
+    assert float(out["histo_count"][sh]) == 1.0
+    # slot metadata for flush labeling
+    metas = dict(table.get_meta("counter"))
+    assert metas[s1].name == "a.b"
+
+
+def test_keytable_overflow_drops():
+    spec = TableSpec(counter_capacity=4, gauge_capacity=4, status_capacity=4,
+                     set_capacity=4, histo_capacity=4, hll_precision=10)
+    t = KeyTable(spec, n_shards=1)
+    slots = [t.slot_for("counter", f"m{i}", (), 0, i) for i in range(6)]
+    assert slots[:4] == [0, 1, 2, 3]
+    assert slots[4] is None and slots[5] is None
+    assert t.dropped() == 2

@@ -1,0 +1,22 @@
+"""Sharded on-device aggregation core.
+
+This package is the TPU-native replacement for the reference's worker layer
+(reference worker.go: per-goroutine maps of samplers keyed by MetricKey).
+Instead of hash-sharded goroutines with mutex-guarded maps, state is a set of
+fixed-capacity device arrays ("the key table") updated by batched XLA scatter
+ops under jit, and sharded across devices on the key axis with shard_map.
+
+- state.py   — TableSpec + DeviceState (the arrays) + constructors
+- step.py    — the jitted ingest step / fold / compact / flush computations
+- host.py    — host-side key dictionary (name/type/tags -> slot) and batcher
+"""
+
+from veneur_tpu.aggregation.state import TableSpec, DeviceState, empty_state
+from veneur_tpu.aggregation.step import (
+    Batch, ingest_step, fold_scalars, compact, flush_compute)
+from veneur_tpu.aggregation.host import KeyTable, Batcher
+
+__all__ = [
+    "TableSpec", "DeviceState", "empty_state", "Batch", "ingest_step",
+    "fold_scalars", "compact", "flush_compute", "KeyTable", "Batcher",
+]

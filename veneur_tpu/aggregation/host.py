@@ -1,0 +1,232 @@
+"""Host-side key dictionary and batch staging.
+
+The reference resolves a MetricKey to a sampler object by Go map lookup inside
+each worker (reference worker.go:108 Upsert). Here the host resolves
+(name, type, joined_tags) to a dense slot index into the device arrays; the
+device never sees strings. Slot metadata (name, tags, scope) stays host-side
+for flush labeling, mirroring how the reference's MetricKey fields ride along
+to InterMetric generation (reference samplers/samplers.go:147-158).
+
+Slots are assigned shard-aware: slot = shard * per_shard + local index, where
+shard = digest % n_shards and digest is the reference-compatible FNV-1a 32
+(reference server.go:973,984 routes by Digest % numWorkers the same way).
+This keeps every key's state resident on a single device when the table is
+sharded over a mesh (parallel/), so ingest scatters never cross devices.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional
+
+import numpy as np
+
+from veneur_tpu.aggregation.state import TableSpec
+from veneur_tpu.aggregation.step import Batch
+from veneur_tpu.utils.hashing import hll_reg_rho
+
+# metric type classes that own a table
+KINDS = ("counter", "gauge", "status", "set", "histogram", "timer")
+
+# scopes, mirroring reference samplers/parser.go:66-70 MetricScope
+SCOPE_MIXED = 0
+SCOPE_LOCAL = 1
+SCOPE_GLOBAL = 2
+
+
+@dataclasses.dataclass
+class SlotMeta:
+    name: str
+    tags: tuple
+    scope: int
+    kind: str
+    hostname: str = ""
+    message: str = ""  # status checks only
+
+
+class _KindTable:
+    __slots__ = ("capacity", "n_shards", "per_shard", "by_key", "meta",
+                 "next_free", "dropped")
+
+    def __init__(self, capacity: int, n_shards: int):
+        self.capacity = capacity
+        self.n_shards = n_shards
+        self.per_shard = capacity // n_shards
+        self.by_key: dict = {}
+        self.meta: list = []          # parallel to allocation order
+        self.next_free = [0] * n_shards
+        self.dropped = 0
+
+    def slot_for(self, key, digest: int, make_meta) -> Optional[int]:
+        slot = self.by_key.get(key)
+        if slot is not None:
+            return slot
+        shard = digest % self.n_shards
+        nxt = self.next_free[shard]
+        if nxt >= self.per_shard:
+            self.dropped += 1
+            return None
+        self.next_free[shard] = nxt + 1
+        slot = shard * self.per_shard + nxt
+        self.by_key[key] = slot
+        self.meta.append((slot, make_meta()))
+        return slot
+
+    def reset(self):
+        self.by_key.clear()
+        self.meta.clear()
+        self.next_free = [0] * self.n_shards
+
+
+class KeyTable:
+    """name/type/tags -> slot assignment for one flush interval.
+
+    Timers and histograms share the histo device table (same sampler math,
+    reference samplers.go:467) but are distinct key namespaces, as in the
+    reference's separate timers/histograms maps (worker.go:66-67); we prefix
+    the dict key with the kind.
+    """
+
+    def __init__(self, spec: TableSpec, n_shards: int = 1):
+        self.spec = spec
+        self.n_shards = n_shards
+        self.tables = {
+            "counter": _KindTable(spec.counter_capacity, n_shards),
+            "gauge": _KindTable(spec.gauge_capacity, n_shards),
+            "status": _KindTable(spec.status_capacity, n_shards),
+            "set": _KindTable(spec.set_capacity, n_shards),
+            "histo": _KindTable(spec.histo_capacity, n_shards),
+        }
+
+    @staticmethod
+    def _table_name(kind: str) -> str:
+        return "histo" if kind in ("histogram", "timer") else kind
+
+    def slot_for(self, kind: str, name: str, tags: tuple, scope: int,
+                 digest: int, hostname: str = "") -> Optional[int]:
+        t = self.tables[self._table_name(kind)]
+        key = (kind, name, tags)
+        return t.slot_for(
+            key, digest,
+            lambda: SlotMeta(name=name, tags=tags, scope=scope, kind=kind,
+                             hostname=hostname))
+
+    def get_meta(self, kind: str):
+        """[(slot, SlotMeta)] in allocation order for flush labeling."""
+        return self.tables[self._table_name(kind)].meta
+
+    def dropped(self) -> int:
+        return sum(t.dropped for t in self.tables.values())
+
+    def reset(self):
+        for t in self.tables.values():
+            t.reset()
+
+
+@dataclasses.dataclass(frozen=True)
+class BatchSpec:
+    """Fixed staging sizes — one compiled ingest program per configuration."""
+    counter: int = 8192
+    gauge: int = 2048
+    status: int = 256
+    set: int = 4096
+    histo: int = 8192
+
+
+class Batcher:
+    """Stages parsed samples into numpy arrays and emits padded Batches.
+
+    The reference's analogue is the PacketChan buffering between parser
+    goroutines and workers (reference worker.go:31-55); here buffering is the
+    staging arrays and "the worker" is the jitted ingest step.
+    """
+
+    def __init__(self, spec: TableSpec, bspec: BatchSpec = BatchSpec(),
+                 on_batch: Optional[Callable[[Batch], None]] = None):
+        self.spec = spec
+        self.bspec = bspec
+        self.on_batch = on_batch
+        self._alloc()
+
+    def _alloc(self):
+        b = self.bspec
+        self.c_slot = np.full(b.counter, self.spec.counter_capacity, np.int32)
+        self.c_inc = np.zeros(b.counter, np.float32)
+        self.g_slot = np.full(b.gauge, self.spec.gauge_capacity, np.int32)
+        self.g_val = np.zeros(b.gauge, np.float32)
+        self.st_slot = np.full(b.status, self.spec.status_capacity, np.int32)
+        self.st_val = np.zeros(b.status, np.float32)
+        self.s_slot = np.full(b.set, self.spec.set_capacity, np.int32)
+        self.s_reg = np.zeros(b.set, np.int32)
+        self.s_rho = np.zeros(b.set, np.uint8)
+        self.h_slot = np.full(b.histo, self.spec.histo_capacity, np.int32)
+        self.h_val = np.zeros(b.histo, np.float32)
+        self.h_wt = np.zeros(b.histo, np.float32)
+        self.nc = self.ng = self.nst = self.ns = self.nh = 0
+
+    def _maybe_emit(self, n, cap):
+        if n >= cap:
+            self.emit()
+
+    def add_counter(self, slot: int, value: float, rate: float):
+        self.c_slot[self.nc] = slot
+        self.c_inc[self.nc] = value * (1.0 / rate)
+        self.nc += 1
+        self._maybe_emit(self.nc, self.bspec.counter)
+
+    def add_gauge(self, slot: int, value: float):
+        self.g_slot[self.ng] = slot
+        self.g_val[self.ng] = value
+        self.ng += 1
+        self._maybe_emit(self.ng, self.bspec.gauge)
+
+    def add_status(self, slot: int, value: float):
+        self.st_slot[self.nst] = slot
+        self.st_val[self.nst] = value
+        self.nst += 1
+        self._maybe_emit(self.nst, self.bspec.status)
+
+    def add_set(self, slot: int, member: bytes):
+        reg, rho = hll_reg_rho(member, self.spec.hll_precision)
+        self.s_slot[self.ns] = slot
+        self.s_reg[self.ns] = reg
+        self.s_rho[self.ns] = rho
+        self.ns += 1
+        self._maybe_emit(self.ns, self.bspec.set)
+
+    def add_histo(self, slot: int, value: float, rate: float):
+        self.h_slot[self.nh] = slot
+        self.h_val[self.nh] = value
+        self.h_wt[self.nh] = 1.0 / rate
+        self.nh += 1
+        self._maybe_emit(self.nh, self.bspec.histo)
+
+    def pending(self) -> int:
+        return self.nc + self.ng + self.nst + self.ns + self.nh
+
+    def emit(self) -> Optional[Batch]:
+        """Build a padded Batch from staged samples, reset staging, and pass
+        it to on_batch (if set). Returns the Batch (None if empty)."""
+        if self.pending() == 0:
+            return None
+        batch = Batch(
+            counter_slot=self.c_slot.copy(), counter_inc=self.c_inc.copy(),
+            gauge_slot=self.g_slot.copy(), gauge_val=self.g_val.copy(),
+            status_slot=self.st_slot.copy(), status_val=self.st_val.copy(),
+            set_slot=self.s_slot.copy(), set_reg=self.s_reg.copy(),
+            set_rho=self.s_rho.copy(),
+            histo_slot=self.h_slot.copy(), histo_val=self.h_val.copy(),
+            histo_wt=self.h_wt.copy(),
+        )
+        # reset padding sentinels for the next batch
+        self.c_slot[:self.nc] = self.spec.counter_capacity
+        self.g_slot[:self.ng] = self.spec.gauge_capacity
+        self.st_slot[:self.nst] = self.spec.status_capacity
+        self.s_slot[:self.ns] = self.spec.set_capacity
+        self.h_slot[:self.nh] = self.spec.histo_capacity
+        self.c_inc[:self.nc] = 0.0
+        self.h_wt[:self.nh] = 0.0
+        self.nc = self.ng = self.nst = self.ns = self.nh = 0
+        if self.on_batch is not None:
+            self.on_batch(batch)
+        return batch

@@ -1,0 +1,103 @@
+"""Device-side aggregation state: the metric-key table.
+
+The reference keeps 13 scope-split Go maps of sampler objects per worker
+(reference worker.go:60-84) whose values are heap objects (int64 counters,
+float64 gauges, HLL sketches, t-digests). Here the equivalent state is a
+fixed-capacity struct-of-arrays, one slot per live MetricKey, assigned by the
+host key dictionary (host.py). Strings never reach the device; scope and
+name/tag metadata stay host-side.
+
+Numeric representation notes:
+
+- Counters (reference samplers/samplers.go:129: int64) are kept as a
+  two-float f32 accumulator (utils/numerics.py) plus a plain f32 scatter
+  target ``counter_acc`` that absorbs the per-batch scatter-adds; the host
+  folds acc into (hi, lo) every ``fold_every`` steps and at flush, bounding
+  rounding error to ~1e-6 relative while keeping the hot path a single
+  scatter-add.
+- Histogram digests are stored as (weight*mean, weight) rather than
+  (mean, weight) so the ingest step is two scatter-adds with no dense
+  mean recomputation; means materialize only during compaction/flush.
+- Gauges are last-write-wins (reference samplers.go:225); batches are
+  in arrival order, so per-batch "last sample per slot" + scatter-set
+  preserves the semantics.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from veneur_tpu.ops import tdigest as td
+from veneur_tpu.ops import hll
+
+
+@dataclasses.dataclass(frozen=True)
+class TableSpec:
+    """Static capacities and sketch parameters of one key table (one shard's
+    worth when sharded; see parallel/)."""
+    counter_capacity: int = 1 << 16
+    gauge_capacity: int = 1 << 14
+    status_capacity: int = 1 << 10
+    set_capacity: int = 1 << 10
+    histo_capacity: int = 1 << 14
+    compression: float = td.DEFAULT_COMPRESSION
+    cells_per_k: int = td.DEFAULT_CELLS_PER_K
+    hll_precision: int = hll.DEFAULT_PRECISION
+
+    @property
+    def centroids(self) -> int:
+        return td.centroid_capacity(self.compression, self.cells_per_k)
+
+    @property
+    def registers(self) -> int:
+        return hll.num_registers(self.hll_precision)
+
+
+class DeviceState(NamedTuple):
+    """One flush interval's aggregation state. All arrays are per-slot;
+    slot indices beyond a type's live count are simply zero/empty."""
+    # counters
+    counter_acc: jax.Array   # f32[Kc] unfolded scatter target
+    counter_hi: jax.Array    # f32[Kc] two-float accumulator
+    counter_lo: jax.Array
+    # gauges / status checks (value part; message is host-side)
+    gauge: jax.Array         # f32[Kg]
+    status: jax.Array        # f32[Kst]
+    # sets
+    hll: jax.Array           # u8[Ks, R]
+    # histograms / timers: digest as (wm, w) + exact scalar aggregates
+    h_wm: jax.Array          # f32[Kh, C]  sum of weight*mean per k-cell
+    h_w: jax.Array           # f32[Kh, C]
+    h_min: jax.Array         # f32[Kh]
+    h_max: jax.Array         # f32[Kh]
+    h_count_acc: jax.Array   # f32[Kh] + two-float, like counters
+    h_count_hi: jax.Array
+    h_count_lo: jax.Array
+    h_sum_acc: jax.Array
+    h_sum_hi: jax.Array
+    h_sum_lo: jax.Array
+    h_recip_acc: jax.Array   # sum of weight/value — harmonic mean support
+    h_recip_hi: jax.Array    # (reference samplers/samplers.go:481,493)
+    h_recip_lo: jax.Array
+
+
+def empty_state(spec: TableSpec) -> DeviceState:
+    f = jnp.float32
+    kc, kg, kst = spec.counter_capacity, spec.gauge_capacity, spec.status_capacity
+    ks, kh, c = spec.set_capacity, spec.histo_capacity, spec.centroids
+    z = jnp.zeros
+    return DeviceState(
+        counter_acc=z((kc,), f), counter_hi=z((kc,), f), counter_lo=z((kc,), f),
+        gauge=z((kg,), f), status=z((kst,), f),
+        hll=jnp.zeros((ks, spec.registers), jnp.uint8),
+        h_wm=z((kh, c), f), h_w=z((kh, c), f),
+        h_min=jnp.full((kh,), jnp.inf, f),
+        h_max=jnp.full((kh,), -jnp.inf, f),
+        h_count_acc=z((kh,), f), h_count_hi=z((kh,), f), h_count_lo=z((kh,), f),
+        h_sum_acc=z((kh,), f), h_sum_hi=z((kh,), f), h_sum_lo=z((kh,), f),
+        h_recip_acc=z((kh,), f), h_recip_hi=z((kh,), f), h_recip_lo=z((kh,), f),
+    )

@@ -1,0 +1,193 @@
+"""The jitted ingest step and flush computations.
+
+This is the hot core replacing the reference's Worker.ProcessMetric switch
+(reference worker.go:344) and the samplers' Sample methods
+(reference samplers/samplers.go:142/225/375/484). One call processes a whole
+padded batch of parsed samples of every type with a handful of scatter ops;
+state is donated so updates are in-place on device.
+
+Histogram ingestion is the interesting part. The reference buffers samples
+into a temp array and runs a sequential greedy merge (reference
+tdigest/merging_digest.go:115,140). Here every sample is assigned a k-cell
+directly: its quantile midpoint is estimated from (a) the current digest's
+mass below the sample value (a [B, C] gather + compare against the row's
+centroids) and (b) the mass of earlier batch samples in the same key segment
+(sort by (slot, value) + segmented cumsum). The sample's (weight, weight*value)
+is then scatter-added into its (slot, cell). Cell assignments drift as the
+distribution evolves, so the host periodically re-compresses rows
+(``compact``), which re-bins all mass at once — the fixed-shape analogue of
+the reference's amortized mergeAllTemps.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from veneur_tpu.aggregation.state import DeviceState, TableSpec
+from veneur_tpu.ops import hll as hll_ops
+from veneur_tpu.ops import tdigest as td
+from veneur_tpu.utils.numerics import twofloat_add
+
+
+class Batch(NamedTuple):
+    """A padded batch of parsed samples. Padding rows carry slot == capacity
+    (out of range) so their scatters drop. All arrays are fixed-size per
+    configuration, so one compiled program serves every step."""
+    counter_slot: jax.Array   # i32[Bc]
+    counter_inc: jax.Array    # f32[Bc]  value * (1/sample_rate), reference samplers.go:142
+    gauge_slot: jax.Array     # i32[Bg]
+    gauge_val: jax.Array      # f32[Bg]
+    status_slot: jax.Array    # i32[Bst]
+    status_val: jax.Array     # f32[Bst]
+    set_slot: jax.Array       # i32[Bs]
+    set_reg: jax.Array        # i32[Bs]
+    set_rho: jax.Array        # u8[Bs]
+    histo_slot: jax.Array     # i32[Bh]
+    histo_val: jax.Array      # f32[Bh]
+    histo_wt: jax.Array       # f32[Bh]  1/sample_rate, reference samplers.go:484
+
+
+def _last_per_slot_set(target, slot, val, capacity):
+    """Scatter-set the LAST batch value per slot (gauge semantics,
+    reference samplers/samplers.go:225 last-write-wins)."""
+    idx = jnp.arange(slot.shape[0], dtype=jnp.int32)
+    order = jnp.lexsort((idx, slot))
+    s = slot[order]
+    v = val[order]
+    is_last = jnp.concatenate([s[:-1] != s[1:], jnp.ones((1,), bool)])
+    tgt = jnp.where(is_last & (s >= 0) & (s < capacity), s, capacity)
+    return target.at[tgt].set(v, mode="drop")
+
+
+def _histo_update(state: DeviceState, slot, val, wt, spec: TableSpec):
+    c = spec.centroids
+    kh = spec.histo_capacity
+    valid = (slot >= 0) & (slot < kh) & (wt > 0)
+    slot = jnp.where(valid, slot, kh)
+    # sort batch by (slot, value) so each key's samples are a contiguous,
+    # value-ordered segment
+    order = jnp.lexsort((val, slot))
+    s = slot[order]
+    v = jnp.where(valid[order], val[order], 0.0)
+    w = jnp.where(valid[order], wt[order], 0.0)
+
+    # mass of the current digest below each sample value
+    sc = jnp.minimum(s, kh - 1)
+    row_w = state.h_w[sc]                     # f32[B, C]
+    row_wm = state.h_wm[sc]
+    row_mean = row_wm / jnp.maximum(row_w, 1e-30)
+    w_main = jnp.sum(row_w, axis=-1)
+    below = (jnp.sum(row_w * (row_mean < v[:, None]), axis=-1)
+             + 0.5 * jnp.sum(row_w * (row_mean == v[:, None]), axis=-1))
+
+    # mass of earlier batch samples in the same segment
+    seg_start = jnp.concatenate(
+        [jnp.ones((1,), bool), s[1:] != s[:-1]])
+    seg_id = jnp.cumsum(seg_start.astype(jnp.int32)) - 1
+    cum_excl = jnp.cumsum(w) - w
+    base = jax.lax.cummax(jnp.where(seg_start, cum_excl, 0.0))
+    cum_seg = cum_excl - base
+    seg_tot = jax.ops.segment_sum(w, seg_id, num_segments=s.shape[0],
+                                  indices_are_sorted=True)[seg_id]
+
+    q_mid = (below + cum_seg + 0.5 * w) / jnp.maximum(w_main + seg_tot, 1e-30)
+    k0 = -spec.compression / 4.0
+    cell = jnp.floor((td._k1(q_mid, spec.compression) - k0)
+                     * spec.cells_per_k).astype(jnp.int32)
+    cell = jnp.clip(cell, 0, c - 1)
+
+    h_w = state.h_w.at[s, cell].add(w, mode="drop")
+    h_wm = state.h_wm.at[s, cell].add(w * v, mode="drop")
+    h_min = state.h_min.at[s].min(jnp.where(w > 0, v, jnp.inf), mode="drop")
+    h_max = state.h_max.at[s].max(jnp.where(w > 0, v, -jnp.inf), mode="drop")
+    h_count = state.h_count_acc.at[s].add(w, mode="drop")
+    h_sum = state.h_sum_acc.at[s].add(w * v, mode="drop")
+    # Go float64 division by zero yields +Inf; match (harmonic mean of a
+    # stream containing 0 is 0 downstream).
+    h_recip = state.h_recip_acc.at[s].add(
+        jnp.where(w > 0, w / v, 0.0), mode="drop")
+    return state._replace(h_w=h_w, h_wm=h_wm, h_min=h_min, h_max=h_max,
+                          h_count_acc=h_count, h_sum_acc=h_sum,
+                          h_recip_acc=h_recip)
+
+
+@partial(jax.jit, static_argnames=("spec",), donate_argnames=("state",))
+def ingest_step(state: DeviceState, batch: Batch, *, spec: TableSpec) -> DeviceState:
+    """Apply one padded batch to the table. The whole reference hot loop
+    below the worker channel (reference server.go:984 -> worker.go:344 ->
+    samplers Sample) becomes this one compiled program."""
+    counter_acc = state.counter_acc.at[batch.counter_slot].add(
+        batch.counter_inc, mode="drop")
+    gauge = _last_per_slot_set(state.gauge, batch.gauge_slot, batch.gauge_val,
+                               spec.gauge_capacity)
+    status = _last_per_slot_set(state.status, batch.status_slot,
+                                batch.status_val, spec.status_capacity)
+    hll = hll_ops.insert_batch(state.hll, batch.set_slot, batch.set_reg,
+                               batch.set_rho, precision=spec.hll_precision)
+    state = state._replace(counter_acc=counter_acc, gauge=gauge,
+                           status=status, hll=hll)
+    return _histo_update(state, batch.histo_slot, batch.histo_val,
+                         batch.histo_wt, spec)
+
+
+@jax.jit
+def fold_scalars(state: DeviceState) -> DeviceState:
+    """Fold the f32 scatter accumulators into their two-float pairs
+    (called by the host every fold_every steps and before flush)."""
+    ch, cl = twofloat_add(state.counter_hi, state.counter_lo, state.counter_acc)
+    hch, hcl = twofloat_add(state.h_count_hi, state.h_count_lo, state.h_count_acc)
+    hsh, hsl = twofloat_add(state.h_sum_hi, state.h_sum_lo, state.h_sum_acc)
+    hrh, hrl = twofloat_add(state.h_recip_hi, state.h_recip_lo, state.h_recip_acc)
+    z = jnp.zeros_like
+    return state._replace(
+        counter_acc=z(state.counter_acc), counter_hi=ch, counter_lo=cl,
+        h_count_acc=z(state.h_count_acc), h_count_hi=hch, h_count_lo=hcl,
+        h_sum_acc=z(state.h_sum_acc), h_sum_hi=hsh, h_sum_lo=hsl,
+        h_recip_acc=z(state.h_recip_acc), h_recip_hi=hrh, h_recip_lo=hrl)
+
+
+@partial(jax.jit, static_argnames=("spec",), donate_argnames=("state",))
+def compact(state: DeviceState, *, spec: TableSpec) -> DeviceState:
+    """Re-compress every digest row to canonical k-cells. Amortized analogue
+    of the reference's mergeAllTemps (merging_digest.go:140)."""
+    mean = state.h_wm / jnp.maximum(state.h_w, 1e-30)
+    m2, w2 = td.compress_rows(mean, state.h_w, compression=spec.compression,
+                              cells_per_k=spec.cells_per_k,
+                              out_c=spec.centroids)
+    return state._replace(h_wm=m2 * w2, h_w=w2)
+
+
+@partial(jax.jit, static_argnames=("spec",))
+def flush_compute(state: DeviceState, qs: jax.Array, *, spec: TableSpec):
+    """Produce the final per-slot values the flusher turns into InterMetrics
+    (reference flusher.go:225 generateInterMetrics). Caller must fold_scalars
+    and compact first. Returns a dict of dense arrays; the host pairs them
+    with slot metadata and emits only live slots."""
+    mean = state.h_wm / jnp.maximum(state.h_w, 1e-30)
+    table = td.TDigestTable(
+        mean=mean, weight=state.h_w, min=state.h_min, max=state.h_max,
+        count_hi=state.h_count_hi, count_lo=state.h_count_lo,
+        sum_hi=state.h_sum_hi, sum_lo=state.h_sum_lo,
+        recip_hi=state.h_recip_hi, recip_lo=state.h_recip_lo)
+    count = state.h_count_hi + state.h_count_lo
+    total = state.h_sum_hi + state.h_sum_lo
+    recip = state.h_recip_hi + state.h_recip_lo
+    return {
+        "counter": state.counter_hi + state.counter_lo,
+        "gauge": state.gauge,
+        "status": state.status,
+        "set_estimate": hll_ops.estimate(state.hll,
+                                         precision=spec.hll_precision),
+        "histo_quantiles": td.quantiles(table, qs),
+        "histo_min": state.h_min,
+        "histo_max": state.h_max,
+        "histo_count": count,
+        "histo_sum": total,
+        "histo_avg": total / jnp.maximum(count, 1e-30),
+        "histo_median": td.quantiles(table, jnp.asarray([0.5], jnp.float32))[..., 0],
+        "histo_hmean": count / jnp.maximum(recip, 1e-30),
+    }

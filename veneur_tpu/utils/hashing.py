@@ -1,0 +1,53 @@
+"""Hash functions for metric keying.
+
+The reference shards every hop by a 32-bit FNV-1a digest over
+name + type + sorted-joined-tags (reference samplers/parser.go:325-420 and
+importsrv/server.go:141-148), and hashes set members with a 64-bit hash for
+HyperLogLog insertion. We keep identical digest semantics (FNV-1a 32) so a
+deployment can mix reference and TPU instances behind one proxy, and use
+FNV-1a 64 + a splitmix64 finalizer for HLL member hashing (any well-mixed
+64-bit hash family gives the same HLL error envelope).
+"""
+
+from __future__ import annotations
+
+FNV32_OFFSET = 0x811C9DC5
+FNV32_PRIME = 0x01000193
+FNV64_OFFSET = 0xCBF29CE484222325
+FNV64_PRIME = 0x100000001B3
+_M64 = (1 << 64) - 1
+
+
+def fnv1a_32(data: bytes, h: int = FNV32_OFFSET) -> int:
+    for b in data:
+        h ^= b
+        h = (h * FNV32_PRIME) & 0xFFFFFFFF
+    return h
+
+
+def fnv1a_64(data: bytes, h: int = FNV64_OFFSET) -> int:
+    for b in data:
+        h ^= b
+        h = (h * FNV64_PRIME) & _M64
+    return h
+
+
+def splitmix64(x: int) -> int:
+    """Finalizer to decorrelate FNV's weak low bits before HLL splitting."""
+    x = (x + 0x9E3779B97F4A7C15) & _M64
+    x = ((x ^ (x >> 30)) * 0xBF58476D1CE4E5B9) & _M64
+    x = ((x ^ (x >> 27)) * 0x94D049BB133111EB) & _M64
+    return x ^ (x >> 31)
+
+
+def hll_reg_rho(member: bytes, precision: int):
+    """(register index, rho) for one set member — host half of the HLL insert
+    (device half is ops/hll.insert_batch)."""
+    h = splitmix64(fnv1a_64(member))
+    reg = h >> (64 - precision)
+    rest = (h << precision) & _M64
+    if rest == 0:
+        rho = 64 - precision + 1
+    else:
+        rho = min(64 - rest.bit_length(), 64 - precision) + 1
+    return reg, rho
