@@ -1,0 +1,200 @@
+"""Parser conformance tests, modeled on the reference's parser_test.go
+coverage: well-formed packets per type, malformed rejection, digest
+determinism, magic tags, events, service checks, SSF conversion."""
+
+import pytest
+
+from veneur_tpu.proto import ssf_pb2
+from veneur_tpu.samplers import (
+    GLOBAL_ONLY, LOCAL_ONLY, MIXED_SCOPE, ParseError, parse_event,
+    parse_metric, parse_metric_ssf, parse_service_check)
+from veneur_tpu.utils.hashing import fnv1a_32
+
+
+def test_fnv1a_known_vectors():
+    # standard FNV-1a test vectors pin hash compatibility with the
+    # reference's fnv1a library
+    assert fnv1a_32(b"") == 0x811C9DC5
+    assert fnv1a_32(b"a") == 0xE40C292C
+    assert fnv1a_32(b"foobar") == 0xBF9CF968
+
+
+@pytest.mark.parametrize("packet,mtype,value", [
+    (b"a.b.c:1|c", "counter", 1.0),
+    (b"a.b.c:-50.4|g", "gauge", -50.4),
+    (b"latency:3.2|ms", "timer", 3.2),
+    (b"dist:7|d", "histogram", 7.0),
+    (b"hist:7|h", "histogram", 7.0),
+])
+def test_parse_metric_types(packet, mtype, value):
+    m = parse_metric(packet)
+    assert m.type == mtype
+    assert m.value == pytest.approx(value)
+    assert m.sample_rate == 1.0
+    assert m.scope == MIXED_SCOPE
+
+
+def test_parse_set_keeps_string():
+    m = parse_metric(b"users:fred@example.com|s")
+    assert m.type == "set"
+    assert m.value == "fred@example.com"
+
+
+def test_parse_sample_rate_and_tags():
+    m = parse_metric(b"a.b:2|c|@0.25|#foo:bar,baz:qux")
+    assert m.sample_rate == pytest.approx(0.25)
+    assert m.tags == ("baz:qux", "foo:bar")  # sorted
+    assert m.joined_tags == "baz:qux,foo:bar"
+
+
+def test_digest_independent_of_tag_order():
+    a = parse_metric(b"x:1|c|#one:1,two:2")
+    b = parse_metric(b"x:1|c|#two:2,one:1")
+    assert a.digest == b.digest
+    c = parse_metric(b"x:1|c|#one:1,two:3")
+    assert a.digest != c.digest
+    d = parse_metric(b"x:1|g|#one:1,two:2")
+    assert a.digest != d.digest  # type feeds the digest
+
+
+def test_magic_tag_local():
+    m = parse_metric(b"a:1|h|#veneurlocalonly,foo:bar")
+    assert m.scope == LOCAL_ONLY
+    assert m.tags == ("foo:bar",)
+
+
+def test_magic_tag_global_prefix_value():
+    m = parse_metric(b"a:1|h|#veneurglobalonly:true,foo:bar")
+    assert m.scope == GLOBAL_ONLY
+    assert m.tags == ("foo:bar",)
+
+
+def test_magic_tag_both_global_wins_first():
+    # sorted order puts veneurglobalonly first; reference strips only the
+    # first match, leaving the local tag in place
+    m = parse_metric(b"a:1|h|#veneurlocalonly,veneurglobalonly")
+    assert m.scope == GLOBAL_ONLY
+    assert m.tags == ("veneurlocalonly",)
+
+
+@pytest.mark.parametrize("packet", [
+    b"nocolon|c",            # no colon
+    b":1|c",                 # empty name
+    b"a:1",                  # no type
+    b"a:1||",                # empty type then empty section
+    b"a:1|q",                # unknown type
+    b"a:1|c|",               # trailing empty section
+    b"a:1|c|@0.5|@0.2",      # multiple rates
+    b"a:1|c|#a:b|#c:d",      # multiple tag sections
+    b"a:1|c|%wat",           # unknown section
+    b"a:nan|c",              # NaN value
+    b"a:inf|g",              # Inf value
+    b"a:one|c",              # non-numeric
+    b"a: 1|c",               # whitespace (Go ParseFloat rejects)
+    b"a:1|c|@1.5",           # rate > 1
+    b"a:1|c|@0",             # rate 0
+    b"a:1|c|@-1",            # rate < 0
+])
+def test_parse_metric_malformed(packet):
+    with pytest.raises(ParseError):
+        parse_metric(packet)
+
+
+def test_parse_event_full():
+    e = parse_event(
+        b"_e{5,4}:title|text|d:1136239445|h:myhost|k:akey|p:low|s:src"
+        b"|t:error|#tag1:v1,tag2", now=99)
+    assert e.name == "title"
+    assert e.message == "text"
+    assert e.timestamp == 1136239445
+    assert e.tags["vdogstatsd_hostname"] == "myhost"
+    assert e.tags["vdogstatsd_ak"] == "akey"
+    assert e.tags["vdogstatsd_pri"] == "low"
+    assert e.tags["vdogstatsd_st"] == "src"
+    assert e.tags["vdogstatsd_at"] == "error"
+    assert e.tags["tag1"] == "v1"
+    assert e.tags["tag2"] == ""
+    assert "vdogstatsd_ev" in e.tags
+
+
+def test_parse_event_newline_unescape():
+    # encoded length counts the raw (escaped) text: len(r"on\ntwo") == 7
+    e = parse_event(b"_e{2,7}:ab|on\\ntwo", now=1)
+    assert e.message == "on\ntwo"
+
+
+@pytest.mark.parametrize("packet", [
+    b"_e{5,4}:titl|text",          # title length mismatch
+    b"_e{5,4}:title|tex",          # text length mismatch
+    b"_e{5,4}title|text",          # no colon
+    b"_e[5,4]:title|text",         # bad wrapper
+    b"_e{5}:title|text",           # no comma
+    b"_e{0,4}:|text",              # zero title length
+    b"_e{5,4}:title|text|p:urgent",  # invalid priority
+    b"_e{5,4}:title|text|t:fatal",   # invalid alert type
+    b"_e{5,4}:title|text|x:wat",     # unknown section
+    b"_e{5,4}:title|text|d:1|d:2",   # duplicate section
+])
+def test_parse_event_malformed(packet):
+    with pytest.raises(ParseError):
+        parse_event(packet)
+
+
+def test_parse_service_check_basic():
+    m = parse_service_check(b"_sc|svc.up|0", now=42)
+    assert m.type == "status"
+    assert m.name == "svc.up"
+    assert m.value == int(ssf_pb2.SSFSample.OK)
+    assert m.timestamp == 42
+    assert m.digest == 0  # reference never digests service checks
+
+
+def test_parse_service_check_full():
+    m = parse_service_check(
+        b"_sc|svc.up|2|d:1136239445|h:host1|#atag|m:it\\nbroke")
+    assert m.value == int(ssf_pb2.SSFSample.CRITICAL)
+    assert m.timestamp == 1136239445
+    assert m.hostname == "host1"
+    assert m.tags == ("atag",)
+    assert m.message == "it\nbroke"
+
+
+@pytest.mark.parametrize("packet", [
+    b"_sc|svc",                    # no status
+    b"_sc||0",                     # empty name
+    b"_sc|svc|9",                  # invalid status
+    b"_sc|svc|0|m:msg|h:host",     # metadata after message
+    b"_sc|svc|0|x:wat",            # unknown section
+])
+def test_parse_service_check_malformed(packet):
+    with pytest.raises(ParseError):
+        parse_service_check(packet)
+
+
+def test_parse_metric_ssf_roundtrip_digest():
+    s = ssf_pb2.SSFSample(
+        metric=ssf_pb2.SSFSample.COUNTER, name="x", value=1.0,
+        sample_rate=1.0)
+    s.tags["one"] = "1"
+    s.tags["two"] = "2"
+    m = parse_metric_ssf(s)
+    dog = parse_metric(b"x:1|c|#one:1,two:2")
+    # same key and digest as the DogStatsD form: SSF and statsd ingest shard
+    # identically (reference parser.go digests both the same way)
+    assert m.digest == dog.digest
+    assert m.key() == dog.key()
+
+
+def test_parse_metric_ssf_scopes_and_set():
+    s = ssf_pb2.SSFSample(metric=ssf_pb2.SSFSample.SET, name="u",
+                          message="member-1")
+    s.tags["veneurglobalonly"] = "true"
+    m = parse_metric_ssf(s)
+    assert m.value == "member-1"
+    assert m.scope == GLOBAL_ONLY
+    assert m.tags == ()
+
+    s2 = ssf_pb2.SSFSample(metric=ssf_pb2.SSFSample.STATUS, name="st",
+                           status=ssf_pb2.SSFSample.WARNING)
+    m2 = parse_metric_ssf(s2)
+    assert m2.value == int(ssf_pb2.SSFSample.WARNING)

@@ -1,0 +1,3 @@
+"""Generated protobuf bindings (wire-compatible with the reference's SSF /
+metricpb / forwardrpc schemas; regenerate with scripts in Makefile)."""
+from veneur_tpu.proto import ssf_pb2, tdigestpb_pb2, metricpb_pb2, forwardrpc_pb2  # noqa: F401

@@ -1,0 +1,18 @@
+"""Parsing and metric keying.
+
+The device-side "samplers" themselves live in aggregation/ (the key table);
+this package holds the wire-facing parse layer: DogStatsD datagrams, events,
+service checks, and SSF sample conversion, with semantics matching the
+reference's samplers/parser.go so existing emitters work unchanged.
+"""
+
+from veneur_tpu.samplers.parser import (
+    MIXED_SCOPE, LOCAL_ONLY, GLOBAL_ONLY,
+    UDPMetric, parse_metric, parse_event, parse_service_check,
+    parse_metric_ssf, parse_tags_to_map, ParseError)
+
+__all__ = [
+    "MIXED_SCOPE", "LOCAL_ONLY", "GLOBAL_ONLY", "UDPMetric", "parse_metric",
+    "parse_event", "parse_service_check", "parse_metric_ssf",
+    "parse_tags_to_map", "ParseError",
+]

@@ -1,0 +1,396 @@
+"""DogStatsD / SSF parsing with reference-identical semantics.
+
+Behavioral contract mirrors the reference's samplers/parser.go:
+- ParseMetric (parser.go:298): ``name:value|type[|@rate][|#tags]``; type
+  bytes c/g/d/h/ms/s; strict malformed-packet rejection; each section at
+  most once; sample rate in (0, 1]; tags sorted then joined with ","; the
+  32-bit FNV-1a digest over name+type+joined-tags is the sharding key.
+- Magic tags (parser.go:397-407): the FIRST sorted tag with prefix
+  "veneurlocalonly"/"veneurglobalonly" is stripped and becomes the scope
+  (note: prefix match, first match only — both present means the
+  lexicographically-earlier "veneurglobalonly" wins and the local tag
+  remains in the tag list; we reproduce that).
+- ParseEvent (parser.go:431): ``_e{tl,tx}:title|text|...`` with d:/h:/k:/
+  p:/s:/t:/#tags metadata, producing an SSF sample carrying the
+  vdogstatsd_* conduit tags.
+- ParseServiceCheck (parser.go:579): ``_sc|name|status|...`` with d:/h:/
+  #tags/m: (message must be last); digest stays 0 (the reference never
+  digests service checks — they all land on worker 0, server.go:973).
+- ParseMetricSSF (parser.go:239): SSFSample -> UDPMetric, where the
+  sample's map tags become sorted "k:v" strings and zero sample rates were
+  already normalized to 1 by the wire layer.
+
+The value of keeping these semantics bit-exact is shard compatibility: a
+mixed fleet of reference instances and this framework hashes every key to
+the same digest, so proxies can route to either.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional, Union
+
+from veneur_tpu.proto import ssf_pb2
+from veneur_tpu.utils.hashing import FNV32_OFFSET, FNV32_PRIME
+
+# MetricScope (reference parser.go:66-70)
+MIXED_SCOPE = 0
+LOCAL_ONLY = 1
+GLOBAL_ONLY = 2
+
+# DogStatsD event conduit tags (reference protocol/dogstatsd/protocol.go)
+EVENT_IDENTIFIER_KEY = "vdogstatsd_ev"
+EVENT_AGGREGATION_KEY_TAG_KEY = "vdogstatsd_ak"
+EVENT_ALERT_TYPE_TAG_KEY = "vdogstatsd_at"
+EVENT_HOSTNAME_TAG_KEY = "vdogstatsd_hostname"
+EVENT_PRIORITY_TAG_KEY = "vdogstatsd_pri"
+EVENT_SOURCE_TYPE_TAG_KEY = "vdogstatsd_st"
+
+
+class ParseError(ValueError):
+    pass
+
+
+@dataclasses.dataclass
+class UDPMetric:
+    """A parsed sample; the MetricKey is (name, type, joined_tags)."""
+    name: str = ""
+    type: str = ""
+    value: Union[float, str, int, None] = None
+    digest: int = 0
+    sample_rate: float = 1.0
+    tags: tuple = ()
+    joined_tags: str = ""
+    scope: int = MIXED_SCOPE
+    timestamp: int = 0
+    message: str = ""
+    hostname: str = ""
+
+    def key(self):
+        return (self.name, self.type, self.joined_tags)
+
+
+def _fnv_add(h: int, data: bytes) -> int:
+    for b in data:
+        h = ((h ^ b) * FNV32_PRIME) & 0xFFFFFFFF
+    return h
+
+
+_TYPE_BY_BYTE = {
+    ord("c"): "counter",
+    ord("g"): "gauge",
+    ord("d"): "histogram",  # DogStatsD "distribution" -> histogram
+    ord("h"): "histogram",
+    ord("m"): "timer",      # "ms"; trailing 's' ignored
+    ord("s"): "set",
+}
+
+
+def _strip_magic_tags(tags: list) -> tuple:
+    """Sorted-first-prefix-match magic tag stripping; returns (tags, scope)."""
+    scope = MIXED_SCOPE
+    for i, tag in enumerate(tags):
+        if tag.startswith("veneurlocalonly"):
+            del tags[i]
+            scope = LOCAL_ONLY
+            break
+        if tag.startswith("veneurglobalonly"):
+            del tags[i]
+            scope = GLOBAL_ONLY
+            break
+    return tags, scope
+
+
+def parse_metric(packet: bytes) -> UDPMetric:
+    """Parse one DogStatsD datagram line into a UDPMetric."""
+    chunks = packet.split(b"|")
+    first = chunks[0]
+    colon = first.find(b":")
+    if colon == -1:
+        raise ParseError("need at least 1 colon")
+    name_b = first[:colon]
+    value_b = first[colon + 1:]
+    if not name_b:
+        raise ParseError("name cannot be empty")
+    if len(chunks) < 2:
+        raise ParseError("need at least 1 pipe for type")
+    type_b = chunks[1]
+    if not type_b:
+        raise ParseError("metric type not specified")
+
+    mtype = _TYPE_BY_BYTE.get(type_b[0])
+    if mtype is None:
+        raise ParseError("invalid type for metric")
+
+    m = UDPMetric(type=mtype)
+    m.name = name_b.decode("utf-8", "surrogateescape")
+    h = _fnv_add(FNV32_OFFSET, name_b)
+    h = _fnv_add(h, mtype.encode())
+
+    if mtype == "set":
+        m.value = value_b.decode("utf-8", "surrogateescape")
+    else:
+        # Go's strconv.ParseFloat is stricter than Python float(): no
+        # surrounding whitespace, no underscores.
+        if value_b != value_b.strip() or b"_" in value_b:
+            raise ParseError("invalid number for metric value")
+        try:
+            v = float(value_b)
+        except ValueError:
+            raise ParseError("invalid number for metric value")
+        if v != v or v in (float("inf"), float("-inf")):
+            raise ParseError("invalid number for metric value")
+        m.value = v
+
+    found_rate = False
+    found_tags = False
+    for chunk in chunks[2:]:
+        if not chunk:
+            raise ParseError("empty string after/between pipes")
+        lead = chunk[0]
+        if lead == 0x40:  # '@'
+            if found_rate:
+                raise ParseError("multiple sample rates specified")
+            try:
+                rate = float(chunk[1:])
+            except ValueError:
+                raise ParseError("invalid float for sample rate")
+            if rate <= 0 or rate > 1:
+                raise ParseError("sample rate must be >0 and <=1")
+            m.sample_rate = rate
+            found_rate = True
+        elif lead == 0x23:  # '#'
+            if found_tags:
+                raise ParseError("multiple tag sections specified")
+            tags = sorted(
+                chunk[1:].decode("utf-8", "surrogateescape").split(","))
+            tags, m.scope = _strip_magic_tags(tags)
+            m.tags = tuple(tags)
+            m.joined_tags = ",".join(tags)
+            h = _fnv_add(h, m.joined_tags.encode("utf-8", "surrogateescape"))
+            found_tags = True
+        else:
+            raise ParseError("contains unknown section")
+
+    m.digest = h
+    return m
+
+
+def parse_tags_to_map(tags) -> dict:
+    """Split "k:v" tag strings into a dict (reference parser.go:696)."""
+    out = {}
+    for tag in tags:
+        k, _, v = tag.partition(":")
+        out[k] = v
+    return out
+
+
+def parse_event(packet: bytes, now: Optional[int] = None) -> ssf_pb2.SSFSample:
+    """Parse a DogStatsD event into an SSF sample with vdogstatsd_* tags."""
+    chunks = packet.split(b"|")
+    first = chunks[0]
+    colon = first.find(b":")
+    if colon == -1:
+        raise ParseError("event needs at least 1 colon")
+    lengths = first[:colon]
+    if not lengths.startswith(b"_e{") or not lengths.endswith(b"}"):
+        raise ParseError("event must have _e{} wrapper around length section")
+    lengths = lengths[3:-1]
+    comma = lengths.find(b",")
+    if comma == -1:
+        raise ParseError("event length section requires comma divider")
+    try:
+        title_len = int(lengths[:comma])
+        text_len = int(lengths[comma + 1:])
+    except ValueError:
+        raise ParseError("event lengths must be integers")
+    if title_len <= 0 or text_len <= 0:
+        raise ParseError("event lengths must be positive")
+
+    title = first[colon + 1:]
+    if len(title) != title_len:
+        raise ParseError("actual title length did not match encoded length")
+    if len(chunks) < 2:
+        raise ParseError("event must have at least 1 pipe for text")
+    text = chunks[1]
+    if len(text) != text_len:
+        raise ParseError("actual text length did not match encoded length")
+
+    sample = ssf_pb2.SSFSample(
+        name=title.decode("utf-8", "surrogateescape"),
+        message=text.decode("utf-8", "surrogateescape").replace("\\n", "\n"),
+        timestamp=now if now is not None else int(time.time()),
+    )
+    sample.tags[EVENT_IDENTIFIER_KEY] = ""
+
+    seen = set()
+
+    def once(key):
+        if key in seen:
+            raise ParseError(f"multiple {key} sections")
+        seen.add(key)
+
+    for chunk in chunks[2:]:
+        if not chunk:
+            raise ParseError("empty string after/between pipes")
+        if chunk.startswith(b"d:"):
+            once("date")
+            try:
+                sample.timestamp = int(chunk[2:])
+            except ValueError:
+                raise ParseError("could not parse date as unix timestamp")
+        elif chunk.startswith(b"h:"):
+            once("hostname")
+            sample.tags[EVENT_HOSTNAME_TAG_KEY] = chunk[2:].decode(
+                "utf-8", "surrogateescape")
+        elif chunk.startswith(b"k:"):
+            once("aggregation")
+            sample.tags[EVENT_AGGREGATION_KEY_TAG_KEY] = chunk[2:].decode(
+                "utf-8", "surrogateescape")
+        elif chunk.startswith(b"p:"):
+            once("priority")
+            pri = chunk[2:].decode("utf-8", "surrogateescape")
+            if pri not in ("normal", "low"):
+                raise ParseError("priority must be normal or low")
+            sample.tags[EVENT_PRIORITY_TAG_KEY] = pri
+        elif chunk.startswith(b"s:"):
+            once("source")
+            sample.tags[EVENT_SOURCE_TYPE_TAG_KEY] = chunk[2:].decode(
+                "utf-8", "surrogateescape")
+        elif chunk.startswith(b"t:"):
+            once("alert")
+            alert = chunk[2:].decode("utf-8", "surrogateescape")
+            if alert not in ("error", "warning", "info", "success"):
+                raise ParseError(
+                    "alert level must be error, warning, info or success")
+            sample.tags[EVENT_ALERT_TYPE_TAG_KEY] = alert
+        elif chunk[0] == 0x23:  # '#'
+            once("tags")
+            tags = chunk[1:].decode("utf-8", "surrogateescape").split(",")
+            for k, v in parse_tags_to_map(tags).items():
+                sample.tags[k] = v
+        else:
+            raise ParseError("unrecognized event metadata section")
+    return sample
+
+
+def parse_service_check(packet: bytes, now: Optional[int] = None) -> UDPMetric:
+    """Parse a DogStatsD service check into a status-typed UDPMetric."""
+    chunks = packet.split(b"|")
+    if chunks[0] != b"_sc":
+        raise ParseError("service check needs _sc prefix")
+    if len(chunks) < 2:
+        raise ParseError("service check needs name section")
+    if not chunks[1]:
+        raise ParseError("service check name cannot be empty")
+    if len(chunks) < 3:
+        raise ParseError("service check needs status section")
+
+    status_map = {b"0": ssf_pb2.SSFSample.OK, b"1": ssf_pb2.SSFSample.WARNING,
+                  b"2": ssf_pb2.SSFSample.CRITICAL,
+                  b"3": ssf_pb2.SSFSample.UNKNOWN}
+    if chunks[2] not in status_map:
+        raise ParseError("service check status must be 0, 1, 2, or 3")
+
+    m = UDPMetric(
+        type="status",
+        name=chunks[1].decode("utf-8", "surrogateescape"),
+        value=int(status_map[chunks[2]]),
+        timestamp=now if now is not None else int(time.time()),
+    )
+
+    found = set()
+    found_message = False
+    for chunk in chunks[3:]:
+        if not chunk:
+            raise ParseError("empty string after/between pipes")
+        if found_message:
+            raise ParseError("message must be the last metadata section")
+        if chunk.startswith(b"d:"):
+            if "date" in found:
+                raise ParseError("multiple date sections")
+            found.add("date")
+            try:
+                m.timestamp = int(chunk[2:])
+            except ValueError:
+                raise ParseError("could not parse date as unix timestamp")
+        elif chunk.startswith(b"h:"):
+            if "hostname" in found:
+                raise ParseError("multiple hostname sections")
+            found.add("hostname")
+            m.hostname = chunk[2:].decode("utf-8", "surrogateescape")
+        elif chunk.startswith(b"m:"):
+            m.message = chunk[2:].decode(
+                "utf-8", "surrogateescape").replace("\\n", "\n")
+            found_message = True
+        elif chunk[0] == 0x23:  # '#'
+            if "tags" in found:
+                raise ParseError("multiple tag sections")
+            found.add("tags")
+            tags = sorted(chunk[1:].decode("utf-8", "surrogateescape").split(","))
+            # exact-equality magic tags here (unlike metric prefix match)
+            scope = MIXED_SCOPE
+            for i, tag in enumerate(tags):
+                if tag == "veneurlocalonly":
+                    del tags[i]
+                    scope = LOCAL_ONLY
+                    break
+                if tag == "veneurglobalonly":
+                    del tags[i]
+                    scope = GLOBAL_ONLY
+                    break
+            m.scope = scope
+            m.tags = tuple(tags)
+            m.joined_tags = ",".join(tags)
+        else:
+            raise ParseError("unrecognized service check metadata section")
+    return m
+
+
+_SSF_TYPE = {
+    ssf_pb2.SSFSample.COUNTER: "counter",
+    ssf_pb2.SSFSample.GAUGE: "gauge",
+    ssf_pb2.SSFSample.HISTOGRAM: "histogram",
+    ssf_pb2.SSFSample.SET: "set",
+    ssf_pb2.SSFSample.STATUS: "status",
+}
+
+
+def parse_metric_ssf(sample: ssf_pb2.SSFSample) -> UDPMetric:
+    """Convert an SSF sample to a UDPMetric (reference parser.go:239)."""
+    mtype = _SSF_TYPE.get(sample.metric)
+    if mtype is None:
+        raise ParseError("invalid type for metric")
+    m = UDPMetric(type=mtype, name=sample.name)
+    h = _fnv_add(FNV32_OFFSET, sample.name.encode("utf-8", "surrogateescape"))
+    h = _fnv_add(h, mtype.encode())
+
+    if sample.metric == ssf_pb2.SSFSample.SET:
+        m.value = sample.message
+    elif sample.metric == ssf_pb2.SSFSample.STATUS:
+        m.value = int(sample.status)
+    else:
+        m.value = float(sample.value)
+
+    if sample.scope == ssf_pb2.SSFSample.LOCAL:
+        m.scope = LOCAL_ONLY
+    elif sample.scope == ssf_pb2.SSFSample.GLOBAL:
+        m.scope = GLOBAL_ONLY
+
+    m.sample_rate = sample.sample_rate
+    tags = []
+    for k, v in sample.tags.items():
+        if k == "veneurlocalonly":
+            m.scope = LOCAL_ONLY
+            continue
+        if k == "veneurglobalonly":
+            m.scope = GLOBAL_ONLY
+            continue
+        tags.append(f"{k}:{v}")
+    tags.sort()
+    m.tags = tuple(tags)
+    m.joined_tags = ",".join(tags)
+    h = _fnv_add(h, m.joined_tags.encode("utf-8", "surrogateescape"))
+    m.digest = h
+    return m
