@@ -64,9 +64,15 @@ class DeviceState(NamedTuple):
     counter_acc: jax.Array   # f32[Kc] unfolded scatter target
     counter_hi: jax.Array    # f32[Kc] two-float accumulator
     counter_lo: jax.Array
-    # gauges / status checks (value part; message is host-side)
+    # gauges / status checks (value part; message is host-side).  The stamp
+    # arrays mark slots written this interval so the cross-replica merge has
+    # a well-defined last-write winner (the reference's Gauge.Merge simply
+    # overwrites in import order, samplers/samplers.go:297; our canonical
+    # order is "highest replica index that wrote wins").
     gauge: jax.Array         # f32[Kg]
+    gauge_stamp: jax.Array   # u8[Kg] 1 if written this interval
     status: jax.Array        # f32[Kst]
+    status_stamp: jax.Array  # u8[Kst]
     # sets
     hll: jax.Array           # u8[Ks, R]
     # histograms / timers: digest as (wm, w) + exact scalar aggregates
@@ -92,7 +98,8 @@ def empty_state(spec: TableSpec) -> DeviceState:
     z = jnp.zeros
     return DeviceState(
         counter_acc=z((kc,), f), counter_hi=z((kc,), f), counter_lo=z((kc,), f),
-        gauge=z((kg,), f), status=z((kst,), f),
+        gauge=z((kg,), f), gauge_stamp=z((kg,), jnp.uint8),
+        status=z((kst,), f), status_stamp=z((kst,), jnp.uint8),
         hll=jnp.zeros((ks, spec.registers), jnp.uint8),
         h_wm=z((kh, c), f), h_w=z((kh, c), f),
         h_min=jnp.full((kh,), jnp.inf, f),

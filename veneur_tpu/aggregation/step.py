@@ -51,16 +51,18 @@ class Batch(NamedTuple):
     histo_wt: jax.Array       # f32[Bh]  1/sample_rate, reference samplers.go:484
 
 
-def _last_per_slot_set(target, slot, val, capacity):
+def _last_per_slot_set(target, stamp, slot, val, capacity):
     """Scatter-set the LAST batch value per slot (gauge semantics,
-    reference samplers/samplers.go:225 last-write-wins)."""
+    reference samplers/samplers.go:225 last-write-wins) and mark the slot's
+    write stamp."""
     idx = jnp.arange(slot.shape[0], dtype=jnp.int32)
     order = jnp.lexsort((idx, slot))
     s = slot[order]
     v = val[order]
     is_last = jnp.concatenate([s[:-1] != s[1:], jnp.ones((1,), bool)])
     tgt = jnp.where(is_last & (s >= 0) & (s < capacity), s, capacity)
-    return target.at[tgt].set(v, mode="drop")
+    return (target.at[tgt].set(v, mode="drop"),
+            stamp.at[tgt].set(jnp.uint8(1), mode="drop"))
 
 
 def _histo_update(state: DeviceState, slot, val, wt, spec: TableSpec):
@@ -115,23 +117,32 @@ def _histo_update(state: DeviceState, slot, val, wt, spec: TableSpec):
                           h_recip_acc=h_recip)
 
 
-@partial(jax.jit, static_argnames=("spec",), donate_argnames=("state",))
-def ingest_step(state: DeviceState, batch: Batch, *, spec: TableSpec) -> DeviceState:
+def ingest_core(state: DeviceState, batch: Batch, *, spec: TableSpec) -> DeviceState:
     """Apply one padded batch to the table. The whole reference hot loop
     below the worker channel (reference server.go:984 -> worker.go:344 ->
-    samplers Sample) becomes this one compiled program."""
+    samplers Sample) becomes this one compiled program. Pure function —
+    `ingest_step` is the donating jit wrapper; parallel/sharded.py wraps it
+    in shard_map/vmap instead."""
     counter_acc = state.counter_acc.at[batch.counter_slot].add(
         batch.counter_inc, mode="drop")
-    gauge = _last_per_slot_set(state.gauge, batch.gauge_slot, batch.gauge_val,
-                               spec.gauge_capacity)
-    status = _last_per_slot_set(state.status, batch.status_slot,
-                                batch.status_val, spec.status_capacity)
+    gauge, gauge_stamp = _last_per_slot_set(
+        state.gauge, state.gauge_stamp, batch.gauge_slot, batch.gauge_val,
+        spec.gauge_capacity)
+    status, status_stamp = _last_per_slot_set(
+        state.status, state.status_stamp, batch.status_slot,
+        batch.status_val, spec.status_capacity)
     hll = hll_ops.insert_batch(state.hll, batch.set_slot, batch.set_reg,
                                batch.set_rho, precision=spec.hll_precision)
-    state = state._replace(counter_acc=counter_acc, gauge=gauge,
-                           status=status, hll=hll)
+    state = state._replace(counter_acc=counter_acc,
+                           gauge=gauge, gauge_stamp=gauge_stamp,
+                           status=status, status_stamp=status_stamp,
+                           hll=hll)
     return _histo_update(state, batch.histo_slot, batch.histo_val,
                          batch.histo_wt, spec)
+
+
+ingest_step = partial(jax.jit, static_argnames=("spec",),
+                      donate_argnames=("state",))(ingest_core)
 
 
 @jax.jit
@@ -150,8 +161,7 @@ def fold_scalars(state: DeviceState) -> DeviceState:
         h_recip_acc=z(state.h_recip_acc), h_recip_hi=hrh, h_recip_lo=hrl)
 
 
-@partial(jax.jit, static_argnames=("spec",), donate_argnames=("state",))
-def compact(state: DeviceState, *, spec: TableSpec) -> DeviceState:
+def compact_core(state: DeviceState, *, spec: TableSpec) -> DeviceState:
     """Re-compress every digest row to canonical k-cells. Amortized analogue
     of the reference's mergeAllTemps (merging_digest.go:140)."""
     mean = state.h_wm / jnp.maximum(state.h_w, 1e-30)
@@ -161,8 +171,11 @@ def compact(state: DeviceState, *, spec: TableSpec) -> DeviceState:
     return state._replace(h_wm=m2 * w2, h_w=w2)
 
 
-@partial(jax.jit, static_argnames=("spec",))
-def flush_compute(state: DeviceState, qs: jax.Array, *, spec: TableSpec):
+compact = partial(jax.jit, static_argnames=("spec",),
+                  donate_argnames=("state",))(compact_core)
+
+
+def flush_core(state: DeviceState, qs: jax.Array, *, spec: TableSpec):
     """Produce the final per-slot values the flusher turns into InterMetrics
     (reference flusher.go:225 generateInterMetrics). Caller must fold_scalars
     and compact first. Returns a dict of dense arrays; the host pairs them
@@ -191,3 +204,6 @@ def flush_compute(state: DeviceState, qs: jax.Array, *, spec: TableSpec):
         "histo_median": td.quantiles(table, jnp.asarray([0.5], jnp.float32))[..., 0],
         "histo_hmean": count / jnp.maximum(recip, 1e-30),
     }
+
+
+flush_compute = partial(jax.jit, static_argnames=("spec",))(flush_core)

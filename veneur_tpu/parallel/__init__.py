@@ -1,0 +1,9 @@
+from veneur_tpu.parallel.sharded import (  # noqa: F401
+    REPLICA_AXIS,
+    SHARD_AXIS,
+    make_mesh,
+    sharded_empty_state,
+    make_sharded_ingest,
+    make_merged_flush,
+    stack_batches,
+)

@@ -1,0 +1,192 @@
+"""Device-mesh sharding of the key table and the collective global merge.
+
+The reference's two distribution axes (SURVEY §2.4) map onto a 2-D
+`jax.sharding.Mesh`:
+
+- **"shard"** — key-space parallelism: `Digest % numWorkers` routing
+  (reference server.go:973,984) becomes a leading shard axis on every state
+  array, partitioned across devices. Each key lives on exactly one device
+  (host.py assigns slot = shard * per_shard + local), so the ingest scatter
+  never crosses devices — the per-worker-private-maps property of the
+  reference (worker.go:60-84), expressed as sharding.
+- **"replica"** — the local→global aggregation tier: each replica group
+  accumulates its own sample stream (one "local veneur instance" worth of
+  state); the flush-time merge the reference does over gRPC
+  (importsrv/server.go:102 → samplers Merge methods) becomes on-device
+  collectives over ICI: `psum` for counters/histogram scalars, register-max
+  for HLL, all-gather + re-compress for t-digest centroids, and a
+  stamp-argmax for last-write-wins gauges.
+
+All state arrays carry leading dims [R, S] (replica, shard) and are laid out
+with `NamedSharding(mesh, P("replica", "shard"))`; compute enters via
+`jax.shard_map`, inside which each device sees its [r_local, s_local] block
+and runs the same per-table ingest core under double vmap.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from veneur_tpu.aggregation.state import DeviceState, TableSpec, empty_state
+from veneur_tpu.aggregation.step import Batch, ingest_core, flush_core
+from veneur_tpu.ops import tdigest as td
+
+REPLICA_AXIS = "replica"
+SHARD_AXIS = "shard"
+
+
+def make_mesh(n_replicas: int, n_shards: int, devices=None) -> Mesh:
+    """A (replica, shard) mesh over `n_replicas * n_shards` devices, or —
+    with fewer physical devices — the largest (nr, ns) mesh where nr divides
+    n_replicas and ns divides n_shards. shard_map blocks then hold multiple
+    logical tiles per device (leading block dims > 1), which the vmapped
+    cores handle transparently."""
+    import numpy as np
+    if devices is None:
+        devices = jax.devices()
+    need = n_replicas * n_shards
+    if len(devices) >= need:
+        return Mesh(np.asarray(devices[:need]).reshape(n_replicas, n_shards),
+                    (REPLICA_AXIS, SHARD_AXIS))
+    nr = next(d for d in range(min(n_replicas, len(devices)), 0, -1)
+              if n_replicas % d == 0)
+    ns = next(d for d in range(min(n_shards, len(devices) // nr), 0, -1)
+              if n_shards % d == 0)
+    return Mesh(np.asarray(devices[:nr * ns]).reshape(nr, ns),
+                (REPLICA_AXIS, SHARD_AXIS))
+
+
+def state_sharding(mesh: Mesh):
+    return NamedSharding(mesh, P(REPLICA_AXIS, SHARD_AXIS))
+
+
+def sharded_empty_state(spec: TableSpec, n_replicas: int, n_shards: int,
+                        mesh: Mesh) -> DeviceState:
+    """DeviceState whose arrays have leading [R, S] dims, device-placed with
+    (replica, shard) sharding. `spec` capacities are PER SHARD."""
+    one = empty_state(spec)
+    sh = state_sharding(mesh)
+
+    def tile(x):
+        tiled = jnp.broadcast_to(x, (n_replicas, n_shards) + x.shape)
+        return jax.device_put(tiled, sh)
+
+    return jax.tree.map(tile, one)
+
+
+def stack_batches(batches, n_replicas: int, n_shards: int) -> Batch:
+    """Stack a [R][S] nested list of per-shard Batches into one Batch with
+    leading [R, S] dims (host-side numpy; feed to the sharded ingest)."""
+    import numpy as np
+    cols = list(zip(*[list(zip(*[batches[r][s] for s in range(n_shards)]))
+                      for r in range(n_replicas)]))
+    return Batch(*[np.stack([np.stack(row) for row in col]) for col in cols])
+
+
+def make_sharded_ingest(mesh: Mesh, spec: TableSpec):
+    """Jitted (state, batch) -> state over the mesh. Batch arrays must carry
+    the same leading [R, S] dims as the state; each (replica, shard) tile's
+    scatters stay on its own device — zero communication."""
+    core = partial(ingest_core, spec=spec)
+    vv = jax.vmap(jax.vmap(core))
+    fn = jax.shard_map(
+        vv, mesh=mesh,
+        in_specs=(P(REPLICA_AXIS, SHARD_AXIS), P(REPLICA_AXIS, SHARD_AXIS)),
+        out_specs=P(REPLICA_AXIS, SHARD_AXIS))
+    return jax.jit(fn, donate_argnums=(0,))
+
+
+def _merge_replica_block(state: DeviceState, spec: TableSpec):
+    """Inside shard_map: merge a [r_local, s_local, ...] block over the full
+    replica axis (local reduce + named-axis collective). Returns arrays with
+    the replica dims reduced away — one merged table per shard tile."""
+    ax = REPLICA_AXIS
+
+    def total(hi, lo, acc):
+        t = (hi + lo + acc).sum(axis=0)
+        return jax.lax.psum(t, ax)
+
+    counters = total(state.counter_hi, state.counter_lo, state.counter_acc)
+    h_count = total(state.h_count_hi, state.h_count_lo, state.h_count_acc)
+    h_sum = total(state.h_sum_hi, state.h_sum_lo, state.h_sum_acc)
+    h_recip = total(state.h_recip_hi, state.h_recip_lo, state.h_recip_acc)
+
+    # HLL: register-wise max (reference Set.Merge = HLL union,
+    # samplers/samplers.go:461)
+    hll = jax.lax.pmax(state.hll.max(axis=0), ax)
+
+    # gauges/status: last-write-wins with canonical order = highest global
+    # replica index that wrote (reference Gauge.Merge overwrites, :297)
+    def lww(val, stamp):
+        r_local = val.shape[0]
+        ridx = jax.lax.axis_index(ax) * r_local + jnp.arange(r_local)
+        prio = jnp.where(stamp > 0, ridx[:, None, None] + 1, 0)
+        vals = jax.lax.all_gather(val, ax)          # [Rg, r_local, s, K]
+        prios = jax.lax.all_gather(prio, ax)
+        vals = vals.reshape((-1,) + vals.shape[2:])
+        prios = prios.reshape((-1,) + prios.shape[2:])
+        win = jnp.argmax(prios, axis=0)
+        merged = jnp.take_along_axis(vals, win[None], axis=0)[0]
+        written = prios.max(axis=0) > 0
+        return merged, written.astype(jnp.uint8)
+
+    gauge, gauge_stamp = lww(state.gauge, state.gauge_stamp)
+    status, status_stamp = lww(state.status, state.status_stamp)
+
+    # t-digest: gather every replica's centroids for the key, concatenate
+    # along the centroid axis, re-compress to canonical cells (the
+    # fixed-shape analogue of Histo.Merge digest re-add,
+    # samplers/samplers.go:726)
+    wm = jax.lax.all_gather(state.h_wm, ax)   # [Rg, r_local, s, K, C]
+    w = jax.lax.all_gather(state.h_w, ax)
+    wm = jnp.moveaxis(wm.reshape((-1,) + wm.shape[2:]), 0, -2)  # [s,K,R,C]
+    w = jnp.moveaxis(w.reshape((-1,) + w.shape[2:]), 0, -2)
+    s_l, k, r, c = w.shape
+    mean = wm / jnp.maximum(w, 1e-30)
+    mean = mean.reshape(s_l, k, r * c)
+    w = w.reshape(s_l, k, r * c)
+    m2, w2 = td.compress_rows(mean, w, compression=spec.compression,
+                              cells_per_k=spec.cells_per_k,
+                              out_c=spec.centroids)
+
+    h_min = jax.lax.pmin(state.h_min.min(axis=0), ax)
+    h_max = jax.lax.pmax(state.h_max.max(axis=0), ax)
+
+    z = jnp.zeros_like
+    merged = DeviceState(
+        counter_acc=z(counters), counter_hi=counters, counter_lo=z(counters),
+        gauge=gauge, gauge_stamp=gauge_stamp,
+        status=status, status_stamp=status_stamp,
+        hll=hll,
+        h_wm=m2 * w2, h_w=w2, h_min=h_min, h_max=h_max,
+        h_count_acc=z(h_count), h_count_hi=h_count, h_count_lo=z(h_count),
+        h_sum_acc=z(h_sum), h_sum_hi=h_sum, h_sum_lo=z(h_sum),
+        h_recip_acc=z(h_recip), h_recip_hi=h_recip, h_recip_lo=z(h_recip),
+    )
+    return merged
+
+
+def make_merged_flush(mesh: Mesh, spec: TableSpec, n_quantiles: int):
+    """Jitted (state[R,S,...], qs[n_quantiles]) -> flush dict with leading
+    [S] dim: replica-merged, per-shard final aggregates. The replica merge is
+    the reference's global-tier import (SURVEY §3.4) as one collective
+    program; the flush math is flush_core per shard."""
+    del n_quantiles  # shape comes from qs itself
+
+    def block(state: DeviceState, qs):
+        # _merge_replica_block already re-compresses digests to canonical
+        # cells; no separate compact pass needed before the flush math.
+        merged = _merge_replica_block(state, spec)
+        out = jax.vmap(lambda st: flush_core(st, qs, spec=spec))(merged)
+        return out
+
+    fn = jax.shard_map(
+        block, mesh=mesh,
+        in_specs=(P(REPLICA_AXIS, SHARD_AXIS), P()),
+        out_specs=P(SHARD_AXIS),
+        check_vma=False)
+    return jax.jit(fn)
