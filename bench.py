@@ -1,0 +1,101 @@
+"""Headline benchmark: device-side aggregation throughput at ~1M-key
+cardinality (BASELINE.md north star: samples/sec/chip at 1M cardinality).
+
+Measures the jitted ingest step — the replacement for the reference's whole
+per-sample hot loop (worker.go:344 ProcessMetric → samplers Sample →
+merging_digest.go:115 Add) — over a key table of ~1M live slots across all
+metric types, with a realistic type mix (counters + timers dominate,
+reference BASELINE configs 1-3). Prints ONE JSON line.
+
+vs_baseline is the ratio to the 50M samples/sec/chip north-star target from
+BASELINE.json (the reference publishes no comparable per-core number; its
+production figure is >60k packets/sec/host, README.md:306).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    steps = int(os.environ.get("BENCH_STEPS", "20"))
+    import jax
+    import jax.numpy as jnp
+    from veneur_tpu.aggregation.state import TableSpec, empty_state
+    from veneur_tpu.aggregation.step import Batch, ingest_step, fold_scalars
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+    if not on_tpu:
+        # CPU smoke-mode: tiny shapes so the harness stays runnable anywhere
+        spec = TableSpec(counter_capacity=1 << 12, gauge_capacity=1 << 10,
+                         status_capacity=1 << 8, set_capacity=1 << 8,
+                         histo_capacity=1 << 10)
+        b = dict(counter=1 << 12, gauge=1 << 10, status=1 << 8,
+                 set=1 << 8, histo=1 << 10)
+        steps = min(steps, 5)
+    else:
+        # ~1M live keys: 512k counters + 256k gauges + 1k status +
+        # 16k sets + 128k timers/histograms
+        spec = TableSpec(counter_capacity=1 << 19, gauge_capacity=1 << 18,
+                         status_capacity=1 << 10, set_capacity=1 << 14,
+                         histo_capacity=1 << 17)
+        b = dict(counter=1 << 18, gauge=1 << 14, status=1 << 8,
+                 set=1 << 14, histo=1 << 16)
+
+    rng = np.random.default_rng(0)
+
+    def mk_batch():
+        return Batch(
+            counter_slot=rng.integers(0, spec.counter_capacity,
+                                      b["counter"]).astype(np.int32),
+            counter_inc=rng.uniform(0, 5, b["counter"]).astype(np.float32),
+            gauge_slot=rng.integers(0, spec.gauge_capacity,
+                                    b["gauge"]).astype(np.int32),
+            gauge_val=rng.uniform(-1, 1, b["gauge"]).astype(np.float32),
+            status_slot=rng.integers(0, spec.status_capacity,
+                                     b["status"]).astype(np.int32),
+            status_val=rng.integers(0, 3, b["status"]).astype(np.float32),
+            set_slot=rng.integers(0, spec.set_capacity,
+                                  b["set"]).astype(np.int32),
+            set_reg=rng.integers(0, spec.registers, b["set"]).astype(np.int32),
+            set_rho=rng.integers(1, 40, b["set"]).astype(np.uint8),
+            histo_slot=rng.integers(0, spec.histo_capacity,
+                                    b["histo"]).astype(np.int32),
+            histo_val=rng.lognormal(0, 0.7, b["histo"]).astype(np.float32),
+            histo_wt=np.ones(b["histo"], np.float32),
+        )
+
+    n_batches = 4
+    batches = [jax.device_put(jax.tree.map(jnp.asarray, mk_batch()), dev)
+               for _ in range(n_batches)]
+    per_step = sum(b.values())
+
+    state = jax.device_put(empty_state(spec), dev)
+    # warmup / compile
+    for i in range(2):
+        state = ingest_step(state, batches[i % n_batches], spec=spec)
+    jax.block_until_ready(state)
+
+    t0 = time.perf_counter()
+    for i in range(steps):
+        state = ingest_step(state, batches[i % n_batches], spec=spec)
+    state = fold_scalars(state)
+    jax.block_until_ready(state)
+    dt = time.perf_counter() - t0
+
+    rate = per_step * steps / dt
+    print(json.dumps({
+        "metric": "aggregation_samples_per_sec_per_chip_1M_keys",
+        "value": round(rate, 1),
+        "unit": "samples/sec",
+        "vs_baseline": round(rate / 50e6, 4),
+    }))
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    main()

@@ -77,7 +77,7 @@ def test_merged_flush_replica_collectives():
     state = ingest(state, stack_batches(batches, r, s))
 
     qs = jnp.asarray([0.5, 0.99], jnp.float32)
-    flush = make_merged_flush(mesh, SPEC, 2)
+    flush = make_merged_flush(mesh, SPEC)
     out = jax.tree.map(np.asarray, flush(state, qs))
 
     for si in range(s):
@@ -149,7 +149,7 @@ def test_merged_quantile_accuracy_across_replicas():
         state = ingest(state, stack_batches(chunk, r, s))
 
     qs = jnp.asarray([0.5, 0.99], jnp.float32)
-    out = make_merged_flush(mesh, spec, 2)(state, qs)
+    out = make_merged_flush(mesh, spec)(state, qs)
     got = np.asarray(out["histo_quantiles"])[0, 0]  # shard 0, key 0
     exact = np.quantile(all_vals, [0.5, 0.99])
     np.testing.assert_allclose(got, exact, atol=0.02)

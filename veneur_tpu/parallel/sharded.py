@@ -52,10 +52,11 @@ def make_mesh(n_replicas: int, n_shards: int, devices=None) -> Mesh:
     if len(devices) >= need:
         return Mesh(np.asarray(devices[:need]).reshape(n_replicas, n_shards),
                     (REPLICA_AXIS, SHARD_AXIS))
-    nr = next(d for d in range(min(n_replicas, len(devices)), 0, -1)
-              if n_replicas % d == 0)
-    ns = next(d for d in range(min(n_shards, len(devices) // nr), 0, -1)
-              if n_shards % d == 0)
+    nr, ns = max(
+        ((r, s) for r in range(1, n_replicas + 1) if n_replicas % r == 0
+         for s in range(1, n_shards + 1) if n_shards % s == 0
+         and r * s <= len(devices)),
+        key=lambda p: p[0] * p[1])
     return Mesh(np.asarray(devices[:nr * ns]).reshape(nr, ns),
                 (REPLICA_AXIS, SHARD_AXIS))
 
@@ -170,12 +171,11 @@ def _merge_replica_block(state: DeviceState, spec: TableSpec):
     return merged
 
 
-def make_merged_flush(mesh: Mesh, spec: TableSpec, n_quantiles: int):
-    """Jitted (state[R,S,...], qs[n_quantiles]) -> flush dict with leading
-    [S] dim: replica-merged, per-shard final aggregates. The replica merge is
-    the reference's global-tier import (SURVEY §3.4) as one collective
-    program; the flush math is flush_core per shard."""
-    del n_quantiles  # shape comes from qs itself
+def make_merged_flush(mesh: Mesh, spec: TableSpec):
+    """Jitted (state[R,S,...], qs[Q]) -> flush dict with leading [S] dim:
+    replica-merged, per-shard final aggregates. The replica merge is the
+    reference's global-tier import (SURVEY §3.4) as one collective program;
+    the flush math is flush_core per shard."""
 
     def block(state: DeviceState, qs):
         # _merge_replica_block already re-compresses digests to canonical
