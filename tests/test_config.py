@@ -1,0 +1,59 @@
+"""Config parsing semantics (reference config_parse.go + config_test.go)."""
+
+import io
+
+import pytest
+
+from veneur_tpu.config import Config, parse_duration, read_config
+
+
+def test_defaults_applied():
+    cfg = read_config(io.StringIO("statsd_listen_addresses:\n"
+                                  " - udp://127.0.0.1:0\n"))
+    assert cfg.interval == "10s"
+    assert cfg.metric_max_length == 4096
+    assert cfg.read_buffer_size_bytes == 2 * 1048576
+    assert cfg.aggregates == ["min", "max", "count"]
+    assert cfg.datadog_flush_max_per_body == 25000
+    assert cfg.span_channel_capacity == 100
+    assert cfg.hostname  # filled from socket.gethostname()
+
+
+def test_unknown_keys_warn_not_fail(caplog):
+    with caplog.at_level("WARNING", logger="veneur_tpu.config"):
+        cfg = read_config(io.StringIO("interval: 5s\nbogus_key: 1\n"))
+    assert cfg.interval == "5s"
+    assert any("bogus_key" in r.message for r in caplog.records)
+
+
+def test_env_override():
+    cfg = read_config(io.StringIO("interval: 5s\n"),
+                      env={"VENEUR_INTERVAL": "2s",
+                           "VENEUR_NUMWORKERS": "9",
+                           "VENEUR_TAGS": "a:1,b:2",
+                           "VENEUR_DEBUG": "true"})
+    assert cfg.interval == "2s"
+    assert cfg.num_workers == 9
+    assert cfg.tags == ["a:1", "b:2"]
+    assert cfg.debug is True
+
+
+def test_parse_duration():
+    assert parse_duration("10s") == 10.0
+    assert parse_duration("500ms") == 0.5
+    assert parse_duration("1m30s") == 90.0
+    assert parse_duration("2h") == 7200.0
+    with pytest.raises(ValueError):
+        parse_duration("nope")
+    with pytest.raises(ValueError):
+        parse_duration("")
+
+
+def test_is_local():
+    assert not Config().is_local
+    assert Config(forward_address="http://global:8127").is_local
+
+
+def test_omit_empty_hostname():
+    cfg = read_config(io.StringIO("omit_empty_hostname: true\n"))
+    assert cfg.hostname == ""

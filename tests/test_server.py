@@ -1,0 +1,216 @@
+"""End-to-end server tests over real loopback sockets — the reference's
+testing stance (server_test.go setupVeneurServer + channel sinks)."""
+
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from veneur_tpu.config import Config
+from veneur_tpu.samplers.intermetric import COUNTER, GAUGE, STATUS
+from veneur_tpu.server.factory import new_from_config
+from veneur_tpu.server.server import Server
+from veneur_tpu.sinks.debug import DebugMetricSink
+
+
+def small_config(**kw):
+    """reference server_test.go:72 generateConfig: port 0, short interval."""
+    defaults = dict(
+        interval="10s", hostname="testbox", metric_max_length=4096,
+        read_buffer_size_bytes=2097152, percentiles=[0.5, 0.99],
+        aggregates=["min", "max", "count"],
+        statsd_listen_addresses=["udp://127.0.0.1:0"],
+        tpu_counter_capacity=256, tpu_gauge_capacity=64,
+        tpu_status_capacity=16, tpu_set_capacity=16, tpu_histo_capacity=64,
+        tpu_batch_counter=512, tpu_batch_gauge=128, tpu_batch_status=16,
+        tpu_batch_set=64, tpu_batch_histo=512)
+    defaults.update(kw)
+    return Config(**defaults)
+
+
+@pytest.fixture
+def server():
+    sink = DebugMetricSink()
+    srv = Server(small_config(), metric_sinks=[sink])
+    srv.start()
+    yield srv, sink
+    srv.shutdown()
+
+
+def _send_udp(addr, lines):
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.sendto(b"\n".join(lines), addr)
+    s.close()
+
+
+def _wait_processed(srv, n, timeout=10.0):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        if srv.aggregator.processed + srv.parse_errors >= n:
+            return
+        time.sleep(0.02)
+    raise TimeoutError(
+        f"only {srv.aggregator.processed} processed after {timeout}s")
+
+
+def by_name(metrics):
+    return {m.name: m for m in metrics}
+
+
+def test_udp_ingest_to_flush(server):
+    srv, sink = server
+    addr = srv.local_addr()
+    _send_udp(addr, [
+        b"a.counter:3|c",
+        b"a.counter:2|c",
+        b"a.gauge:7.5|g|#env:prod",
+        b"a.timer:100|ms",
+        b"a.timer:200|ms",
+        b"a.timer:300|ms",
+        b"a.set:user1|s",
+        b"a.set:user2|s",
+        b"a.set:user1|s",
+        b"bad packet!!!",
+    ])
+    _wait_processed(srv, 10)
+    srv.trigger_flush()
+
+    m = by_name(sink.flushed)
+    assert m["a.counter"].value == 5.0
+    assert m["a.counter"].type == COUNTER
+    assert m["a.gauge"].value == 7.5
+    assert m["a.gauge"].tags == ["env:prod"]
+    assert m["a.timer.min"].value == 100.0
+    assert m["a.timer.max"].value == 300.0
+    assert m["a.timer.count"].value == 3.0
+    assert m["a.timer.count"].type == COUNTER
+    # standalone (not local): percentiles emitted
+    assert "a.timer.50percentile" in m
+    assert m["a.set"].value == pytest.approx(2.0, abs=0.1)
+    assert srv.parse_errors == 1
+    # flush resets the interval state
+    sink.flushed.clear()
+    srv.trigger_flush()
+    assert not by_name(sink.flushed)
+
+
+def test_sample_rate_and_magic_tags(server):
+    srv, sink = server
+    addr = srv.local_addr()
+    _send_udp(addr, [
+        b"r.counter:1|c|@0.5",             # counts as 2
+        b"scoped.gauge:4|g|#veneurlocalonly",
+    ])
+    _wait_processed(srv, 2)
+    srv.trigger_flush()
+    m = by_name(sink.flushed)
+    assert m["r.counter"].value == 2.0
+    assert m["scoped.gauge"].value == 4.0
+    assert m["scoped.gauge"].tags == []  # magic tag stripped
+
+
+def test_events_and_service_checks(server):
+    srv, sink = server
+    addr = srv.local_addr()
+    _send_udp(addr, [
+        b"_e{5,5}:hello|world|#env:prod",
+        b"_sc|my.check|1|#env:prod|m:all good",
+    ])
+    _wait_processed(srv, 1)  # service check counts; event goes to buffer
+    t0 = time.time()
+    while not srv.event_samples and time.time() - t0 < 5:
+        time.sleep(0.02)
+    srv.trigger_flush()
+    m = by_name(sink.flushed)
+    assert m["my.check"].type == STATUS
+    assert m["my.check"].value == 1.0
+
+
+def test_local_mode_suppresses_percentiles_and_sets():
+    """flusher.go:61-77: a forwarding (local) instance emits aggregates
+    only for mixed histograms and nothing for sets."""
+    sink = DebugMetricSink()
+    srv = Server(small_config(forward_address="http://global:1"),
+                 metric_sinks=[sink])
+    srv.start()
+    try:
+        _send_udp(srv.local_addr(), [
+            b"h.timer:100|ms", b"h.timer:200|ms",
+            b"s.set:x|s",
+            b"c.global:1|c|#veneurglobalonly",
+            b"l.timer:50|ms|#veneurlocalonly",
+        ])
+        _wait_processed(srv, 4)
+        srv.trigger_flush()
+        m = by_name(sink.flushed)
+        assert "h.timer.min" in m and "h.timer.count" in m
+        assert "h.timer.50percentile" not in m
+        assert "s.set" not in m
+        assert "c.global" not in m       # forwarded, not flushed
+        # local-only timers flush fully, with percentiles
+        assert "l.timer.50percentile" in m
+    finally:
+        srv.shutdown()
+
+
+def test_tcp_listener():
+    sink = DebugMetricSink()
+    srv = Server(small_config(
+        statsd_listen_addresses=["tcp://127.0.0.1:0"]), metric_sinks=[sink])
+    srv.start()
+    try:
+        addr = srv.local_addr()
+        s = socket.create_connection(addr, timeout=5)
+        s.sendall(b"tcp.counter:4|c\ntcp.counter:1|c\n")
+        s.close()
+        _wait_processed(srv, 2)
+        srv.trigger_flush()
+        m = by_name(sink.flushed)
+        assert m["tcp.counter"].value == 5.0
+    finally:
+        srv.shutdown()
+
+
+def test_localfile_plugin(tmp_path):
+    from veneur_tpu.sinks.localfile import LocalFilePlugin
+    out = tmp_path / "flush.tsv"
+    sink = DebugMetricSink()
+    srv = Server(small_config(),
+                 metric_sinks=[sink],
+                 plugins=[LocalFilePlugin(str(out), "testbox", 1)])
+    srv.start()
+    try:
+        _send_udp(srv.local_addr(), [b"f.counter:1|c"])
+        _wait_processed(srv, 1)
+        srv.trigger_flush()
+        data = out.read_text()
+        assert "f.counter" in data
+        assert "testbox" in data
+    finally:
+        srv.shutdown()
+
+
+def test_factory_wiring(tmp_path):
+    cfg = small_config(debug_flushed_metrics=True,
+                       flush_file=str(tmp_path / "x.tsv"))
+    srv = new_from_config(cfg)
+    assert any(s.name == "debug" for s in srv.metric_sinks)
+    assert any(p.name == "localfile" for p in srv.plugins)
+
+
+def test_sink_routing_and_tag_exclusion(server):
+    srv, sink = server
+    sink.set_excluded_tags(["secret"])
+    _send_udp(srv.local_addr(), [
+        b"routed:1|c|#veneursinkonly:datadog",
+        b"plain:1|c|#secret:x,keep:y",
+    ])
+    _wait_processed(srv, 2)
+    srv.trigger_flush()
+    m = by_name(sink.flushed)
+    # debug sink is not 'datadog', so the routed metric must be filtered
+    assert "routed" not in m
+    assert "plain" in m
+    # exclusion applies at sink level
+    assert sink.strip_excluded(m["plain"].tags) == ["keep:y"]

@@ -46,7 +46,7 @@ class SlotMeta:
 
 class _KindTable:
     __slots__ = ("capacity", "n_shards", "per_shard", "by_key", "meta",
-                 "next_free", "dropped")
+                 "by_slot", "next_free", "dropped")
 
     def __init__(self, capacity: int, n_shards: int):
         self.capacity = capacity
@@ -54,6 +54,7 @@ class _KindTable:
         self.per_shard = capacity // n_shards
         self.by_key: dict = {}
         self.meta: list = []          # parallel to allocation order
+        self.by_slot: dict = {}       # slot -> SlotMeta, O(1) mutation
         self.next_free = [0] * n_shards
         self.dropped = 0
 
@@ -69,12 +70,15 @@ class _KindTable:
         self.next_free[shard] = nxt + 1
         slot = shard * self.per_shard + nxt
         self.by_key[key] = slot
-        self.meta.append((slot, make_meta()))
+        m = make_meta()
+        self.meta.append((slot, m))
+        self.by_slot[slot] = m
         return slot
 
     def reset(self):
         self.by_key.clear()
         self.meta.clear()
+        self.by_slot.clear()
         self.next_free = [0] * self.n_shards
 
 
@@ -114,6 +118,9 @@ class KeyTable:
     def get_meta(self, kind: str):
         """[(slot, SlotMeta)] in allocation order for flush labeling."""
         return self.tables[self._table_name(kind)].meta
+
+    def meta_for_slot(self, kind: str, slot: int) -> Optional[SlotMeta]:
+        return self.tables[self._table_name(kind)].by_slot.get(slot)
 
     def dropped(self) -> int:
         return sum(t.dropped for t in self.tables.values())

@@ -1,0 +1,139 @@
+"""veneur-emit: CLI metric/event/service-check emitter and workload
+generator (reference cmd/veneur-emit/main.go). Supports statsd packet
+output over udp/tcp/unix, `-command` subprocess timing, and a `-replay`
+benchmark mode (the traffic generator for BASELINE configs).
+"""
+
+from __future__ import annotations
+
+import argparse
+import random
+import socket
+import subprocess
+import sys
+import time
+
+
+def build_metric_packet(name, value, mtype, rate=1.0, tags=()):
+    parts = [f"{name}:{value}|{mtype}"]
+    if rate != 1.0:
+        parts.append(f"@{rate}")
+    if tags:
+        parts.append("#" + ",".join(tags))
+    return "|".join(parts).encode()
+
+
+def build_event_packet(title, text, tags=(), **fields):
+    """reference cmd/veneur-emit/main.go:650 buildEventPacket. Lengths are
+    BYTE lengths (the parser validates UTF-8 byte counts)."""
+    body = (f"_e{{{len(title.encode())},{len(text.encode())}}}:"
+            f"{title}|{text}")
+    for k, v in fields.items():
+        if v:
+            body += f"|{k}:{v}"
+    if tags:
+        body += "|#" + ",".join(tags)
+    return body.encode()
+
+
+def build_service_check_packet(name, status, tags=(), message=""):
+    """reference cmd/veneur-emit/main.go:715."""
+    body = f"_sc|{name}|{status}"
+    if tags:
+        body += "|#" + ",".join(tags)
+    if message:
+        body += f"|m:{message}"
+    return body.encode()
+
+
+def open_sink(hostport: str):
+    from veneur_tpu.server.server import resolve_addr
+    kind, target = resolve_addr(hostport)
+    if kind == "udp":
+        sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        sock.connect(target)
+    elif kind == "tcp":
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.connect(target)
+    else:
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_DGRAM)
+        sock.connect(target)
+    return kind, sock
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="veneur-emit")
+    ap.add_argument("-hostport", default="udp://127.0.0.1:8126")
+    ap.add_argument("-name", default="")
+    ap.add_argument("-count", type=float, default=None)
+    ap.add_argument("-gauge", type=float, default=None)
+    ap.add_argument("-timing", default=None, help="duration like 3ms")
+    ap.add_argument("-set", dest="set_", default=None)
+    ap.add_argument("-tag", default="", help="comma-separated k:v tags")
+    ap.add_argument("-sample_rate", type=float, default=1.0)
+    ap.add_argument("-event_title", default="")
+    ap.add_argument("-event_text", default="")
+    ap.add_argument("-sc_name", default="")
+    ap.add_argument("-sc_status", type=int, default=0)
+    ap.add_argument("-sc_msg", default="")
+    ap.add_argument("-command", nargs=argparse.REMAINDER, default=None,
+                    help="run command, emit its wall time as a timer")
+    ap.add_argument("-replay", type=int, default=0,
+                    help="benchmark mode: send N random counter packets")
+    ap.add_argument("-replay_names", type=int, default=10000)
+    args = ap.parse_args(argv)
+
+    tags = [t for t in args.tag.split(",") if t]
+    kind, sock = open_sink(args.hostport)
+    nl = b"\n" if kind == "tcp" else b""
+    packets = []
+
+    if args.command:
+        t0 = time.perf_counter()
+        rc = subprocess.call(args.command)
+        ms = (time.perf_counter() - t0) * 1000.0
+        name = args.name or "veneur_emit.command"
+        packets.append(build_metric_packet(
+            name, f"{ms:.3f}", "ms", tags=tags + [f"exit_status:{rc}"]))
+    elif args.replay:
+        rng = random.Random(0)
+        sent = 0
+        t0 = time.perf_counter()
+        while sent < args.replay:
+            n = rng.randrange(args.replay_names)
+            sock.send(build_metric_packet(
+                f"replay.counter.{n}", 1, "c", tags=tags) + nl)
+            sent += 1
+        dt = time.perf_counter() - t0
+        print(f"sent {sent} packets in {dt:.3f}s ({sent / dt:.0f}/s)")
+        return 0
+    else:
+        if args.count is not None:
+            packets.append(build_metric_packet(
+                args.name, args.count, "c", args.sample_rate, tags))
+        if args.gauge is not None:
+            packets.append(build_metric_packet(
+                args.name, args.gauge, "g", tags=tags))
+        if args.timing is not None:
+            from veneur_tpu.config import parse_duration
+            ms = parse_duration(args.timing) * 1000.0
+            packets.append(build_metric_packet(
+                args.name, f"{ms:.3f}", "ms", args.sample_rate, tags))
+        if args.set_ is not None:
+            packets.append(build_metric_packet(
+                args.name, args.set_, "s", tags=tags))
+        if args.event_title:
+            packets.append(build_event_packet(
+                args.event_title, args.event_text, tags))
+        if args.sc_name:
+            packets.append(build_service_check_packet(
+                args.sc_name, args.sc_status, tags, args.sc_msg))
+
+    for p in packets:
+        sock.send(p + nl)
+    sock.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,299 @@
+"""YAML configuration with reference-compatible semantics.
+
+Mirrors the reference's config surface (reference config.go:3-122, 115 yaml
+keys) and parse pipeline (reference config_parse.go:100-148): strict-then-
+loose YAML unmarshal that *warns* about unknown keys instead of failing,
+``VENEUR_*`` environment-variable overrides (envconfig semantics: the env
+var name is VENEUR_ + fieldname uppercased, underscores removed from the
+yaml key's words — we use VENEUR_<YAML_KEY_UPPERCASED> which is what
+envconfig produces for these field names), then defaults
+(config_parse.go:150-230).
+
+TPU additions (the `aggregation_backend: tpu` surface promised by
+BASELINE.json's north star): table capacities, staging batch sizes, and the
+(replica, shard) mesh shape.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import re
+from typing import List, Optional
+
+import yaml
+
+log = logging.getLogger("veneur_tpu.config")
+
+
+class UnknownConfigKeys(Warning):
+    """Raised-as-warning analogue of reference config_parse.go:88
+    UnknownConfigKeys: config parsed fine but contains unrecognized keys."""
+
+    def __init__(self, keys):
+        self.keys = sorted(keys)
+        super().__init__(f"unknown config keys: {', '.join(self.keys)}")
+
+
+_DURATION_RE = re.compile(r"(\d+(?:\.\d+)?)(ns|us|µs|ms|s|m|h)")
+_DURATION_UNITS = {"ns": 1e-9, "us": 1e-6, "µs": 1e-6, "ms": 1e-3,
+                   "s": 1.0, "m": 60.0, "h": 3600.0}
+
+
+def parse_duration(s: str) -> float:
+    """Go time.ParseDuration subset → seconds (reference config_parse.go:229
+    ParseInterval)."""
+    if not s:
+        raise ValueError("empty duration")
+    matches = list(_DURATION_RE.finditer(s))
+    if not matches or "".join(m.group(0) for m in matches) != s:
+        raise ValueError(f"invalid duration {s!r}")
+    return sum(float(m.group(1)) * _DURATION_UNITS[m.group(2)]
+               for m in matches)
+
+
+@dataclasses.dataclass
+class Config:
+    """One server process's configuration (reference config.go Config).
+
+    Keys the TPU build does not (yet) act on are still parsed and carried so
+    existing reference YAML files load cleanly; sinks/features gate on them
+    being non-empty exactly like reference server.go:472-678.
+    """
+    # core pipeline
+    aggregates: List[str] = dataclasses.field(default_factory=list)
+    interval: str = ""
+    synchronize_with_interval: bool = False
+    metric_max_length: int = 0
+    trace_max_length_bytes: int = 0
+    read_buffer_size_bytes: int = 0
+    num_workers: int = 1
+    num_readers: int = 1
+    num_span_workers: int = 1
+    span_channel_capacity: int = 0
+    percentiles: List[float] = dataclasses.field(default_factory=list)
+    count_unique_timeseries: bool = False
+    hostname: str = ""
+    omit_empty_hostname: bool = False
+    tags: List[str] = dataclasses.field(default_factory=list)
+    tags_exclude: List[str] = dataclasses.field(default_factory=list)
+    mutex_profile_fraction: int = 0
+    block_profile_rate: int = 0
+    sentry_dsn: str = ""
+    stats_address: str = ""
+    veneur_metrics_additional_tags: List[str] = dataclasses.field(
+        default_factory=list)
+    veneur_metrics_scopes: dict = dataclasses.field(default_factory=dict)
+
+    # listeners
+    statsd_listen_addresses: List[str] = dataclasses.field(
+        default_factory=list)
+    ssf_listen_addresses: List[str] = dataclasses.field(default_factory=list)
+    http_address: str = ""
+    grpc_address: str = ""
+    http_quit: bool = False
+    tls_key: str = ""
+    tls_certificate: str = ""
+    tls_authority_certificate: str = ""
+
+    # forwarding / distributed tier
+    forward_address: str = ""
+    forward_use_grpc: bool = False
+    flush_max_per_body: int = 0
+    flush_file: str = ""
+    flush_watchdog_missed_flushes: int = 0
+
+    # debug
+    debug: bool = False
+    debug_flushed_metrics: bool = False
+    debug_ingested_spans: bool = False
+    enable_profiling: bool = False
+
+    # datadog sink
+    datadog_api_key: str = ""
+    datadog_api_hostname: str = ""
+    datadog_flush_max_per_body: int = 0
+    datadog_metric_name_prefix_drops: List[str] = dataclasses.field(
+        default_factory=list)
+    datadog_exclude_tags_prefix_by_prefix_metric: dict = dataclasses.field(
+        default_factory=dict)
+    datadog_span_buffer_size: int = 0
+    datadog_trace_api_address: str = ""
+
+    # other sinks (parsed; gated on non-empty like the reference)
+    signalfx_api_key: str = ""
+    signalfx_endpoint_base: str = ""
+    signalfx_endpoint_api: str = ""
+    signalfx_hostname_tag: str = ""
+    signalfx_flush_max_per_body: int = 0
+    signalfx_vary_key_by: str = ""
+    signalfx_per_tag_api_keys: List[dict] = dataclasses.field(
+        default_factory=list)
+    signalfx_dynamic_per_tag_api_keys_enable: bool = False
+    signalfx_dynamic_per_tag_api_keys_refresh_period: str = ""
+    signalfx_metric_name_prefix_drops: List[str] = dataclasses.field(
+        default_factory=list)
+    signalfx_metric_tag_prefix_drops: List[str] = dataclasses.field(
+        default_factory=list)
+    kafka_broker: str = ""
+    kafka_metric_topic: str = ""
+    kafka_span_topic: str = ""
+    kafka_check_topic: str = ""
+    kafka_event_topic: str = ""
+    kafka_partitioner: str = ""
+    kafka_metric_require_acks: str = ""
+    kafka_span_require_acks: str = ""
+    kafka_retry_max: int = 0
+    kafka_metric_buffer_bytes: int = 0
+    kafka_metric_buffer_messages: int = 0
+    kafka_metric_buffer_frequency: str = ""
+    kafka_span_buffer_bytes: int = 0
+    kafka_span_buffer_mesages: int = 0  # sic — reference config.go typo kept
+    kafka_span_buffer_frequency: str = ""
+    kafka_span_serialization_format: str = ""
+    kafka_span_sample_rate_percent: int = 0
+    kafka_span_sample_tag: str = ""
+    splunk_hec_address: str = ""
+    splunk_hec_token: str = ""
+    splunk_hec_batch_size: int = 0
+    splunk_hec_submission_workers: int = 0
+    splunk_hec_tls_validate_hostname: str = ""
+    splunk_hec_send_timeout: str = ""
+    splunk_hec_ingest_timeout: str = ""
+    splunk_hec_max_connection_lifetime: str = ""
+    splunk_hec_connection_lifetime_jitter: str = ""
+    splunk_span_sample_rate: int = 0
+    lightstep_access_token: str = ""
+    lightstep_collector_host: str = ""
+    lightstep_reconnect_period: str = ""
+    lightstep_maximum_spans: int = 0
+    lightstep_num_clients: int = 0
+    xray_address: str = ""
+    xray_annotation_tags: List[str] = dataclasses.field(default_factory=list)
+    xray_sample_percentage: float = 0.0
+    falconer_address: str = ""
+    grpsink_address: str = ""
+
+    # span pipeline
+    indicator_span_timer_name: str = ""
+    objective_span_timer_name: str = ""
+    ssf_buffer_size: int = 0
+
+    # plugins
+    aws_access_key_id: str = ""
+    aws_secret_access_key: str = ""
+    aws_region: str = ""
+    aws_s3_bucket: str = ""
+    metric_prefix: str = ""
+
+    # set by read_config: yaml keys that matched no field (strict-validate
+    # callers fail on these; reference UnknownConfigKeys)
+    unknown_keys: List[str] = dataclasses.field(default_factory=list)
+
+    # TPU aggregation backend (this framework's addition)
+    aggregation_backend: str = "tpu"
+    tpu_counter_capacity: int = 1 << 17
+    tpu_gauge_capacity: int = 1 << 15
+    tpu_status_capacity: int = 1 << 10
+    tpu_set_capacity: int = 1 << 12
+    tpu_histo_capacity: int = 1 << 14
+    tpu_batch_counter: int = 8192
+    tpu_batch_gauge: int = 2048
+    tpu_batch_status: int = 256
+    tpu_batch_set: int = 4096
+    tpu_batch_histo: int = 8192
+    tpu_n_shards: int = 0      # 0 = one shard per local device
+    tpu_n_replicas: int = 1
+    tpu_compact_every: int = 32
+    tpu_fold_every: int = 64
+
+    def parse_interval(self) -> float:
+        return parse_duration(self.interval)
+
+    @property
+    def is_local(self) -> bool:
+        """Local ⇔ forwards to a global tier (reference server.go:1434)."""
+        return self.forward_address != ""
+
+
+_DEFAULTS = {
+    "aggregates": ["min", "max", "count"],
+    "interval": "10s",
+    "metric_max_length": 4096,
+    "read_buffer_size_bytes": 2 * 1048576,
+    "span_channel_capacity": 100,
+    "splunk_hec_batch_size": 100,
+    "splunk_hec_max_connection_lifetime": "10s",
+    "datadog_flush_max_per_body": 25000,
+    "percentiles": [0.5, 0.75, 0.99],
+}
+
+_FIELDS = {f.name: f for f in dataclasses.fields(Config)}
+
+
+def _coerce(field: dataclasses.Field, raw: str):
+    # resolve the runtime type from the default factory / default value
+    if field.default_factory is not dataclasses.MISSING:  # type: ignore
+        proto = field.default_factory()  # type: ignore
+    else:
+        proto = field.default
+    if isinstance(proto, bool):
+        return raw.lower() in ("1", "true", "yes", "on")
+    if isinstance(proto, int):
+        return int(raw)
+    if isinstance(proto, float):
+        return float(raw)
+    if isinstance(proto, list):
+        return [s for s in (x.strip() for x in raw.split(",")) if s]
+    if isinstance(proto, dict):
+        return yaml.safe_load(raw)
+    return raw
+
+
+def read_config(path_or_file, env: Optional[dict] = None,
+                proxy: bool = False) -> Config:
+    """YAML → Config with unknown-key warning, env override, defaults
+    (reference config_parse.go:100 ReadConfig)."""
+    if hasattr(path_or_file, "read"):
+        data = yaml.safe_load(path_or_file.read()) or {}
+    else:
+        with open(path_or_file) as f:
+            data = yaml.safe_load(f) or {}
+    if not isinstance(data, dict):
+        raise ValueError("config root must be a mapping")
+
+    cfg = Config()
+    unknown = []
+    for k, v in data.items():
+        if k in _FIELDS:
+            if v is not None:
+                setattr(cfg, k, v)
+        else:
+            unknown.append(k)
+    cfg.unknown_keys = sorted(unknown)
+    if unknown:
+        # reference behavior: usable config, warn loudly; strict callers
+        # check cfg.unknown_keys and fail (config_parse.go:113
+        # unmarshalSemiStrictly returning UnknownConfigKeys)
+        log.warning(str(UnknownConfigKeys(unknown)))
+
+    env = os.environ if env is None else env
+    prefix = "VENEUR_"
+    for name, field in _FIELDS.items():
+        var = prefix + name.upper().replace("_", "")
+        # envconfig checks both the squashed and underscored forms
+        for candidate in (var, prefix + name.upper()):
+            if candidate in env:
+                setattr(cfg, name, _coerce(field, env[candidate]))
+                break
+
+    for k, v in _DEFAULTS.items():
+        cur = getattr(cfg, k)
+        if cur == _FIELDS[k].default or (
+                isinstance(cur, list) and not cur) or cur in ("", 0):
+            setattr(cfg, k, v)
+    if not cfg.hostname and not cfg.omit_empty_hostname:
+        import socket
+        cfg.hostname = socket.gethostname()
+    return cfg

@@ -1,0 +1,42 @@
+"""InterMetric — the post-aggregation metric record handed to sinks.
+
+Mirrors reference samplers/samplers.go:48-127: InterMetric{Name, Timestamp,
+Value, Tags, Type, Message, HostName, Sinks}, metric types counter/gauge/
+status, and the `veneursinkonly:<name>` routing tag semantics
+(RouteInformation, samplers.go:33-44, 110-127).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+COUNTER = "counter"
+GAUGE = "gauge"
+STATUS = "status"
+
+SINK_ONLY_TAG_PREFIX = "veneursinkonly:"
+
+
+@dataclasses.dataclass
+class InterMetric:
+    name: str
+    timestamp: int
+    value: float
+    tags: List[str]
+    type: str
+    message: str = ""
+    hostname: str = ""
+    sinks: Optional[frozenset] = None  # None = route to every sink
+
+    def is_acceptable_to(self, sink_name: str) -> bool:
+        """reference sinks/sinks.go:51 IsAcceptableMetric."""
+        return self.sinks is None or sink_name in self.sinks
+
+
+def route_info(tags) -> Optional[frozenset]:
+    """Extract `veneursinkonly:` destinations from a tag list
+    (reference samplers/samplers.go:110-127 routeInfo)."""
+    dests = frozenset(t[len(SINK_ONLY_TAG_PREFIX):] for t in tags
+                      if t.startswith(SINK_ONLY_TAG_PREFIX))
+    return dests or None

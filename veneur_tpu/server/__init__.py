@@ -1,0 +1,1 @@
+from veneur_tpu.server.server import Server  # noqa: F401

@@ -1,0 +1,98 @@
+"""Single-process aggregation backend: key table + batcher + device state.
+
+The glue between parsed UDPMetrics and the jitted ingest step — the role of
+the reference's Worker goroutines (worker.go:265 Work / :344 ProcessMetric),
+with N workers replaced by one device table (logical shards assigned by
+digest, host.py). Flush performs the map-swap double-buffering of
+worker.go:498: the live table/state are detached and replaced, then the
+flush math runs on the detached state while new samples accumulate.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from veneur_tpu.aggregation.host import Batcher, BatchSpec, KeyTable
+from veneur_tpu.aggregation.state import TableSpec, empty_state
+from veneur_tpu.aggregation.step import (
+    compact, flush_compute, fold_scalars, ingest_step)
+from veneur_tpu.samplers import parser
+from veneur_tpu.samplers.parser import UDPMetric
+
+
+class Aggregator:
+    def __init__(self, spec: TableSpec, bspec: BatchSpec = BatchSpec(),
+                 n_shards: int = 1, compact_every: int = 32,
+                 fold_every: int = 64):
+        self.spec = spec
+        self.bspec = bspec
+        self.n_shards = n_shards
+        self.compact_every = compact_every
+        self.fold_every = fold_every
+        self.table = KeyTable(spec, n_shards)
+        self.batcher = Batcher(spec, bspec, on_batch=self._on_batch)
+        self.state = empty_state(spec)
+        self._steps = 0
+        # stats (reference self-telemetry counters)
+        self.processed = 0
+        self.dropped_capacity = 0
+
+    # -- ingest -------------------------------------------------------------
+    def _on_batch(self, batch):
+        self.state = ingest_step(self.state, batch, spec=self.spec)
+        self._steps += 1
+        if self._steps % self.compact_every == 0:
+            self.state = compact(self.state, spec=self.spec)
+        if self._steps % self.fold_every == 0:
+            self.state = fold_scalars(self.state)
+
+    def process_metric(self, m: UDPMetric) -> None:
+        """reference worker.go:344 ProcessMetric: switch on type+scope,
+        upsert, sample."""
+        kind = m.type
+        slot = self.table.slot_for(kind, m.name, m.tags, m.scope, m.digest,
+                                   hostname=m.hostname)
+        if slot is None:
+            self.dropped_capacity += 1
+            return
+        if kind == "counter":
+            self.batcher.add_counter(slot, float(m.value), m.sample_rate)
+        elif kind == "gauge":
+            self.batcher.add_gauge(slot, float(m.value))
+        elif kind == "status":
+            self.batcher.add_status(slot, float(m.value))
+            # keep the latest message on the slot metadata (O(1);
+            # reference StatusCheck.Sample keeps last message,
+            # samplers.go:312)
+            mt = self.table.meta_for_slot("status", slot)
+            if mt is not None:
+                mt.message = m.message
+        elif kind == "set":
+            member = m.value if isinstance(m.value, bytes) else str(
+                m.value).encode()
+            self.batcher.add_set(slot, member)
+        elif kind in ("histogram", "timer"):
+            self.batcher.add_histo(slot, float(m.value), m.sample_rate)
+        self.processed += 1
+
+    # -- flush --------------------------------------------------------------
+    def flush(self, percentiles: List[float]
+              ) -> Tuple[Dict[str, np.ndarray], KeyTable]:
+        """Map-swap (worker.go:498): detach live state+table, reset fresh,
+        then run the flush computation on the detached interval."""
+        import jax.numpy as jnp
+
+        self.batcher.emit()
+        state, table = self.state, self.table
+        self.state = empty_state(self.spec)
+        self.table = KeyTable(self.spec, self.n_shards)
+        self._steps = 0
+
+        state = fold_scalars(state)
+        state = compact(state, spec=self.spec)
+        qs = jnp.asarray(percentiles or [0.5], jnp.float32)
+        out = flush_compute(state, qs, spec=self.spec)
+        return {k: np.asarray(v) for k, v in out.items()}, table

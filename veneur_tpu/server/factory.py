@@ -1,0 +1,53 @@
+"""Server construction from config — sink/plugin wiring.
+
+Mirrors reference server.go:261 NewFromConfig's gating: each sink exists iff
+its config keys are set (server.go:472-678), plugins registered from
+flush_file / aws_* (server.go:683-731).
+"""
+
+from __future__ import annotations
+
+from veneur_tpu.config import Config
+from veneur_tpu.server.server import Server
+
+
+def new_from_config(cfg: Config, extra_metric_sinks=(), extra_span_sinks=(),
+                    extra_plugins=()) -> Server:
+    metric_sinks = list(extra_metric_sinks)
+    span_sinks = list(extra_span_sinks)
+    plugins = list(extra_plugins)
+
+    if cfg.debug_flushed_metrics:
+        from veneur_tpu.sinks.debug import DebugMetricSink
+        metric_sinks.append(DebugMetricSink())
+    if cfg.debug_ingested_spans:
+        from veneur_tpu.sinks.debug import DebugSpanSink
+        span_sinks.append(DebugSpanSink())
+    if cfg.datadog_api_key and cfg.datadog_api_hostname:
+        from veneur_tpu.sinks.datadog import DatadogMetricSink
+        metric_sinks.append(DatadogMetricSink(
+            api_key=cfg.datadog_api_key,
+            hostname=cfg.hostname,
+            api_url=cfg.datadog_api_hostname,
+            interval_s=cfg.parse_interval(),
+            flush_max_per_body=cfg.datadog_flush_max_per_body,
+            tags=cfg.tags,
+            metric_name_prefix_drops=cfg.datadog_metric_name_prefix_drops,
+            exclude_tags_prefix_by_prefix_metric=(
+                cfg.datadog_exclude_tags_prefix_by_prefix_metric)))
+    if cfg.flush_file:
+        from veneur_tpu.sinks.localfile import LocalFilePlugin
+        plugins.append(LocalFilePlugin(
+            cfg.flush_file, cfg.hostname,
+            interval_s=int(cfg.parse_interval())))
+    if cfg.aws_s3_bucket and cfg.aws_region:
+        from veneur_tpu.plugins.s3 import S3Plugin
+        plugins.append(S3Plugin(
+            bucket=cfg.aws_s3_bucket, region=cfg.aws_region,
+            access_key_id=cfg.aws_access_key_id,
+            secret_access_key=cfg.aws_secret_access_key,
+            hostname=cfg.hostname,
+            interval_s=int(cfg.parse_interval())))
+
+    return Server(cfg, metric_sinks=metric_sinks, span_sinks=span_sinks,
+                  plugins=plugins)

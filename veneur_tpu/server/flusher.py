@@ -1,0 +1,115 @@
+"""Turn device flush arrays + host slot metadata into InterMetrics.
+
+This is the reference's generateInterMetrics (flusher.go:225-298) plus the
+per-sampler Flush methods (samplers/samplers.go:147/230/319/392/511-675),
+driven by the scope rules of flusher.go:61-77:
+
+- local instance (forwarding configured): mixed histograms/timers emit
+  aggregates only (percentiles=nil); global-scoped metrics and sets emit
+  nothing locally (their sketch state is forwarded); local-only
+  histograms/timers flush fully, with percentiles.
+- global / standalone instance: everything flushes; global-scoped
+  histograms emit aggregates from the digest (the reference's global=true
+  Flush path), mixed ones from their local scalars.
+
+One deliberate deviation, documented: the reference keeps separate sampler
+objects for direct vs imported mixed-scope histograms' local scalars; our
+device table has one (min, max, count, sum) row per key, so on a standalone
+global instance that both ingests a key directly and imports it, aggregates
+include the imported mass (strictly more accurate; percentiles identical).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List
+
+import numpy as np
+
+from veneur_tpu.aggregation.host import (
+    KeyTable, SCOPE_GLOBAL, SCOPE_LOCAL)
+from veneur_tpu.samplers.intermetric import (
+    COUNTER, GAUGE, STATUS, InterMetric, route_info)
+
+# aggregate name -> (flush-dict key, metric type)
+AGGREGATE_FIELDS = {
+    "min": ("histo_min", GAUGE),
+    "max": ("histo_max", GAUGE),
+    "median": ("histo_median", GAUGE),
+    "avg": ("histo_avg", GAUGE),
+    "count": ("histo_count", COUNTER),
+    "sum": ("histo_sum", GAUGE),
+    "hmean": ("histo_hmean", GAUGE),
+}
+
+
+def percentile_name(p: float) -> str:
+    """reference samplers.go:664: `%s.%dpercentile` with int(p*100)."""
+    return f"{int(p * 100)}percentile"
+
+
+def generate_intermetrics(flush: Dict[str, np.ndarray], table: KeyTable,
+                          *, percentiles: List[float], aggregates: List[str],
+                          is_local: bool, timestamp: int,
+                          hostname: str = "") -> List[InterMetric]:
+    out: List[InterMetric] = []
+    perc = list(percentiles)
+
+    def emit(meta, name, value, mtype, message=""):
+        out.append(InterMetric(
+            name=name, timestamp=timestamp, value=float(value),
+            tags=list(meta.tags), type=mtype, message=message,
+            hostname=meta.hostname or hostname,
+            sinks=route_info(meta.tags)))
+
+    counters = flush["counter"]
+    for slot, meta in table.get_meta("counter"):
+        if is_local and meta.scope == SCOPE_GLOBAL:
+            continue  # forwarded, not flushed (flusher.go:274-287)
+        emit(meta, meta.name, counters[slot], COUNTER)
+
+    gauges = flush["gauge"]
+    for slot, meta in table.get_meta("gauge"):
+        if is_local and meta.scope == SCOPE_GLOBAL:
+            continue
+        emit(meta, meta.name, gauges[slot], GAUGE)
+
+    status = flush["status"]
+    for slot, meta in table.get_meta("status"):
+        emit(meta, meta.name, status[slot], STATUS, message=meta.message)
+
+    sets = flush["set_estimate"]
+    for slot, meta in table.get_meta("set"):
+        # sets have no local part (flusher.go:277-280): local instances
+        # forward the HLL and emit nothing unless the set is local-only
+        if is_local and meta.scope != SCOPE_LOCAL:
+            continue
+        emit(meta, meta.name, sets[slot], GAUGE)
+
+    hq = flush["histo_quantiles"]
+    hcount = flush["histo_count"]
+    agg_arrays = {a: flush[AGGREGATE_FIELDS[a][0]] for a in aggregates
+                  if a in AGGREGATE_FIELDS}
+    for slot, meta in table.get_meta("histogram"):
+        if is_local and meta.scope == SCOPE_GLOBAL:
+            continue
+        global_flush = meta.scope == SCOPE_GLOBAL and not is_local
+        sampled = hcount[slot] > 0
+        # aggregates: suppressed when nothing was sampled locally unless this
+        # is the global=true path (samplers.go:530-655 guard clauses)
+        if sampled or global_flush:
+            for agg, arr in agg_arrays.items():
+                v = arr[slot]
+                if agg in ("min", "max") and not math.isfinite(v):
+                    continue
+                if agg in ("avg", "sum", "hmean", "count") and not sampled:
+                    continue
+                emit(meta, f"{meta.name}.{agg}", v,
+                     AGGREGATE_FIELDS[agg][1])
+        # percentiles: only where they are globally accurate — everywhere on
+        # a global/standalone instance, local-only keys on a local one
+        if perc and (not is_local or meta.scope == SCOPE_LOCAL) and sampled:
+            for i, p in enumerate(perc):
+                emit(meta, f"{meta.name}.{percentile_name(p)}",
+                     hq[slot, i], GAUGE)
+    return out

@@ -1,0 +1,398 @@
+"""The server daemon: listeners → parse → aggregate → flush → sinks.
+
+Maps the reference's Server (server.go:83 struct, :771 Start, :1303 Serve):
+
+- UDP/TCP statsd listeners with SO_REUSEPORT reader sharding
+  (networking.go:19 StartStatsd, socket_linux.go:26).
+- HandleMetricPacket prefix dispatch: `_e{` → event, `_sc` → service
+  check, else metric (server.go:939-988).
+- One pipeline thread owning the device table (the N worker goroutines of
+  worker.go collapse into one jitted scatter program; logical shards are
+  slot ranges).
+- Flush ticker with per-flush deadline and the crash-only FlushWatchdog
+  (server.go:853-890, :900-935).
+- Sinks flushed in parallel threads with a WaitGroup-equivalent barrier,
+  then plugins (flusher.go:105-131).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import queue
+import socket
+import ssl
+import threading
+import time
+from typing import List, Optional
+
+from veneur_tpu.aggregation.host import BatchSpec
+from veneur_tpu.aggregation.state import TableSpec
+from veneur_tpu.config import Config
+from veneur_tpu.samplers import parser
+from veneur_tpu.samplers.intermetric import InterMetric
+from veneur_tpu.server.aggregator import Aggregator
+from veneur_tpu.server.flusher import generate_intermetrics
+
+log = logging.getLogger("veneur_tpu.server")
+
+_FLUSH = object()   # pipeline-queue sentinel: run a flush now
+_STOP = object()    # pipeline-queue sentinel: drain and exit
+
+
+def resolve_addr(addr: str):
+    """reference protocol/addr.go:18 ResolveAddr: scheme://host:port with
+    schemes udp/tcp/unix(gram)."""
+    from urllib.parse import urlparse
+    u = urlparse(addr)
+    port = u.port if u.port is not None else 8126
+    if u.scheme in ("udp", "udp4", "udp6"):
+        return ("udp", (u.hostname or "127.0.0.1", port))
+    if u.scheme in ("tcp", "tcp4", "tcp6"):
+        return ("tcp", (u.hostname or "127.0.0.1", port))
+    if u.scheme in ("unix", "unixgram"):
+        return (u.scheme, u.path)
+    raise ValueError(f"unsupported listener scheme in {addr!r}")
+
+
+def spec_from_config(cfg: Config) -> TableSpec:
+    return TableSpec(
+        counter_capacity=cfg.tpu_counter_capacity,
+        gauge_capacity=cfg.tpu_gauge_capacity,
+        status_capacity=cfg.tpu_status_capacity,
+        set_capacity=cfg.tpu_set_capacity,
+        histo_capacity=cfg.tpu_histo_capacity)
+
+
+class Server:
+    def __init__(self, cfg: Config, metric_sinks: Optional[List] = None,
+                 span_sinks: Optional[List] = None,
+                 plugins: Optional[List] = None):
+        self.cfg = cfg
+        self.interval = cfg.parse_interval()
+        self.hostname = cfg.hostname
+        self.tags = list(cfg.tags)
+        self.aggregator = Aggregator(
+            spec_from_config(cfg),
+            BatchSpec(counter=cfg.tpu_batch_counter,
+                      gauge=cfg.tpu_batch_gauge,
+                      status=cfg.tpu_batch_status,
+                      set=cfg.tpu_batch_set,
+                      histo=cfg.tpu_batch_histo),
+            n_shards=max(1, cfg.tpu_n_shards) if cfg.tpu_n_shards else 1,
+            compact_every=cfg.tpu_compact_every,
+            fold_every=cfg.tpu_fold_every)
+        self.metric_sinks = list(metric_sinks or [])
+        self.span_sinks = list(span_sinks or [])
+        self.plugins = list(plugins or [])
+        self._wire_excluded_tags()
+
+        self.event_samples = []       # EventWorker buffer (worker.go:527)
+        self._event_lock = threading.Lock()
+        self.packet_queue: "queue.Queue" = queue.Queue(maxsize=4096)
+        self.last_flush = time.time()
+        self.flush_count = 0
+        self.parse_errors = 0
+        self.packets_received = 0
+        self._shutdown = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._sockets: List[socket.socket] = []
+        self._flush_done = threading.Condition()
+
+    # -- tag exclusion wiring (server.go:1467-1510) -------------------------
+    def _wire_excluded_tags(self):
+        base: List[str] = []
+        per_sink: dict = {}
+        for entry in self.cfg.tags_exclude:
+            parts = entry.split("|")
+            if len(parts) == 1:
+                base.append(entry)
+            else:
+                for sink_name in parts[1:]:
+                    per_sink.setdefault(sink_name, []).append(parts[0])
+        for sink in self.metric_sinks:
+            sink.set_excluded_tags(base + per_sink.get(sink.name, []))
+
+    # -- ingest path --------------------------------------------------------
+    def handle_metric_packet(self, packet: bytes) -> None:
+        """reference server.go:939 HandleMetricPacket."""
+        if not packet:
+            return
+        try:
+            if packet.startswith(b"_e{"):
+                sample = parser.parse_event(packet)
+                with self._event_lock:
+                    self.event_samples.append(sample)
+            elif packet.startswith(b"_sc"):
+                m = parser.parse_service_check(packet)
+                self.aggregator.process_metric(m)
+            else:
+                m = parser.parse_metric(packet)
+                self.aggregator.process_metric(m)
+        except parser.ParseError as e:
+            self.parse_errors += 1
+            log.debug("bad packet %r: %s", packet[:64], e)
+
+    def _process_packets(self, data: bytes) -> None:
+        """reference server.go:1081 processMetricPacket + SplitBytes."""
+        for line in data.split(b"\n"):
+            if line:
+                self.handle_metric_packet(line)
+
+    def _pipeline_loop(self):
+        """The single device-owning thread (all worker goroutines in one)."""
+        while True:
+            item = self.packet_queue.get()
+            if item is _STOP:
+                return
+            if item is _FLUSH:
+                try:
+                    self._do_flush()
+                finally:
+                    with self._flush_done:
+                        self.flush_count += 1
+                        self._flush_done.notify_all()
+                continue
+            self._process_packets(item)
+
+    # -- listeners ----------------------------------------------------------
+    def _udp_reader(self, sock: socket.socket):
+        bufsize = max(self.cfg.metric_max_length, 65536)
+        sock.settimeout(0.5)  # lets readers observe shutdown and release fd
+        while not self._shutdown.is_set():
+            try:
+                data = sock.recv(bufsize)
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            self.packets_received += 1
+            try:
+                self.packet_queue.put(data, timeout=1.0)
+            except queue.Full:
+                pass  # drop like a kernel would; counted upstream
+
+    def _tcp_listener(self, sock: socket.socket, tls_ctx):
+        """reference server.go:1283 ReadTCPSocket: newline-delimited metrics
+        over stream conns, optional TLS with client-cert auth."""
+        sock.settimeout(0.5)
+        while not self._shutdown.is_set():
+            try:
+                conn, _ = sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            conn.settimeout(5.0)
+            if tls_ctx is not None:
+                try:
+                    conn = tls_ctx.wrap_socket(conn, server_side=True)
+                except ssl.SSLError as e:
+                    log.warning("TLS handshake failed: %s", e)
+                    continue
+            t = threading.Thread(target=self._tcp_conn, args=(conn,),
+                                 daemon=True)
+            t.start()
+
+    def _tcp_conn(self, conn):
+        buf = b""
+        limit = self.cfg.metric_max_length
+        with conn:
+            while not self._shutdown.is_set():
+                try:
+                    data = conn.recv(65536)
+                except socket.timeout:
+                    continue  # idle conns stay open (server.go ReadTCPSocket)
+                except OSError:
+                    return
+                if not data:
+                    break
+                buf += data
+                *lines, buf = buf.split(b"\n")
+                for line in lines:
+                    if len(line) > limit:
+                        self.parse_errors += 1
+                        continue
+                    if line:
+                        self.packet_queue.put(line)
+                if len(buf) > limit:  # oversized line w/o newline: drop conn
+                    self.parse_errors += 1
+                    return
+
+    def _tls_context(self):
+        if not (self.cfg.tls_key and self.cfg.tls_certificate):
+            return None
+        import tempfile
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        cert = key = None
+        try:
+            cert = self._write_temp(tempfile, self.cfg.tls_certificate)
+            key = self._write_temp(tempfile, self.cfg.tls_key)
+            ctx.load_cert_chain(cert, key)
+        finally:
+            # never leave key material on disk
+            for path in (cert, key):
+                if path:
+                    try:
+                        os.unlink(path)
+                    except OSError:
+                        pass
+        if self.cfg.tls_authority_certificate:
+            ctx.load_verify_locations(
+                cadata=self.cfg.tls_authority_certificate)
+            ctx.verify_mode = ssl.CERT_REQUIRED
+        return ctx
+
+    @staticmethod
+    def _write_temp(tempfile, pem: str) -> str:
+        f = tempfile.NamedTemporaryFile("w", suffix=".pem", delete=False)
+        f.write(pem)
+        f.close()
+        return f.name
+
+    def start(self):
+        """reference server.go:771 Start + networking.go:19 StartStatsd."""
+        for sink in self.metric_sinks + self.span_sinks:
+            sink.start()
+        t = threading.Thread(target=self._pipeline_loop, daemon=True,
+                             name="pipeline")
+        t.start()
+        self._threads.append(t)
+
+        for addr in self.cfg.statsd_listen_addresses:
+            kind, target = resolve_addr(addr)
+            if kind == "udp":
+                for _ in range(max(1, self.cfg.num_readers)):
+                    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+                    if self.cfg.num_readers > 1 and hasattr(
+                            socket, "SO_REUSEPORT"):
+                        sock.setsockopt(socket.SOL_SOCKET,
+                                        socket.SO_REUSEPORT, 1)
+                    sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF,
+                                    self.cfg.read_buffer_size_bytes)
+                    sock.bind(target)
+                    self._sockets.append(sock)
+                    rt = threading.Thread(target=self._udp_reader,
+                                          args=(sock,), daemon=True)
+                    rt.start()
+                    self._threads.append(rt)
+            elif kind == "tcp":
+                sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+                sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+                sock.bind(target)
+                sock.listen(128)
+                self._sockets.append(sock)
+                lt = threading.Thread(target=self._tcp_listener,
+                                      args=(sock, self._tls_context()),
+                                      daemon=True)
+                lt.start()
+                self._threads.append(lt)
+            elif kind in ("unix", "unixgram"):
+                sock = socket.socket(socket.AF_UNIX, socket.SOCK_DGRAM)
+                if os.path.exists(target):
+                    os.unlink(target)
+                sock.bind(target)
+                self._sockets.append(sock)
+                rt = threading.Thread(target=self._udp_reader, args=(sock,),
+                                      daemon=True)
+                rt.start()
+                self._threads.append(rt)
+
+        ft = threading.Thread(target=self._flush_ticker, daemon=True,
+                              name="flush-ticker")
+        ft.start()
+        self._threads.append(ft)
+
+        if self.cfg.flush_watchdog_missed_flushes > 0:
+            wt = threading.Thread(target=self._watchdog, daemon=True,
+                                  name="flush-watchdog")
+            wt.start()
+            self._threads.append(wt)
+
+    def local_addr(self, index: int = 0):
+        return self._sockets[index].getsockname()
+
+    # -- flush orchestration ------------------------------------------------
+    def _flush_ticker(self):
+        while not self._shutdown.wait(self.interval):
+            self.trigger_flush(wait=False)
+
+    def trigger_flush(self, wait: bool = True):
+        """Enqueue a flush on the pipeline thread (the ticker of
+        server.go:853-890). With wait=True, blocks until it completed —
+        the reference tests' manual-flush idiom. The queue put happens
+        outside the condition lock so a full queue can never deadlock the
+        pipeline thread against the ticker."""
+        with self._flush_done:
+            gen = self.flush_count
+        self.packet_queue.put(_FLUSH)
+        if wait:
+            with self._flush_done:
+                self._flush_done.wait_for(
+                    lambda: self.flush_count > gen,
+                    timeout=max(self.interval, 30.0))
+
+    def _do_flush(self):
+        self.last_flush = time.time()
+        ts = int(self.last_flush)
+        flush_arrays, table = self.aggregator.flush(self.cfg.percentiles)
+
+        with self._event_lock:
+            samples, self.event_samples = self.event_samples, []
+        for sink in self.metric_sinks:
+            try:
+                sink.flush_other_samples(samples)
+            except Exception as e:
+                log.warning("sink %s FlushOtherSamples: %s", sink.name, e)
+
+        final = generate_intermetrics(
+            flush_arrays, table,
+            percentiles=self.cfg.percentiles,
+            aggregates=self.cfg.aggregates,
+            is_local=self.cfg.is_local,
+            timestamp=ts, hostname=self.hostname)
+        if not final:
+            return
+        # parallel sink flushes + barrier (flusher.go:105-115)
+        threads = [threading.Thread(target=self._flush_sink,
+                                    args=(s, final)) for s in self.metric_sinks]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=self.interval)
+        # plugins run post-flush (flusher.go:117-131)
+        for p in self.plugins:
+            try:
+                p.flush(final)
+            except Exception as e:
+                log.warning("plugin %s flush failed: %s", p.name, e)
+
+    @staticmethod
+    def _flush_sink(sink, metrics: List[InterMetric]):
+        try:
+            sink.flush(metrics)
+        except Exception as e:
+            log.warning("sink %s flush failed: %s", sink.name, e)
+
+    def _watchdog(self):
+        """reference server.go:900 FlushWatchdog: crash-only restart if
+        flushes stall for N intervals."""
+        missed = self.cfg.flush_watchdog_missed_flushes
+        while not self._shutdown.wait(self.interval / 2):
+            if time.time() - self.last_flush > missed * self.interval:
+                log.critical(
+                    "flush watchdog: no flush for %d intervals, aborting",
+                    missed)
+                os._exit(3)
+
+    def shutdown(self):
+        """reference server.go:1418 Shutdown (graceful)."""
+        self._shutdown.set()
+        for s in self._sockets:
+            try:
+                s.close()
+            except OSError:
+                pass
+        self.packet_queue.put(_STOP)
+        for t in self._threads:
+            t.join(timeout=2.0)

@@ -1,0 +1,4 @@
+"""Sink interfaces (reference sinks/sinks.go:32-103) and the registry the
+server wires at startup (reference server.go:472-678)."""
+
+from veneur_tpu.sinks.base import MetricSink, SpanSink  # noqa: F401

@@ -1,0 +1,53 @@
+"""Sink interfaces.
+
+reference sinks/sinks.go:32-48 MetricSink{Name, Start, Flush,
+FlushOtherSamples} and :86-103 SpanSink{Name, Start, Ingest, Flush}. Tag
+exclusion (SetExcludedTags) is wired from `tags_exclude` with the
+`tag|sink1|sink2` per-sink syntax (reference server.go:1467-1510).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List
+
+from veneur_tpu.samplers.intermetric import InterMetric
+
+
+class MetricSink:
+    name: str = "sink"
+
+    def start(self) -> None:
+        pass
+
+    def flush(self, metrics: List[InterMetric]) -> None:
+        raise NotImplementedError
+
+    def flush_other_samples(self, samples: Iterable) -> None:
+        """DogStatsD events / service checks as SSF samples
+        (reference sinks.go:44-47)."""
+
+    def set_excluded_tags(self, tags: List[str]) -> None:
+        self.excluded_tags = list(tags)
+
+    def strip_excluded(self, tags: Iterable[str]) -> List[str]:
+        excl = getattr(self, "excluded_tags", ())
+        return [t for t in tags
+                if not any(t == e or t.startswith(e + ":") for e in excl)]
+
+
+class SpanSink:
+    name: str = "span_sink"
+
+    def start(self) -> None:
+        pass
+
+    def ingest(self, span) -> None:
+        raise NotImplementedError
+
+    def flush(self) -> None:
+        pass
+
+
+def filter_acceptable(metrics: List[InterMetric], sink_name: str):
+    """reference sinks/sinks.go:51 IsAcceptableMetric applied batch-wise."""
+    return [m for m in metrics if m.is_acceptable_to(sink_name)]

@@ -1,0 +1,18 @@
+"""No-op sink (reference sinks/blackhole/blackhole.go) — the benchmark and
+test target (BASELINE config 1 flushes to blackhole)."""
+
+from veneur_tpu.sinks.base import MetricSink, SpanSink
+
+
+class BlackholeMetricSink(MetricSink):
+    name = "blackhole"
+
+    def flush(self, metrics):
+        pass
+
+
+class BlackholeSpanSink(SpanSink):
+    name = "blackhole"
+
+    def ingest(self, span):
+        pass

@@ -1,0 +1,139 @@
+"""Datadog metric sink — batched JSON series posts.
+
+reference sinks/datadog/datadog.go: `DDMetric` JSON bodies posted to
+`{api}/api/v1/series?api_key=...`, chunked to `datadog_flush_max_per_body`
+points per POST (:112-160), name-prefix drops and per-prefix tag exclusion
+(:256+), events/service checks via FlushOtherSamples (:162). Uses urllib —
+no external HTTP dependency — with zlib deflate like the reference's
+compressed posts (http/http.go PostHelper).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import urllib.request
+import zlib
+from typing import List
+
+from veneur_tpu.samplers.intermetric import COUNTER, InterMetric
+from veneur_tpu.sinks.base import MetricSink, filter_acceptable
+
+log = logging.getLogger("veneur_tpu.sinks.datadog")
+
+
+class DatadogMetricSink(MetricSink):
+    name = "datadog"
+
+    def __init__(self, api_key: str, hostname: str, api_url: str,
+                 interval_s: float = 10.0, flush_max_per_body: int = 25000,
+                 tags: List[str] = (), metric_name_prefix_drops: List[str] = (),
+                 exclude_tags_prefix_by_prefix_metric: dict = None):
+        self.api_key = api_key
+        self.hostname = hostname
+        self.api_url = api_url.rstrip("/")
+        self.interval_s = interval_s
+        self.flush_max_per_body = flush_max_per_body
+        self.tags = list(tags)
+        self.prefix_drops = list(metric_name_prefix_drops)
+        self.prefix_tag_excludes = dict(
+            exclude_tags_prefix_by_prefix_metric or {})
+
+    # -- serialization ------------------------------------------------------
+    def _dd_metric(self, m: InterMetric):
+        """InterMetric -> DDMetric dict (reference datadog.go:200-254
+        finalizeMetrics/ddMetricFromInterMetric)."""
+        tags = self.strip_excluded(m.tags)
+        for prefix, excludes in self.prefix_tag_excludes.items():
+            if m.name.startswith(prefix):
+                tags = [t for t in tags
+                        if not any(t == e or t.startswith(e + ":")
+                                   for e in excludes)]
+        host = m.hostname or self.hostname
+        dd = {
+            "metric": m.name,
+            "type": "gauge",
+            "points": [[m.timestamp, m.value]],
+            "host": host,
+            "tags": tags + self.tags,
+        }
+        if m.type == COUNTER:
+            # Datadog rates: value divided by the flush interval, with the
+            # interval attached so count rollups reconstruct the original
+            # (reference datadog.go:375 Interval)
+            dd["type"] = "rate"
+            dd["points"] = [[m.timestamp, m.value / self.interval_s]]
+            dd["interval"] = int(self.interval_s)
+        return dd
+
+    # -- flush --------------------------------------------------------------
+    def flush(self, metrics):
+        metrics = filter_acceptable(metrics, self.name)
+        series = [self._dd_metric(m) for m in metrics
+                  if not any(m.name.startswith(p) for p in self.prefix_drops)]
+        if not series:
+            return
+        chunks = [series[i:i + self.flush_max_per_body]
+                  for i in range(0, len(series), self.flush_max_per_body)]
+        # parallel chunk posts, like the reference's per-chunk goroutines
+        # (datadog.go:145-155 flushPart workers)
+        threads = [threading.Thread(target=self._post_chunk, args=(c,))
+                   for c in chunks[1:]]
+        for t in threads:
+            t.start()
+        self._post_chunk(chunks[0])
+        for t in threads:
+            t.join()
+
+    def _post_chunk(self, series):
+        body = zlib.compress(json.dumps({"series": series}).encode())
+        url = f"{self.api_url}/api/v1/series?api_key={self.api_key}"
+        req = urllib.request.Request(
+            url, data=body, method="POST",
+            headers={"Content-Type": "application/json",
+                     "Content-Encoding": "deflate"})
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                resp.read()
+        except Exception as e:  # flush errors are counted, never fatal
+            log.error("datadog flush failed: %s", e)
+
+    def flush_other_samples(self, samples):
+        """DogStatsD events → Datadog events API: the vdogstatsd_* conduit
+        tags map back onto event fields (reference datadog.go:162
+        FlushOtherSamples / parseMetricsFromSSFSamples)."""
+        events = []
+        for s in samples:
+            tags = dict(s.tags) if s.tags else {}
+            if "vdogstatsd_ev" not in tags:
+                continue
+            ev = {
+                "title": s.name,
+                "text": s.message,
+                "date_happened": s.timestamp,
+                "tags": [f"{k}:{v}" for k, v in tags.items()
+                         if not k.startswith("vdogstatsd")],
+            }
+            field_map = {"vdogstatsd_at": "alert_type",
+                         "vdogstatsd_pri": "priority",
+                         "vdogstatsd_hostname": "host",
+                         "vdogstatsd_st": "source_type_name",
+                         "vdogstatsd_ak": "aggregation_key"}
+            for tag_key, ev_key in field_map.items():
+                if tags.get(tag_key):
+                    ev[ev_key] = tags[tag_key]
+            events.append(ev)
+        if not events:
+            return
+        body = zlib.compress(json.dumps({"events": events}).encode())
+        req = urllib.request.Request(
+            f"{self.api_url}/intake?api_key={self.api_key}", data=body,
+            method="POST",
+            headers={"Content-Type": "application/json",
+                     "Content-Encoding": "deflate"})
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                resp.read()
+        except Exception as e:
+            log.error("datadog event flush failed: %s", e)

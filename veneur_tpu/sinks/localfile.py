@@ -1,0 +1,55 @@
+"""Flush-to-file plugin (reference plugins/localfile/localfile.go: TSV
+append of every final InterMetric batch) and the CSV encoding shared with
+the S3 plugin (reference plugins/s3/csv.go EncodeInterMetricsCSV)."""
+
+from __future__ import annotations
+
+import csv
+import gzip
+import io
+import time
+
+from veneur_tpu.samplers.intermetric import InterMetric
+
+# column order mirrors reference plugins/s3/csv.go tsvSchema
+COLUMNS = ["Name", "Tags", "MetricType", "HostName", "Interval",
+           "Timestamp", "Value", "Partition"]
+
+
+def encode_row(m: InterMetric, hostname: str, interval_s: int):
+    ts = time.strftime("%Y-%m-%d %H:%M:%S",
+                       time.gmtime(m.timestamp))
+    partition = time.strftime("%Y%m%d", time.gmtime(m.timestamp))
+    return [m.name, ",".join(m.tags), m.type, hostname,
+            str(interval_s), ts, repr(float(m.value)), partition]
+
+
+def encode_intermetrics_csv(metrics, hostname: str, interval_s: int,
+                            delimiter: str = "\t", compress: bool = False) -> bytes:
+    buf = io.StringIO()
+    w = csv.writer(buf, delimiter=delimiter, lineterminator="\n")
+    for m in metrics:
+        w.writerow(encode_row(m, hostname, interval_s))
+    data = buf.getvalue().encode()
+    if compress:
+        data = gzip.compress(data)
+    return data
+
+
+class LocalFilePlugin:
+    """reference plugins/localfile/localfile.go:32 — appends TSV rows on
+    every flush. Registered as a post-flush plugin (plugins/plugins.go:16)."""
+    name = "localfile"
+
+    def __init__(self, path: str, hostname: str, interval_s: int = 10,
+                 delimiter: str = "\t"):
+        self.path = path
+        self.hostname = hostname
+        self.interval_s = interval_s
+        self.delimiter = delimiter
+
+    def flush(self, metrics):
+        data = encode_intermetrics_csv(metrics, self.hostname,
+                                       self.interval_s, self.delimiter)
+        with open(self.path, "ab") as f:
+            f.write(data)
