@@ -1,0 +1,236 @@
+"""In-process distributed tier: local server(s) + proxy + global server over
+real loopback gRPC (the reference's forwardGRPCFixture pattern,
+forward_grpc_test.go:19-56)."""
+
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from veneur_tpu.config import Config
+from veneur_tpu.forward.discovery import StaticDiscoverer
+from veneur_tpu.forward.proxysrv import HashRing, ProxyServer
+from veneur_tpu.server.server import Server
+from veneur_tpu.sinks.debug import DebugMetricSink
+
+from tests.test_server import by_name, small_config, _send_udp, _wait_processed
+
+
+@pytest.fixture
+def tier():
+    """local -> global, directly wired over loopback gRPC."""
+    gsink = DebugMetricSink()
+    glob = Server(small_config(grpc_address="127.0.0.1:0"),
+                  metric_sinks=[gsink])
+    glob.start()
+    lsink = DebugMetricSink()
+    local = Server(small_config(
+        forward_address=f"127.0.0.1:{glob.grpc_port}"),
+        metric_sinks=[lsink])
+    local.start()
+    yield local, lsink, glob, gsink
+    local.shutdown()
+    glob.shutdown()
+
+
+def _flush_through(local, glob):
+    local.trigger_flush()
+    deadline = time.time() + 10
+    while time.time() < deadline and glob.aggregator.processed == 0:
+        time.sleep(0.05)
+    glob.trigger_flush()
+
+
+def test_forward_global_counter_and_gauge(tier):
+    local, lsink, glob, gsink = tier
+    _send_udp(local.local_addr(), [
+        b"fwd.counter:7|c|#veneurglobalonly",
+        b"fwd.gauge:3.5|g|#veneurglobalonly",
+    ])
+    _wait_processed(local, 2)
+    _flush_through(local, glob)
+
+    # not flushed locally
+    assert "fwd.counter" not in by_name(lsink.flushed)
+    g = by_name(gsink.flushed)
+    assert g["fwd.counter"].value == 7.0
+    assert g["fwd.gauge"].value == 3.5
+
+
+def test_forward_mixed_timer_digest_merge(tier):
+    local, lsink, glob, gsink = tier
+    vals = list(range(1, 101))  # 1..100
+    _send_udp(local.local_addr(),
+              [f"fwd.timer:{v}|ms".encode() for v in vals])
+    _wait_processed(local, 100)
+    _flush_through(local, glob)
+
+    l = by_name(lsink.flushed)
+    # local: aggregates only for mixed scope
+    assert l["fwd.timer.count"].value == 100.0
+    assert l["fwd.timer.min"].value == 1.0
+    assert "fwd.timer.50percentile" not in l
+    # global: percentiles only (no double-counted aggregates)
+    g = by_name(gsink.flushed)
+    assert "fwd.timer.count" not in g
+    p50 = g["fwd.timer.50percentile"].value
+    assert abs(p50 - np.percentile(vals, 50)) / 100.0 < 0.02
+    p99 = g["fwd.timer.99percentile"].value
+    assert abs(p99 - np.percentile(vals, 99)) / 100.0 < 0.02
+
+
+def test_forward_set_hll_merge(tier):
+    local, lsink, glob, gsink = tier
+    _send_udp(local.local_addr(),
+              [f"fwd.set:user{i}|s".encode() for i in range(64)])
+    _wait_processed(local, 64)
+    _flush_through(local, glob)
+
+    assert "fwd.set" not in by_name(lsink.flushed)
+    g = by_name(gsink.flushed)
+    assert g["fwd.set"].value == pytest.approx(64, rel=0.05)
+
+
+def test_two_locals_merge_on_global():
+    """The 64->1 pattern at 2->1 scale: counter sums and digest merges
+    across instances (BASELINE config 4)."""
+    gsink = DebugMetricSink()
+    glob = Server(small_config(grpc_address="127.0.0.1:0"),
+                  metric_sinks=[gsink])
+    glob.start()
+    locals_ = [Server(small_config(
+        forward_address=f"127.0.0.1:{glob.grpc_port}"),
+        metric_sinks=[DebugMetricSink()]) for _ in range(2)]
+    for s in locals_:
+        s.start()
+    try:
+        rng = np.random.default_rng(5)
+        all_vals = []
+        for i, srv in enumerate(locals_):
+            vals = rng.lognormal(0, 0.5, 200)
+            all_vals.extend(vals)
+            lines = [b"multi.count:2|c|#veneurglobalonly"] * 50 + [
+                f"multi.timer:{v:.4f}|ms".encode() for v in vals]
+            _send_udp(srv.local_addr(), lines[:100])
+            _send_udp(srv.local_addr(), lines[100:])
+            _wait_processed(srv, 250)
+        for srv in locals_:
+            srv.trigger_flush()
+        deadline = time.time() + 10
+        while time.time() < deadline and glob.aggregator.processed < 2:
+            time.sleep(0.05)
+        glob.trigger_flush()
+        g = by_name(gsink.flushed)
+        assert g["multi.count"].value == 200.0  # 2*50 per local, 2 locals
+        exact = np.percentile(all_vals, 99)
+        got = g["multi.timer.99percentile"].value
+        # 400 samples through two compression stages: statistical envelope
+        # is wider than the 100k-sample accuracy tests (test_tdigest.py)
+        assert abs(got - exact) / exact < 0.05
+    finally:
+        for s in locals_:
+            s.shutdown()
+        glob.shutdown()
+
+
+def test_proxy_routes_to_globals():
+    """local -> proxy -> 2 globals: ring routing partitions keys without
+    loss (proxysrv/server.go:273 destForMetric)."""
+    gsinks = [DebugMetricSink(), DebugMetricSink()]
+    globs = [Server(small_config(grpc_address="127.0.0.1:0"),
+                    metric_sinks=[gs]) for gs in gsinks]
+    for g in globs:
+        g.start()
+    proxy = ProxyServer(StaticDiscoverer(
+        [f"127.0.0.1:{g.grpc_port}" for g in globs]))
+    proxy.start()
+    local = Server(small_config(
+        forward_address=f"127.0.0.1:{proxy.port}"),
+        metric_sinks=[DebugMetricSink()])
+    local.start()
+    try:
+        lines = [f"proxied.counter.{i}:1|c|#veneurglobalonly".encode()
+                 for i in range(40)]
+        _send_udp(local.local_addr(), lines)
+        _wait_processed(local, 40)
+        local.trigger_flush()
+        deadline = time.time() + 10
+        while (time.time() < deadline
+               and sum(g.aggregator.processed for g in globs) < 40):
+            time.sleep(0.05)
+        for g in globs:
+            g.trigger_flush()
+        names = set()
+        for gs in gsinks:
+            names |= set(by_name(gs.flushed))
+        assert names == {f"proxied.counter.{i}" for i in range(40)}
+        # both globals got a share
+        assert all(g.aggregator.processed > 0 for g in globs)
+        assert proxy.forwarded == 40
+    finally:
+        local.shutdown()
+        proxy.stop()
+        for g in globs:
+            g.shutdown()
+
+
+def test_hash_ring_stability_and_keep_last_good():
+    ring = HashRing(["a:1", "b:1", "c:1"])
+    keys = [f"key{i}".encode() for i in range(1000)]
+    owners = {k: ring.get(k) for k in keys}
+    # deterministic
+    assert owners == {k: ring.get(k) for k in keys}
+    # balanced within reason
+    from collections import Counter
+    counts = Counter(owners.values())
+    assert all(150 < c < 550 for c in counts.values()), counts
+    # minimal disruption when one node leaves
+    ring2 = HashRing(["a:1", "b:1"])
+    moved = sum(1 for k in keys
+                if owners[k] != "c:1" and ring2.get(k) != owners[k])
+    assert moved < 100  # only c's keys reassign (plus a tiny remainder)
+
+    # keep-last-good: discovery returning [] keeps the ring
+    class FlakyDisc:
+        def __init__(self):
+            self.calls = 0
+
+        def get_destinations_for_service(self, service):
+            self.calls += 1
+            return [] if self.calls > 1 else ["a:1", "b:1"]
+
+    p = ProxyServer(FlakyDisc())
+    assert p._ring.destinations == ["a:1", "b:1"]
+    p.refresh()
+    assert p._ring.destinations == ["a:1", "b:1"]
+
+
+def test_consul_discoverer_parses_health_json():
+    import io
+    import json
+    payload = [
+        {"Node": {"Address": "10.0.0.1"},
+         "Service": {"Address": "10.1.1.1", "Port": 8128}},
+        {"Node": {"Address": "10.0.0.2"},
+         "Service": {"Address": "", "Port": 8128}},
+    ]
+
+    class FakeResp(io.BytesIO):
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            return False
+
+    from veneur_tpu.forward.discovery import ConsulDiscoverer
+    seen = {}
+
+    def opener(url, timeout=0):
+        seen["url"] = url
+        return FakeResp(json.dumps(payload).encode())
+
+    d = ConsulDiscoverer("http://consul:8500", opener=opener)
+    dests = d.get_destinations_for_service("veneur-global")
+    assert dests == ["10.1.1.1:8128", "10.0.0.2:8128"]
+    assert "health/service/veneur-global?passing" in seen["url"]

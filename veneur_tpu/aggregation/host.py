@@ -42,6 +42,12 @@ class SlotMeta:
     kind: str
     hostname: str = ""
     message: str = ""  # status checks only
+    # True while a histo slot has only ever been fed by the import path;
+    # drives the global tier's aggregate suppression for mixed-scope
+    # histograms (reference flusher.go:61-77 "avoid double counting":
+    # imported mixed histos have no local scalars, so only percentiles
+    # flush). Cleared on the first directly-sampled value.
+    imported_only: bool = False
 
 
 class _KindTable:
@@ -107,13 +113,14 @@ class KeyTable:
         return "histo" if kind in ("histogram", "timer") else kind
 
     def slot_for(self, kind: str, name: str, tags: tuple, scope: int,
-                 digest: int, hostname: str = "") -> Optional[int]:
+                 digest: int, hostname: str = "",
+                 imported: bool = False) -> Optional[int]:
         t = self.tables[self._table_name(kind)]
         key = (kind, name, tags)
         return t.slot_for(
             key, digest,
             lambda: SlotMeta(name=name, tags=tags, scope=scope, kind=kind,
-                             hostname=hostname))
+                             hostname=hostname, imported_only=imported))
 
     def get_meta(self, kind: str):
         """[(slot, SlotMeta)] in allocation order for flush labeling."""
@@ -138,6 +145,7 @@ class BatchSpec:
     status: int = 256
     set: int = 4096
     histo: int = 8192
+    histo_stat: int = 256  # imported-digest scalar lane (step.py)
 
 
 class Batcher:
@@ -169,7 +177,12 @@ class Batcher:
         self.h_slot = np.full(b.histo, self.spec.histo_capacity, np.int32)
         self.h_val = np.zeros(b.histo, np.float32)
         self.h_wt = np.zeros(b.histo, np.float32)
-        self.nc = self.ng = self.nst = self.ns = self.nh = 0
+        self.hs_slot = np.full(b.histo_stat, self.spec.histo_capacity,
+                               np.int32)
+        self.hs_min = np.full(b.histo_stat, np.inf, np.float32)
+        self.hs_max = np.full(b.histo_stat, -np.inf, np.float32)
+        self.hs_recip = np.zeros(b.histo_stat, np.float32)
+        self.nc = self.ng = self.nst = self.ns = self.nh = self.nhs = 0
 
     def _maybe_emit(self, n, cap):
         if n >= cap:
@@ -208,8 +221,28 @@ class Batcher:
         self.nh += 1
         self._maybe_emit(self.nh, self.bspec.histo)
 
+    def add_histo_weighted(self, slot: int, value: float, weight: float):
+        """Direct-weight variant for imported digest centroids (the
+        global-tier re-add merge, reference samplers.go:726)."""
+        self.h_slot[self.nh] = slot
+        self.h_val[self.nh] = value
+        self.h_wt[self.nh] = weight
+        self.nh += 1
+        self._maybe_emit(self.nh, self.bspec.histo)
+
+    def add_histo_stats(self, slot: int, mn: float, mx: float,
+                        recip: float):
+        """Imported digest's exact min/max/reciprocalSum."""
+        self.hs_slot[self.nhs] = slot
+        self.hs_min[self.nhs] = mn
+        self.hs_max[self.nhs] = mx
+        self.hs_recip[self.nhs] = recip
+        self.nhs += 1
+        self._maybe_emit(self.nhs, self.bspec.histo_stat)
+
     def pending(self) -> int:
-        return self.nc + self.ng + self.nst + self.ns + self.nh
+        return (self.nc + self.ng + self.nst + self.ns + self.nh
+                + self.nhs)
 
     def emit(self) -> Optional[Batch]:
         """Build a padded Batch from staged samples, reset staging, and pass
@@ -224,6 +257,10 @@ class Batcher:
             set_rho=self.s_rho.copy(),
             histo_slot=self.h_slot.copy(), histo_val=self.h_val.copy(),
             histo_wt=self.h_wt.copy(),
+            histo_stat_slot=self.hs_slot.copy(),
+            histo_stat_min=self.hs_min.copy(),
+            histo_stat_max=self.hs_max.copy(),
+            histo_stat_recip=self.hs_recip.copy(),
         )
         # reset padding sentinels for the next batch
         self.c_slot[:self.nc] = self.spec.counter_capacity
@@ -231,9 +268,13 @@ class Batcher:
         self.st_slot[:self.nst] = self.spec.status_capacity
         self.s_slot[:self.ns] = self.spec.set_capacity
         self.h_slot[:self.nh] = self.spec.histo_capacity
+        self.hs_slot[:self.nhs] = self.spec.histo_capacity
+        self.hs_min[:self.nhs] = np.inf
+        self.hs_max[:self.nhs] = -np.inf
+        self.hs_recip[:self.nhs] = 0.0
         self.c_inc[:self.nc] = 0.0
         self.h_wt[:self.nh] = 0.0
-        self.nc = self.ng = self.nst = self.ns = self.nh = 0
+        self.nc = self.ng = self.nst = self.ns = self.nh = self.nhs = 0
         if self.on_batch is not None:
             self.on_batch(batch)
         return batch

@@ -49,6 +49,14 @@ class Batch(NamedTuple):
     histo_slot: jax.Array     # i32[Bh]
     histo_val: jax.Array      # f32[Bh]
     histo_wt: jax.Array       # f32[Bh]  1/sample_rate, reference samplers.go:484
+    # import-side digest scalars (global tier merge, worker.go:438
+    # ImportMetricGRPC): per imported digest, its exact min/max/reciprocalSum
+    # ride these lanes instead of being lossily re-derived from centroids.
+    # None on pure-ingest batches (the common case).
+    histo_stat_slot: jax.Array = None   # i32[Bm]
+    histo_stat_min: jax.Array = None    # f32[Bm]
+    histo_stat_max: jax.Array = None    # f32[Bm]
+    histo_stat_recip: jax.Array = None  # f32[Bm]
 
 
 def _last_per_slot_set(target, stamp, slot, val, capacity):
@@ -137,8 +145,16 @@ def ingest_core(state: DeviceState, batch: Batch, *, spec: TableSpec) -> DeviceS
                            gauge=gauge, gauge_stamp=gauge_stamp,
                            status=status, status_stamp=status_stamp,
                            hll=hll)
-    return _histo_update(state, batch.histo_slot, batch.histo_val,
-                         batch.histo_wt, spec)
+    state = _histo_update(state, batch.histo_slot, batch.histo_val,
+                          batch.histo_wt, spec)
+    if batch.histo_stat_slot is not None:
+        s = batch.histo_stat_slot
+        state = state._replace(
+            h_min=state.h_min.at[s].min(batch.histo_stat_min, mode="drop"),
+            h_max=state.h_max.at[s].max(batch.histo_stat_max, mode="drop"),
+            h_recip_acc=state.h_recip_acc.at[s].add(batch.histo_stat_recip,
+                                                    mode="drop"))
+    return state
 
 
 ingest_step = partial(jax.jit, static_argnames=("spec",),

@@ -1,0 +1,54 @@
+"""veneur-proxy daemon CLI (reference cmd/veneur-proxy/main.go)."""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import sys
+import threading
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="veneur-tpu-proxy")
+    ap.add_argument("-f", dest="config", required=True)
+    args = ap.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO)
+    from veneur_tpu.config import parse_duration
+    from veneur_tpu.config_proxy import read_proxy_config
+    from veneur_tpu.forward.discovery import (
+        ConsulDiscoverer, StaticDiscoverer)
+    from veneur_tpu.forward.proxysrv import ProxyServer
+
+    cfg = read_proxy_config(args.config)
+    service = (cfg.consul_forward_grpc_service_name
+               or cfg.consul_forward_service_name)
+    static = cfg.grpc_forward_address or cfg.forward_address
+    if service:
+        disc = ConsulDiscoverer(cfg.consul_url)
+    elif static:
+        disc = StaticDiscoverer([static])
+    else:
+        print("proxy needs a discovery service name or a static "
+              "forward address", file=sys.stderr)
+        return 1
+
+    refresh = (parse_duration(cfg.consul_refresh_interval)
+               if cfg.consul_refresh_interval else 0.0)
+    proxy = ProxyServer(disc, service=service or "static",
+                        refresh_interval=refresh)
+    proxy.start(cfg.grpc_address)
+    logging.getLogger("veneur_tpu").info(
+        "veneur-tpu-proxy listening on port %s", proxy.port)
+
+    stop = threading.Event()
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    stop.wait()
+    proxy.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

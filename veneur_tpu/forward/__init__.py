@@ -1,0 +1,2 @@
+"""The distributed tier: local→global sketch forwarding over gRPC
+(reference forwardrpc/, importsrv/, proxysrv/ — SURVEY §2.4)."""

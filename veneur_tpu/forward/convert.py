@@ -1,0 +1,132 @@
+"""metricpb.Metric <-> aggregator state conversion.
+
+Export mirrors reference worker.go:181 ForwardableMetrics + the samplers'
+Metric() methods (samplers/samplers.go: Counter.Metric :171, Gauge.Metric
+:266, Set.Metric :432, Histo.Metric :688): scope-global counters/gauges and
+non-local histograms/timers/sets ship their mergeable sketch state. Import
+mirrors importsrv/server.go:102 SendMetrics → worker.go:438
+ImportMetricGRPC, including the scope coercion of counters/gauges to
+GlobalOnly (:442-447).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+from veneur_tpu.aggregation.host import (
+    KeyTable, SCOPE_GLOBAL, SCOPE_LOCAL)
+from veneur_tpu.ops import hll as hll_ops
+from veneur_tpu.proto import metricpb_pb2 as mpb
+from veneur_tpu.proto import tdigestpb_pb2 as tdpb
+from veneur_tpu.utils.hashing import fnv1a_32
+
+_KIND_TO_TYPE = {
+    "counter": mpb.Counter, "gauge": mpb.Gauge, "histogram": mpb.Histogram,
+    "set": mpb.Set, "timer": mpb.Timer,
+}
+_TYPE_TO_KIND = {v: k for k, v in _KIND_TO_TYPE.items()}
+_TYPE_NAMES = {mpb.Counter: "counter", mpb.Gauge: "gauge",
+               mpb.Histogram: "histogram", mpb.Set: "set",
+               mpb.Timer: "timer"}
+
+
+def metric_digest(name: str, pb_type: int, tags) -> int:
+    """Sharding digest over name+type+tags, identical to the reference's
+    importsrv hash (importsrv/server.go:141-148 hashMetric: fnv1a-32 over
+    name, the capitalized enum name from Type.String(), then each tag)."""
+    h = fnv1a_32(name.encode())
+    h = fnv1a_32(mpb.Type.Name(pb_type).encode(), h)
+    for t in tags:
+        h = fnv1a_32(t.encode(), h)
+    return h
+
+
+def export_metrics(raw: Dict[str, np.ndarray], table: KeyTable,
+                   compression: float, hll_precision: int
+                   ) -> List[mpb.Metric]:
+    """Build the forwardable MetricList from a flush's raw state."""
+    out: List[mpb.Metric] = []
+
+    for slot, meta in table.get_meta("counter"):
+        if meta.scope != SCOPE_GLOBAL:
+            continue  # only global counters forward (worker.go:186-193)
+        m = mpb.Metric(name=meta.name, tags=list(meta.tags),
+                       type=mpb.Counter, scope=mpb.Global)
+        m.counter.value = int(round(float(raw["counter"][slot])))
+        out.append(m)
+
+    for slot, meta in table.get_meta("gauge"):
+        if meta.scope != SCOPE_GLOBAL:
+            continue
+        m = mpb.Metric(name=meta.name, tags=list(meta.tags),
+                       type=mpb.Gauge, scope=mpb.Global)
+        m.gauge.value = float(raw["gauge"][slot])
+        out.append(m)
+
+    for slot, meta in table.get_meta("set"):
+        if meta.scope == SCOPE_LOCAL:
+            continue  # local-only sets flush locally, never forward
+        m = mpb.Metric(name=meta.name, tags=list(meta.tags), type=mpb.Set,
+                       scope=mpb.Global if meta.scope == SCOPE_GLOBAL
+                       else mpb.Mixed)
+        m.set.hyper_log_log = hll_ops.serialize(raw["hll"][slot],
+                                                hll_precision)
+        out.append(m)
+
+    for slot, meta in table.get_meta("histogram"):
+        if meta.scope == SCOPE_LOCAL:
+            continue
+        w = raw["h_weight"][slot]
+        live = w > 0
+        if not live.any():
+            continue
+        mtype = mpb.Timer if meta.kind == "timer" else mpb.Histogram
+        m = mpb.Metric(name=meta.name, tags=list(meta.tags), type=mtype,
+                       scope=mpb.Global if meta.scope == SCOPE_GLOBAL
+                       else mpb.Mixed)
+        td = m.histogram.t_digest
+        td.compression = compression
+        td.min = float(raw["h_min"][slot])
+        td.max = float(raw["h_max"][slot])
+        td.reciprocalSum = float(raw["h_recip"][slot])
+        means = raw["h_mean"][slot][live]
+        weights = w[live]
+        for mean, wt in zip(means, weights):
+            td.main_centroids.add(mean=float(mean), weight=float(wt))
+        out.append(m)
+
+    return out
+
+
+def import_into(aggregator, metric: mpb.Metric) -> None:
+    """Apply one received metricpb.Metric to a global aggregator
+    (worker.go:438 ImportMetricGRPC)."""
+    kind = _TYPE_NAMES[metric.type]
+    tags = tuple(metric.tags)
+    digest = metric_digest(metric.name, metric.type, tags)
+    # counters/gauges arriving via import are global by definition
+    # (worker.go:442-447 scope coercion)
+    scope = SCOPE_GLOBAL if kind in ("counter", "gauge") else (
+        SCOPE_GLOBAL if metric.scope == mpb.Global else 0)
+
+    which = metric.WhichOneof("value")
+    if which == "counter":
+        payload = {"value": metric.counter.value}
+    elif which == "gauge":
+        payload = {"value": metric.gauge.value}
+    elif which == "set":
+        _, regs = hll_ops.deserialize(metric.set.hyper_log_log)
+        payload = {"registers": regs}
+    elif which == "histogram":
+        td = metric.histogram.t_digest
+        payload = {
+            "means": [c.mean for c in td.main_centroids],
+            "weights": [c.weight for c in td.main_centroids],
+            "min": td.min, "max": td.max, "recip": td.reciprocalSum,
+        }
+    else:
+        return
+    aggregator.import_metric(kind, metric.name, tags, scope, digest,
+                             payload)

@@ -1,0 +1,85 @@
+"""Service discovery for the proxy tier.
+
+reference discoverer.go:5 Discoverer interface + consul.go:29 (healthy
+instances via /v1/health/service) + kubernetes.go:32 (pod list by label).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import urllib.request
+from typing import List
+
+log = logging.getLogger("veneur_tpu.forward.discovery")
+
+
+class StaticDiscoverer:
+    """Fixed destination list (the reference's non-discovery config path)."""
+
+    def __init__(self, destinations: List[str]):
+        self.destinations = list(destinations)
+
+    def get_destinations_for_service(self, service: str) -> List[str]:
+        return list(self.destinations)
+
+
+class ConsulDiscoverer:
+    """Healthy-instance lookup (reference consul.go:29
+    GetDestinationsForService: /v1/health/service/<name>?passing)."""
+
+    def __init__(self, consul_url: str = "http://127.0.0.1:8500",
+                 opener=None):
+        self.consul_url = consul_url.rstrip("/")
+        self._open = opener or urllib.request.urlopen
+
+    def get_destinations_for_service(self, service: str) -> List[str]:
+        url = f"{self.consul_url}/v1/health/service/{service}?passing"
+        with self._open(url, timeout=10) as resp:
+            entries = json.loads(resp.read())
+        dests = []
+        for e in entries:
+            svc = e.get("Service", {})
+            node = e.get("Node", {})
+            host = svc.get("Address") or node.get("Address")
+            port = svc.get("Port")
+            if host and port:
+                dests.append(f"{host}:{port}")
+        return dests
+
+
+class KubernetesDiscoverer:
+    """Pod-list lookup (reference kubernetes.go:32: label
+    app=veneur-global). Requires in-cluster credentials; reads the
+    service-account token mounted by k8s."""
+
+    def __init__(self, namespace: str = "default",
+                 label_selector: str = "app=veneur-global",
+                 api_base: str = "https://kubernetes.default.svc"):
+        self.namespace = namespace
+        self.label_selector = label_selector
+        self.api_base = api_base
+
+    def get_destinations_for_service(self, service: str) -> List[str]:
+        import ssl
+        token_path = "/var/run/secrets/kubernetes.io/serviceaccount/token"
+        try:
+            with open(token_path) as f:
+                token = f.read()
+        except OSError:
+            log.warning("not running in-cluster; k8s discovery unavailable")
+            return []
+        url = (f"{self.api_base}/api/v1/namespaces/{self.namespace}/pods"
+               f"?labelSelector={self.label_selector}")
+        req = urllib.request.Request(
+            url, headers={"Authorization": f"Bearer {token}"})
+        ctx = ssl.create_default_context(
+            cafile="/var/run/secrets/kubernetes.io/serviceaccount/ca.crt")
+        with urllib.request.urlopen(req, timeout=10, context=ctx) as resp:
+            pods = json.loads(resp.read())
+        dests = []
+        for pod in pods.get("items", []):
+            ip = pod.get("status", {}).get("podIP")
+            if ip and pod.get("status", {}).get("phase") == "Running":
+                dests.append(f"{ip}:8128")
+        return dests

@@ -1,0 +1,142 @@
+"""The consistent-hash routing tier: veneur-proxy.
+
+reference proxysrv/server.go: a Forward gRPC server that consistent-hashes
+each metric's key to one global destination and forwards per-destination
+batches; the ring refreshes from discovery on an interval (proxy.go:321-347)
+and keeps the last good set when discovery returns empty (proxy.go:498-508);
+connections are cached per destination (client_conn_map.go).
+"""
+
+from __future__ import annotations
+
+import bisect
+import logging
+import threading
+from typing import Dict, List, Optional
+
+from veneur_tpu.forward.rpc import ForwardClient, serve
+from veneur_tpu.utils.hashing import fnv1a_64, splitmix64
+
+
+def _point(data: bytes) -> int:
+    """Ring placement hash: fnv1a-64 finalized through splitmix64 — raw fnv
+    clusters badly on short, similar strings (node#i)."""
+    return splitmix64(fnv1a_64(data))
+
+log = logging.getLogger("veneur_tpu.forward.proxysrv")
+
+
+class HashRing:
+    """Consistent-hash ring with virtual nodes (the role of the reference's
+    stathat.com/c/consistent ring, proxy.go:603; our node hash is fnv1a-64
+    — routing placement is an internal choice, not a wire format)."""
+
+    def __init__(self, destinations: List[str], replicas: int = 128):
+        self.replicas = replicas
+        self.destinations = sorted(set(destinations))
+        self._points: List[int] = []
+        self._owners: List[str] = []
+        for dest in self.destinations:
+            for i in range(replicas):
+                h = _point(f"{dest}#{i}".encode())
+                self._points.append(h)
+                self._owners.append(dest)
+        order = sorted(range(len(self._points)),
+                       key=lambda i: self._points[i])
+        self._points = [self._points[i] for i in order]
+        self._owners = [self._owners[i] for i in order]
+
+    def get(self, key: bytes) -> Optional[str]:
+        if not self._points:
+            return None
+        h = _point(key)
+        i = bisect.bisect(self._points, h) % len(self._points)
+        return self._owners[i]
+
+
+class ProxyServer:
+    """Forward-service server that re-forwards by MetricKey hash
+    (proxysrv/server.go:273 destForMetric keyed on MetricKey.String())."""
+
+    def __init__(self, discoverer, service: str = "veneur-global",
+                 refresh_interval: float = 0.0, replicas: int = 128):
+        self.discoverer = discoverer
+        self.service = service
+        self.refresh_interval = refresh_interval
+        self.replicas = replicas
+        self._ring = HashRing([], replicas)
+        self._conns: Dict[str, ForwardClient] = {}
+        self._lock = threading.Lock()
+        self._shutdown = threading.Event()
+        self._grpc = None
+        self.port = None
+        self.forwarded = 0
+        self.errors = 0
+        self.refresh()
+
+    # -- ring maintenance ---------------------------------------------------
+    def refresh(self):
+        """proxy.go:321 RefreshDestinations, incl. keep-last-good-on-empty
+        (proxy.go:498-508) and connection cache pruning
+        (proxysrv/server.go:148-176)."""
+        try:
+            dests = self.discoverer.get_destinations_for_service(self.service)
+        except Exception as e:
+            log.warning("discovery failed: %s", e)
+            return
+        if not dests:
+            log.warning("discovery returned no hosts; keeping last ring")
+            return
+        with self._lock:
+            self._ring = HashRing(dests, self.replicas)
+            for dest in list(self._conns):
+                if dest not in self._ring.destinations:
+                    self._conns.pop(dest).close()
+
+    def _conn(self, dest: str) -> ForwardClient:
+        with self._lock:
+            if dest not in self._conns:
+                self._conns[dest] = ForwardClient(dest)
+            return self._conns[dest]
+
+    # -- forwarding ---------------------------------------------------------
+    def handle(self, metrics: List):
+        """Group by ring destination, then one SendMetrics per destination
+        (proxysrv/server.go:180-188, :286)."""
+        by_dest: Dict[str, List] = {}
+        with self._lock:
+            ring = self._ring  # immutable once built; snapshot suffices
+        for m in metrics:
+            key = f"{m.name}{m.type}{','.join(m.tags)}".encode()
+            dest = ring.get(key)
+            if dest is None:
+                self.errors += 1
+                continue
+            by_dest.setdefault(dest, []).append(m)
+        for dest, batch in by_dest.items():
+            try:
+                self._conn(dest).send_metrics(batch)
+                self.forwarded += len(batch)
+            except Exception as e:
+                self.errors += len(batch)
+                log.warning("proxy forward to %s failed: %s", dest, e)
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self, address: str = "127.0.0.1:0"):
+        self._grpc, self.port = serve(self.handle, address)
+        if self.refresh_interval > 0:
+            t = threading.Thread(target=self._refresh_loop, daemon=True)
+            t.start()
+
+    def _refresh_loop(self):
+        while not self._shutdown.wait(self.refresh_interval):
+            self.refresh()
+
+    def stop(self):
+        self._shutdown.set()
+        if self._grpc is not None:
+            self._grpc.stop(grace=1.0)
+        with self._lock:
+            for c in self._conns.values():
+                c.close()
+            self._conns.clear()

@@ -1,0 +1,67 @@
+"""gRPC bindings for the Forward service, hand-wired (no codegen plugin in
+this image). The method path `/forwardrpc.Forward/SendMetrics` and message
+types match the reference's forwardrpc/forward.proto, so this client can
+forward to a reference global veneur and this server can accept from a
+reference local one."""
+
+from __future__ import annotations
+
+import logging
+from concurrent import futures
+from typing import Callable, List
+
+import grpc
+from google.protobuf import empty_pb2
+
+from veneur_tpu.proto import forwardrpc_pb2 as fpb
+
+log = logging.getLogger("veneur_tpu.forward.rpc")
+
+METHOD = "/forwardrpc.Forward/SendMetrics"
+
+
+class ForwardClient:
+    """Forwarding client (reference flusher.go:474 forwardGRPC; single Dial
+    at Start, server.go:843-851)."""
+
+    def __init__(self, address: str):
+        self.address = address
+        self._channel = grpc.insecure_channel(address)
+        self._send = self._channel.unary_unary(
+            METHOD,
+            request_serializer=fpb.MetricList.SerializeToString,
+            response_deserializer=empty_pb2.Empty.FromString)
+
+    def send_metrics(self, metrics: List, timeout: float = 10.0) -> None:
+        self._send(fpb.MetricList(metrics=metrics), timeout=timeout)
+
+    def close(self):
+        self._channel.close()
+
+
+def make_forward_service(handler: Callable[[List], None]):
+    """A generic gRPC handler for the Forward service calling
+    `handler(metrics)` per request (the shape of reference
+    internal/forwardtest/server.go)."""
+
+    def send_metrics(request: fpb.MetricList, context):
+        handler(list(request.metrics))
+        return empty_pb2.Empty()
+
+    rpc_handler = grpc.method_handlers_generic_handler(
+        "forwardrpc.Forward",
+        {"SendMetrics": grpc.unary_unary_rpc_method_handler(
+            send_metrics,
+            request_deserializer=fpb.MetricList.FromString,
+            response_serializer=empty_pb2.Empty.SerializeToString)})
+    return rpc_handler
+
+
+def serve(handler: Callable[[List], None], address: str = "127.0.0.1:0",
+          max_workers: int = 4):
+    """Start a Forward gRPC server; returns (server, bound_port)."""
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    server.add_generic_rpc_handlers((make_forward_service(handler),))
+    port = server.add_insecure_port(address)
+    server.start()
+    return server, port

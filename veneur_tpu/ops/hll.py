@@ -109,6 +109,37 @@ def merge(a, b):
     return jnp.maximum(a, b)
 
 
+@jax.jit
+def merge_rows(registers, slot, rows):
+    """Scatter-union imported register rows into a table: the global-tier
+    HLL merge (reference worker.go:438 ImportMetricGRPC -> Set.Merge).
+    registers u8[K, R], slot i32[B] (out-of-range = drop), rows u8[B, R]."""
+    return registers.at[slot].max(rows, mode="drop")
+
+
+MAGIC = b"VHLL"
+
+
+def serialize(registers, precision: int = DEFAULT_PRECISION) -> bytes:
+    """Forwarding bytes for one key's registers (this framework's wire
+    format for metricpb.SetValue.hyper_log_log; the reference ships
+    axiomhq/hyperloglog MarshalBinary, which is implementation-defined —
+    sketch bytes only interoperate between same-impl tiers)."""
+    import numpy as np
+    return MAGIC + bytes([precision]) + np.asarray(registers, np.uint8).tobytes()
+
+
+def deserialize(data: bytes):
+    import numpy as np
+    if data[:4] != MAGIC:
+        raise ValueError("bad HLL payload")
+    precision = data[4]
+    regs = np.frombuffer(data[5:], np.uint8)
+    if regs.shape[0] != (1 << precision):
+        raise ValueError("HLL payload length mismatch")
+    return precision, regs
+
+
 @partial(jax.jit, static_argnames=("precision",))
 def estimate(registers, *, precision: int = DEFAULT_PRECISION):
     """Cardinality estimate per key: f32[...] over registers [..., R].

@@ -81,11 +81,25 @@ def sharded_empty_state(spec: TableSpec, n_replicas: int, n_shards: int,
 
 def stack_batches(batches, n_replicas: int, n_shards: int) -> Batch:
     """Stack a [R][S] nested list of per-shard Batches into one Batch with
-    leading [R, S] dims (host-side numpy; feed to the sharded ingest)."""
+    leading [R, S] dims (host-side numpy; feed to the sharded ingest).
+    Optional lanes (None, e.g. histo_stat_* on pure-ingest batches) stay
+    None — every tile must agree on which lanes are present."""
     import numpy as np
     cols = list(zip(*[list(zip(*[batches[r][s] for s in range(n_shards)]))
                       for r in range(n_replicas)]))
-    return Batch(*[np.stack([np.stack(row) for row in col]) for col in cols])
+
+    def stack(col):
+        flat = [x for row in col for x in row]
+        if all(x is None for x in flat):
+            return None
+        if any(x is None for x in flat):
+            raise ValueError(
+                "stack_batches: every tile must agree on which optional "
+                "Batch lanes are present (mixing Batcher batches with "
+                "hand-built ones?)")
+        return np.stack([np.stack(row) for row in col])
+
+    return Batch(*[stack(col) for col in cols])
 
 
 def make_sharded_ingest(mesh: Mesh, spec: TableSpec):

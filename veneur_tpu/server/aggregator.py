@@ -36,6 +36,9 @@ class Aggregator:
         self.batcher = Batcher(spec, bspec, on_batch=self._on_batch)
         self.state = empty_state(spec)
         self._steps = 0
+        # staged HLL import rows (merged via ops.hll.merge_rows)
+        self._hll_slots: list = []
+        self._hll_rows: list = []
         # stats (reference self-telemetry counters)
         self.processed = 0
         self.dropped_capacity = 0
@@ -58,6 +61,10 @@ class Aggregator:
         if slot is None:
             self.dropped_capacity += 1
             return
+        if kind in ("histogram", "timer"):
+            mt = self.table.meta_for_slot(kind, slot)
+            if mt is not None and mt.imported_only:
+                mt.imported_only = False
         if kind == "counter":
             self.batcher.add_counter(slot, float(m.value), m.sample_rate)
         elif kind == "gauge":
@@ -78,14 +85,83 @@ class Aggregator:
             self.batcher.add_histo(slot, float(m.value), m.sample_rate)
         self.processed += 1
 
+    # -- import path (global tier) ------------------------------------------
+    def import_metric(self, kind: str, name: str, tags: tuple, scope: int,
+                      digest: int, payload: dict) -> None:
+        """Merge one forwarded metric's sketch state (the reference's
+        Worker.ImportMetricGRPC switch, worker.go:438-495). payload keys by
+        kind: counter/gauge 'value'; set 'registers' (np.uint8[R]);
+        histogram/timer 'means','weights' (+ optional 'min','max','recip')."""
+        slot = self.table.slot_for(kind, name, tags, scope, digest,
+                                   imported=True)
+        if slot is None:
+            self.dropped_capacity += 1
+            return
+        if kind == "counter":
+            self.batcher.add_counter(slot, float(payload["value"]), 1.0)
+        elif kind == "gauge":
+            self.batcher.add_gauge(slot, float(payload["value"]))
+        elif kind == "set":
+            regs = payload["registers"]
+            if regs.shape[0] != self.spec.registers:
+                # peer configured with a different hll_precision; sketch
+                # registers don't interoperate across precisions
+                raise ValueError(
+                    f"imported HLL has {regs.shape[0]} registers, "
+                    f"table expects {self.spec.registers}")
+            self._hll_slots.append(slot)
+            self._hll_rows.append(regs)
+            if len(self._hll_slots) >= 128:
+                self._flush_hll_imports()
+        elif kind in ("histogram", "timer"):
+            means = np.asarray(payload["means"], np.float32)
+            weights = np.asarray(payload["weights"], np.float32)
+            # digest merge = re-add centroids (samplers.go:726 -> tdigest
+            # Merge), with the wire's exact min/max/reciprocalSum replacing
+            # the re-add's approximation: the stats lane carries the
+            # imported recip minus what the centroid re-add will add.
+            live = weights > 0
+            means, weights = means[live], weights[live]
+            for v, w in zip(means, weights):
+                self.batcher.add_histo_weighted(slot, float(v), float(w))
+            mn = float(payload.get("min", np.inf))
+            mx = float(payload.get("max", -np.inf))
+            recip = payload.get("recip")
+            recip_corr = 0.0
+            if recip is not None and np.all(means != 0.0):
+                recip_corr = float(recip) - float(np.sum(weights / means))
+            self.batcher.add_histo_stats(slot, mn, mx, recip_corr)
+        self.processed += 1
+
+    def _flush_hll_imports(self):
+        if not self._hll_slots:
+            return
+        from veneur_tpu.ops.hll import merge_rows
+        import jax.numpy as jnp
+        b = 128
+        slots = np.full(b, self.spec.set_capacity, np.int32)
+        rows = np.zeros((b, self.spec.registers), np.uint8)
+        n = min(len(self._hll_slots), b)
+        slots[:n] = self._hll_slots[:n]
+        rows[:n] = np.stack(self._hll_rows[:n])
+        self.state = self.state._replace(
+            hll=merge_rows(self.state.hll, jnp.asarray(slots),
+                           jnp.asarray(rows)))
+        self._hll_slots, self._hll_rows = (self._hll_slots[b:],
+                                           self._hll_rows[b:])
+
     # -- flush --------------------------------------------------------------
-    def flush(self, percentiles: List[float]
+    def flush(self, percentiles: List[float], want_raw: bool = False
               ) -> Tuple[Dict[str, np.ndarray], KeyTable]:
         """Map-swap (worker.go:498): detach live state+table, reset fresh,
-        then run the flush computation on the detached interval."""
+        then run the flush computation on the detached interval. With
+        want_raw, also returns the folded sketch state (numpy) for
+        forwarding serialization."""
         import jax.numpy as jnp
 
         self.batcher.emit()
+        while self._hll_slots:
+            self._flush_hll_imports()
         state, table = self.state, self.table
         self.state = empty_state(self.spec)
         self.table = KeyTable(self.spec, self.n_shards)
@@ -95,4 +171,20 @@ class Aggregator:
         state = compact(state, spec=self.spec)
         qs = jnp.asarray(percentiles or [0.5], jnp.float32)
         out = flush_compute(state, qs, spec=self.spec)
-        return {k: np.asarray(v) for k, v in out.items()}, table
+        result = {k: np.asarray(v) for k, v in out.items()}
+        if want_raw:
+            w = np.asarray(state.h_w)
+            wm = np.asarray(state.h_wm)
+            raw = {
+                "counter": result["counter"],
+                "gauge": result["gauge"],
+                "hll": np.asarray(state.hll),
+                "h_mean": np.where(w > 0, wm / np.maximum(w, 1e-30), 0.0),
+                "h_weight": w,
+                "h_min": np.asarray(state.h_min),
+                "h_max": np.asarray(state.h_max),
+                "h_recip": np.asarray(state.h_recip_hi)
+                + np.asarray(state.h_recip_lo),
+            }
+            return result, table, raw
+        return result, table

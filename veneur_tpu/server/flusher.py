@@ -94,21 +94,22 @@ def generate_intermetrics(flush: Dict[str, np.ndarray], table: KeyTable,
         if is_local and meta.scope == SCOPE_GLOBAL:
             continue
         global_flush = meta.scope == SCOPE_GLOBAL and not is_local
-        sampled = hcount[slot] > 0
-        # aggregates: suppressed when nothing was sampled locally unless this
-        # is the global=true path (samplers.go:530-655 guard clauses)
-        if sampled or global_flush:
+        has_mass = hcount[slot] > 0
+        # imported-only MIXED histos on a global tier emit percentiles only:
+        # their aggregates already flushed on the local instances
+        # (flusher.go:61-77 "avoid double counting"); global-scoped ones
+        # flush aggregates from the digest (the global=true path).
+        emit_aggs = has_mass and (not meta.imported_only or global_flush)
+        if emit_aggs:
             for agg, arr in agg_arrays.items():
                 v = arr[slot]
                 if agg in ("min", "max") and not math.isfinite(v):
-                    continue
-                if agg in ("avg", "sum", "hmean", "count") and not sampled:
                     continue
                 emit(meta, f"{meta.name}.{agg}", v,
                      AGGREGATE_FIELDS[agg][1])
         # percentiles: only where they are globally accurate — everywhere on
         # a global/standalone instance, local-only keys on a local one
-        if perc and (not is_local or meta.scope == SCOPE_LOCAL) and sampled:
+        if perc and (not is_local or meta.scope == SCOPE_LOCAL) and has_mass:
             for i, p in enumerate(perc):
                 emit(meta, f"{meta.name}.{percentile_name(p)}",
                      hq[slot, i], GAUGE)

@@ -40,6 +40,11 @@ _FLUSH = object()   # pipeline-queue sentinel: run a flush now
 _STOP = object()    # pipeline-queue sentinel: drain and exit
 
 
+class _ImportBatch(list):
+    """Queue item carrying forwarded metricpb.Metrics into the pipeline
+    thread (the ImportMetricChan of reference worker.go:55)."""
+
+
 def resolve_addr(addr: str):
     """reference protocol/addr.go:18 ResolveAddr: scheme://host:port with
     schemes udp/tcp/unix(gram)."""
@@ -98,6 +103,9 @@ class Server:
         self._threads: List[threading.Thread] = []
         self._sockets: List[socket.socket] = []
         self._flush_done = threading.Condition()
+        self._forward_client = None
+        self._grpc_server = None
+        self.grpc_port = None
 
     # -- tag exclusion wiring (server.go:1467-1510) -------------------------
     def _wire_excluded_tags(self):
@@ -148,10 +156,23 @@ class Server:
             if item is _FLUSH:
                 try:
                     self._do_flush()
+                except Exception:
+                    # a failed flush must never kill the pipeline thread;
+                    # state was already swapped, next interval starts clean
+                    log.exception("flush failed")
                 finally:
                     with self._flush_done:
                         self.flush_count += 1
                         self._flush_done.notify_all()
+                continue
+            if isinstance(item, _ImportBatch):
+                from veneur_tpu.forward.convert import import_into
+                for metric in item:
+                    try:
+                        import_into(self.aggregator, metric)
+                    except Exception as e:
+                        log.warning("bad imported metric %s: %s",
+                                    metric.name, e)
                 continue
             self._process_packets(item)
 
@@ -309,6 +330,29 @@ class Server:
             wt.start()
             self._threads.append(wt)
 
+        # global-tier import server (reference importsrv/, server.go:753-762)
+        if self.cfg.grpc_address:
+            from veneur_tpu.forward import rpc
+            _, target = resolve_addr(
+                self.cfg.grpc_address
+                if "//" in self.cfg.grpc_address
+                else f"tcp://{self.cfg.grpc_address}")
+            self._grpc_server, self.grpc_port = rpc.serve(
+                self.import_metrics, f"{target[0]}:{target[1]}")
+        # forwarding client, dialed once at start (server.go:843-851)
+        if self.cfg.is_local:
+            from veneur_tpu.forward.rpc import ForwardClient
+            addr = self.cfg.forward_address
+            for prefix in ("http://", "https://", "grpc://", "tcp://"):
+                if addr.startswith(prefix):
+                    addr = addr[len(prefix):]
+            self._forward_client = ForwardClient(addr)
+
+    def import_metrics(self, metrics: List) -> None:
+        """gRPC import entry: enqueue onto the pipeline thread
+        (importsrv/server.go:102 SendMetrics → IngestMetrics)."""
+        self.packet_queue.put(_ImportBatch(metrics))
+
     def local_addr(self, index: int = 0):
         return self._sockets[index].getsockname()
 
@@ -335,7 +379,16 @@ class Server:
     def _do_flush(self):
         self.last_flush = time.time()
         ts = int(self.last_flush)
-        flush_arrays, table = self.aggregator.flush(self.cfg.percentiles)
+        if self._forward_client is not None:
+            flush_arrays, table, raw = self.aggregator.flush(
+                self.cfg.percentiles, want_raw=True)
+            # fire-and-forget, concurrent with sink flushes
+            # (flusher.go:84-95); _forward logs and counts its own errors,
+            # and the pipeline thread must never block on a slow global tier
+            threading.Thread(target=self._forward, args=(raw, table),
+                             daemon=True).start()
+        else:
+            flush_arrays, table = self.aggregator.flush(self.cfg.percentiles)
 
         with self._event_lock:
             samples, self.event_samples = self.event_samples, []
@@ -367,6 +420,22 @@ class Server:
             except Exception as e:
                 log.warning("plugin %s flush failed: %s", p.name, e)
 
+    def _forward(self, raw, table):
+        """Serialize and ship forwardable sketch state
+        (flusher.go:474 forwardGRPC). Errors are counted, never fatal
+        (flusher.go:512-524)."""
+        from veneur_tpu.forward.convert import export_metrics
+        try:
+            metrics = export_metrics(
+                raw, table, compression=self.aggregator.spec.compression,
+                hll_precision=self.aggregator.spec.hll_precision)
+            if metrics:
+                self._forward_client.send_metrics(
+                    metrics, timeout=self.interval)
+        except Exception as e:
+            self.forward_errors = getattr(self, "forward_errors", 0) + 1
+            log.warning("forward failed: %s", e)
+
     @staticmethod
     def _flush_sink(sink, metrics: List[InterMetric]):
         try:
@@ -393,6 +462,10 @@ class Server:
                 s.close()
             except OSError:
                 pass
+        if self._grpc_server is not None:
+            self._grpc_server.stop(grace=1.0)
+        if self._forward_client is not None:
+            self._forward_client.close()
         self.packet_queue.put(_STOP)
         for t in self._threads:
             t.join(timeout=2.0)
