@@ -117,8 +117,9 @@ def test_two_locals_merge_on_global():
             _wait_processed(srv, 250)
         for srv in locals_:
             srv.trigger_flush()
+        # each local forwards one counter + one timer import
         deadline = time.time() + 10
-        while time.time() < deadline and glob.aggregator.processed < 2:
+        while time.time() < deadline and glob.aggregator.processed < 4:
             time.sleep(0.05)
         glob.trigger_flush()
         g = by_name(gsink.flushed)
