@@ -1,0 +1,166 @@
+"""SSF wire framing + span pipeline end-to-end (reference
+protocol/wire_test.go and server_test.go TestSSFMetricsEndToEnd)."""
+
+import io
+import socket
+import time
+
+import pytest
+
+from veneur_tpu.proto import ssf_pb2
+from veneur_tpu.protocol import (
+    FramingError, parse_ssf, read_ssf, valid_trace, write_ssf)
+from veneur_tpu.samplers import parser, ssf_samples
+from veneur_tpu.server.server import Server
+from veneur_tpu.sinks.debug import DebugMetricSink, DebugSpanSink
+
+from tests.test_server import by_name, small_config, _wait_processed
+
+
+def make_span(trace_id=5, span_id=6, service="svc", name="op",
+              indicator=False, error=False, metrics=(), start=1, end=2):
+    span = ssf_pb2.SSFSpan(
+        version=0, trace_id=trace_id, id=span_id, service=service,
+        name=name, indicator=indicator, error=error,
+        start_timestamp=int(start * 1e9), end_timestamp=int(end * 1e9))
+    for m in metrics:
+        span.metrics.append(m)
+    return span
+
+
+# -- wire framing ------------------------------------------------------------
+
+def test_frame_roundtrip():
+    span = make_span(metrics=[ssf_samples.count("x", 3, {"a": "b"})])
+    buf = io.BytesIO()
+    write_ssf(buf, span)
+    buf.seek(0)
+    got = read_ssf(buf)
+    assert got.trace_id == span.trace_id
+    assert got.metrics[0].name == "x"
+    assert read_ssf(buf) is None  # clean EOF
+
+
+def test_frame_bad_version_and_truncation():
+    span = make_span()
+    buf = io.BytesIO()
+    write_ssf(buf, span)
+    raw = buf.getvalue()
+    with pytest.raises(FramingError):
+        read_ssf(io.BytesIO(b"\x01" + raw[1:]))
+    with pytest.raises(FramingError):
+        read_ssf(io.BytesIO(raw[:len(raw) - 2]))
+
+
+def test_parse_ssf_name_tag_promotion_and_rate_normalization():
+    """wire_test.go / regression_test.go:27-45 name-tag promotion."""
+    span = make_span(name="")
+    span.tags["name"] = "legacy.name"
+    s = ssf_samples.count("c", 1)
+    s.sample_rate = 0.0
+    span.metrics.append(s)
+    got = parse_ssf(span.SerializeToString())
+    assert got.name == "legacy.name"
+    assert "name" not in got.tags
+    assert got.metrics[0].sample_rate == 1.0
+
+
+def test_valid_trace():
+    assert valid_trace(make_span())
+    assert not valid_trace(make_span(trace_id=0))
+    assert not valid_trace(make_span(name=""))
+
+
+# -- converters --------------------------------------------------------------
+
+def test_convert_indicator_metrics():
+    span = make_span(indicator=True, error=True, start=1.0, end=1.5)
+    ms = parser.convert_indicator_metrics(span, "veneur.sli", "veneur.obj")
+    assert len(ms) == 2
+    ind, obj = ms
+    assert ind.name == "veneur.sli"
+    # SSF has no timer type: timings ride as histograms
+    # (reference parser.go:251-252)
+    assert ind.type == "histogram"
+    assert ind.value == pytest.approx(0.5e9)  # ns
+    assert "error:true" in ind.tags and "service:svc" in ind.tags
+    assert obj.scope == parser.GLOBAL_ONLY
+    assert "objective:op" in obj.tags
+    # non-indicator spans convert to nothing
+    assert parser.convert_indicator_metrics(
+        make_span(indicator=False), "a", "b") == []
+
+
+def test_convert_uniqueness_set():
+    span = make_span()
+    ms = parser.convert_span_uniqueness_metrics(span, rate=1.0)
+    assert len(ms) == 1
+    assert ms[0].type == "set"
+    assert ms[0].name == "ssf.names_unique"
+    assert ms[0].value == "op"
+
+
+# -- end-to-end through a live server ---------------------------------------
+
+@pytest.fixture
+def ssf_server():
+    msink = DebugMetricSink()
+    ssink = DebugSpanSink()
+    srv = Server(small_config(
+        statsd_listen_addresses=[],
+        ssf_listen_addresses=["udp://127.0.0.1:0"],
+        indicator_span_timer_name="veneur.indicator",
+        objective_span_timer_name="veneur.objective"),
+        metric_sinks=[msink], span_sinks=[ssink])
+    srv.start()
+    yield srv, msink, ssink
+    srv.shutdown()
+
+
+def test_ssf_udp_end_to_end(ssf_server):
+    srv, msink, ssink = ssf_server
+    addr = srv.local_addr()
+    span = make_span(indicator=True, start=0.0, end=0.25,
+                     metrics=[ssf_samples.count("from.span", 4),
+                              ssf_samples.gauge("span.gauge", 9)])
+    span.start_timestamp = int(1e9)
+    span.end_timestamp = int(1.25e9)
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.sendto(span.SerializeToString(), addr)
+    s.close()
+    _wait_processed(srv, 2)
+    srv.trigger_flush()
+    m = by_name(msink.flushed)
+    assert m["from.span"].value == 4.0
+    assert m["span.gauge"].value == 9.0
+    # indicator SLI timer extracted (250ms in ns)
+    assert m["veneur.indicator.max"].value == pytest.approx(0.25e9, rel=1e-3)
+    # span fanned out to the span sink too
+    assert len(ssink.spans) == 1
+    assert ssink.spans[0].service == "svc"
+
+
+def test_ssf_stream_unix_end_to_end(tmp_path):
+    path = str(tmp_path / "ssf.sock")
+    msink = DebugMetricSink()
+    srv = Server(small_config(
+        statsd_listen_addresses=[],
+        ssf_listen_addresses=[f"unix://{path}"]),
+        metric_sinks=[msink])
+    srv.start()
+    try:
+        c = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        c.connect(path)
+        f = c.makefile("wb")
+        for i in range(3):
+            write_ssf(f, make_span(
+                span_id=10 + i,
+                metrics=[ssf_samples.count("stream.count", 2)]))
+        f.flush()
+        c.close()
+        _wait_processed(srv, 3)
+        srv.trigger_flush()
+        m = by_name(msink.flushed)
+        assert m["stream.count"].value == 6.0
+    finally:
+        srv.shutdown()

@@ -394,3 +394,72 @@ def parse_metric_ssf(sample: ssf_pb2.SSFSample) -> UDPMetric:
     h = _fnv_add(h, m.joined_tags.encode("utf-8", "surrogateescape"))
     m.digest = h
     return m
+
+
+def valid_metric(m: UDPMetric) -> bool:
+    """reference parser.go ValidMetric."""
+    return bool(m.name) and m.value is not None
+
+
+def convert_metrics(span):
+    """Extract the span's embedded SSF samples as UDPMetrics (reference
+    parser.go:103 ConvertMetrics). Returns (metrics, invalid_samples)."""
+    metrics, invalid = [], []
+    for sample in span.metrics:
+        try:
+            m = parse_metric_ssf(sample)
+        except ParseError:
+            invalid.append(sample)
+            continue
+        if not valid_metric(m):
+            invalid.append(sample)
+            continue
+        metrics.append(m)
+    return metrics, invalid
+
+
+def convert_indicator_metrics(span, indicator_timer_name: str,
+                              objective_timer_name: str):
+    """Indicator spans -> SLI timers (reference parser.go:129
+    ConvertIndicatorMetrics): duration as an indicator timer tagged
+    service/error, and an objective timer additionally tagged with the
+    span name (overridable via the ssf_objective tag) and
+    veneurglobalonly."""
+    from veneur_tpu.protocol.wire import valid_trace
+    from veneur_tpu.samplers import ssf_samples
+
+    if not span.indicator or not valid_trace(span):
+        return []
+    duration_s = (span.end_timestamp - span.start_timestamp) / 1e9
+    err = "true" if span.error else "false"
+    out = []
+    if indicator_timer_name:
+        t = ssf_samples.timing(indicator_timer_name, duration_s,
+                               {"service": span.service, "error": err})
+        out.append(parse_metric_ssf(t))
+    if objective_timer_name:
+        objective = span.tags.get("ssf_objective") or span.name
+        t = ssf_samples.timing(objective_timer_name, duration_s,
+                               {"service": span.service,
+                                "objective": objective,
+                                "error": err,
+                                "veneurglobalonly": "true"})
+        out.append(parse_metric_ssf(t))
+    return out
+
+
+def convert_span_uniqueness_metrics(span, rate: float = 0.01):
+    """Unique span-name Sets per service at a sampling rate (reference
+    parser.go:187 ConvertSpanUniquenessMetrics)."""
+    from veneur_tpu.samplers import ssf_samples
+
+    if not span.service:
+        return []
+    samples = ssf_samples.randomly_sample(
+        rate,
+        ssf_samples.set_("ssf.names_unique", span.name, {
+            "indicator": "true" if span.indicator else "false",
+            "service": span.service,
+            "root_span": "true" if span.id == span.trace_id else "false",
+        }))
+    return [parse_metric_ssf(s) for s in samples]

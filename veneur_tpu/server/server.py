@@ -38,11 +38,17 @@ log = logging.getLogger("veneur_tpu.server")
 
 _FLUSH = object()   # pipeline-queue sentinel: run a flush now
 _STOP = object()    # pipeline-queue sentinel: drain and exit
+MAX_UDP_SSF = 65536
 
 
 class _ImportBatch(list):
     """Queue item carrying forwarded metricpb.Metrics into the pipeline
     thread (the ImportMetricChan of reference worker.go:55)."""
+
+
+class _SpanMetricBatch(list):
+    """Queue item carrying span-extracted UDPMetrics (ssfmetrics loop-back
+    into L3, SURVEY §2.5)."""
 
 
 def resolve_addr(addr: str):
@@ -91,6 +97,24 @@ class Server:
         self.span_sinks = list(span_sinks or [])
         self.plugins = list(plugins or [])
         self._wire_excluded_tags()
+
+        # span pipeline: metric-extraction sink always first
+        # (server.go:409, ssfmetrics always prepended)
+        from veneur_tpu.server.spans import SpanPipeline
+        from veneur_tpu.sinks.ssfmetrics import MetricExtractionSink
+        extraction = MetricExtractionSink(
+            self.process_span_metrics,
+            indicator_timer_name=cfg.indicator_span_timer_name,
+            objective_timer_name=cfg.objective_span_timer_name)
+        # bare tags map to empty values (parser.go:694 ParseTagSliceToMap)
+        common_tags = {t.split(":", 1)[0]: (t.split(":", 1)[1]
+                                            if ":" in t else "")
+                       for t in cfg.tags}
+        self.span_pipeline = SpanPipeline(
+            [extraction] + self.span_sinks,
+            capacity=cfg.span_channel_capacity or 100,
+            num_workers=max(1, cfg.num_span_workers),
+            common_tags=common_tags)
 
         self.event_samples = []       # EventWorker buffer (worker.go:527)
         self._event_lock = threading.Lock()
@@ -174,6 +198,10 @@ class Server:
                         log.warning("bad imported metric %s: %s",
                                     metric.name, e)
                 continue
+            if isinstance(item, _SpanMetricBatch):
+                for m in item:
+                    self.aggregator.process_metric(m)
+                continue
             self._process_packets(item)
 
     # -- listeners ----------------------------------------------------------
@@ -192,6 +220,79 @@ class Server:
                 self.packet_queue.put(data, timeout=1.0)
             except queue.Full:
                 pass  # drop like a kernel would; counted upstream
+
+    def _ssf_udp_reader(self, sock: socket.socket):
+        """One SSF span protobuf per datagram (server.go:1125
+        ReadSSFPacketSocket -> HandleTracePacket)."""
+        from veneur_tpu.protocol.wire import parse_ssf
+        sock.settimeout(0.5)
+        while not self._shutdown.is_set():
+            try:
+                data = sock.recv(MAX_UDP_SSF)
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            if not data:
+                continue
+            try:
+                span = parse_ssf(data)
+            except Exception:
+                self.parse_errors += 1
+                continue
+            self.span_pipeline.handle_span(span)
+
+    def _ssf_stream_listener(self, sock: socket.socket):
+        """Framed SSF stream (server.go:1160 ReadSSFStreamSocket)."""
+        sock.settimeout(0.5)
+        while not self._shutdown.is_set():
+            try:
+                conn, _ = sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=self._ssf_stream_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _ssf_stream_conn(self, conn):
+        """Buffered frame reader: framing errors (bad version, oversized
+        length) poison the stream and close it (wire.go IsFramingError), but
+        a corrupt protobuf body inside a well-formed frame is recoverable —
+        the frame boundary is intact, so keep reading (server.go:1186).
+        The 0.5s recv timeout lets the thread observe shutdown."""
+        import struct
+        from veneur_tpu.protocol.wire import MAX_SSF_PACKET_LENGTH, parse_ssf
+        buf = b""
+        conn.settimeout(0.5)
+        with conn:
+            while not self._shutdown.is_set():
+                try:
+                    data = conn.recv(65536)
+                except socket.timeout:
+                    continue
+                except OSError:
+                    return
+                if not data:
+                    return
+                buf += data
+                while len(buf) >= 5:
+                    if buf[0] != 0:
+                        self.parse_errors += 1
+                        return  # unknown frame version: poisoned
+                    (length,) = struct.unpack(">I", buf[1:5])
+                    if length > MAX_SSF_PACKET_LENGTH:
+                        self.parse_errors += 1
+                        return  # oversized frame: poisoned
+                    if len(buf) < 5 + length:
+                        break
+                    body, buf = buf[5:5 + length], buf[5 + length:]
+                    try:
+                        span = parse_ssf(body)
+                    except Exception:
+                        self.parse_errors += 1
+                        continue
+                    self.span_pipeline.handle_span(span)
 
     def _tcp_listener(self, sock: socket.socket, tls_ctx):
         """reference server.go:1283 ReadTCPSocket: newline-delimited metrics
@@ -319,6 +420,40 @@ class Server:
                 rt.start()
                 self._threads.append(rt)
 
+        # SSF span listeners (networking.go:198 StartSSF)
+        self.span_pipeline.start()
+        for addr in self.cfg.ssf_listen_addresses:
+            kind, target = resolve_addr(addr)
+            if kind in ("udp", "unixgram"):
+                if kind == "udp":
+                    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+                else:
+                    sock = socket.socket(socket.AF_UNIX, socket.SOCK_DGRAM)
+                    if os.path.exists(target):
+                        os.unlink(target)
+                sock.bind(target)
+                self._sockets.append(sock)
+                rt = threading.Thread(target=self._ssf_udp_reader,
+                                      args=(sock,), daemon=True)
+                rt.start()
+                self._threads.append(rt)
+            elif kind in ("unix", "tcp"):
+                if kind == "unix":
+                    sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                    if os.path.exists(target):
+                        os.unlink(target)
+                else:
+                    sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+                    sock.setsockopt(socket.SOL_SOCKET,
+                                    socket.SO_REUSEADDR, 1)
+                sock.bind(target)
+                sock.listen(64)
+                self._sockets.append(sock)
+                lt = threading.Thread(target=self._ssf_stream_listener,
+                                      args=(sock,), daemon=True)
+                lt.start()
+                self._threads.append(lt)
+
         ft = threading.Thread(target=self._flush_ticker, daemon=True,
                               name="flush-ticker")
         ft.start()
@@ -352,6 +487,11 @@ class Server:
         """gRPC import entry: enqueue onto the pipeline thread
         (importsrv/server.go:102 SendMetrics → IngestMetrics)."""
         self.packet_queue.put(_ImportBatch(metrics))
+
+    def process_span_metrics(self, metrics: List) -> None:
+        """Extraction-sink loop-back: span-derived UDPMetrics re-enter the
+        aggregation pipeline (ssfmetrics/metrics.go:65-69 routing)."""
+        self.packet_queue.put(_SpanMetricBatch(metrics))
 
     def local_addr(self, index: int = 0):
         return self._sockets[index].getsockname()
@@ -389,6 +529,10 @@ class Server:
                              daemon=True).start()
         else:
             flush_arrays, table = self.aggregator.flush(self.cfg.percentiles)
+
+        # span sinks flush concurrently (flusher.go:56 go flushTraces)
+        threading.Thread(target=self.span_pipeline.flush,
+                         daemon=True).start()
 
         with self._event_lock:
             samples, self.event_samples = self.event_samples, []
@@ -462,6 +606,7 @@ class Server:
                 s.close()
             except OSError:
                 pass
+        self.span_pipeline.stop()
         if self._grpc_server is not None:
             self._grpc_server.stop(grace=1.0)
         if self._forward_client is not None:

@@ -1,0 +1,89 @@
+"""Span pipeline: SpanChan + SpanWorker fan-out (reference worker.go:575-719
+SpanWorker, server.go:991-1065 span intake).
+
+Each span fans out to every span sink; a span that is invalid as a trace
+AND carries no metrics is dropped (worker.go:627-640). Sink ingest runs
+with a per-sink timeout budget enforced at flush, not per span (Python
+threads can't be interrupted mid-call; the reference's 9s per-sink ingest
+timeout maps to the flush deadline here)."""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from typing import List
+
+from veneur_tpu.protocol.wire import valid_trace
+
+log = logging.getLogger("veneur_tpu.server.spans")
+
+
+class SpanPipeline:
+    def __init__(self, span_sinks: List, capacity: int = 100,
+                 num_workers: int = 1, common_tags=None):
+        self.span_sinks = list(span_sinks)
+        self.chan: "queue.Queue" = queue.Queue(maxsize=capacity)
+        self.num_workers = max(1, num_workers)
+        self.common_tags = dict(common_tags or {})
+        self.spans_received = 0
+        self.spans_dropped = 0
+        self.sink_errors = 0
+        self._threads: List[threading.Thread] = []
+        self._stop = object()
+
+    # -- intake (server.go:1022 handleSSF) ----------------------------------
+    def handle_span(self, span) -> bool:
+        """Enqueue; returns False when the channel is full (the reference
+        blocks; we drop + count to protect the UDP readers)."""
+        self.spans_received += 1
+        try:
+            self.chan.put_nowait(span)
+            return True
+        except queue.Full:
+            self.spans_dropped += 1
+            return False
+
+    # -- workers (worker.go:611 SpanWorker.Work) ----------------------------
+    def start(self):
+        for i in range(self.num_workers):
+            t = threading.Thread(target=self._work, daemon=True,
+                                 name=f"span-worker-{i}")
+            t.start()
+            self._threads.append(t)
+
+    def _work(self):
+        while True:
+            span = self.chan.get()
+            if span is self._stop:
+                return
+            # tag with commonTags without clobbering span tags
+            # (worker.go:619-626)
+            for k, v in self.common_tags.items():
+                if k not in span.tags:
+                    span.tags[k] = v
+            # drop spans that are invalid traces and carry no metrics
+            if not valid_trace(span) and not span.metrics:
+                self.spans_dropped += 1
+                continue
+            for sink in self.span_sinks:
+                try:
+                    sink.ingest(span)
+                except Exception as e:
+                    self.sink_errors += 1
+                    log.warning("span sink %s ingest failed: %s",
+                                sink.name, e)
+
+    def flush(self):
+        """worker.go:698 SpanWorker.Flush: flush every span sink."""
+        for sink in self.span_sinks:
+            try:
+                sink.flush()
+            except Exception as e:
+                log.warning("span sink %s flush failed: %s", sink.name, e)
+
+    def stop(self):
+        for _ in self._threads:
+            self.chan.put(self._stop)
+        for t in self._threads:
+            t.join(timeout=2.0)
