@@ -1,0 +1,72 @@
+"""Count-min sketch guarantees + heavy-hitter extraction."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from veneur_tpu.ops import countmin as cm
+
+
+def test_never_underestimates_and_eps_bound():
+    rng = np.random.default_rng(0)
+    width, depth = 1 << 12, 4
+    counters = cm.empty_counters(depth, width)
+    # zipf-ish: item i appears ~ 1/i
+    items = []
+    for i in range(500):
+        items.extend([f"tag{i}".encode()] * max(1, 500 // (i + 1)))
+    rng.shuffle(items)
+    true = {}
+    for it in items:
+        true[it] = true.get(it, 0) + 1
+    for i in range(0, len(items), 256):
+        chunk = items[i:i + 256]
+        cols = cm.columns_for_batch(chunk, depth, width)
+        counters = cm.insert_batch(counters, jnp.asarray(cols),
+                                   jnp.ones(len(chunk), jnp.float32))
+    uniq = sorted(true)
+    cols = cm.columns_for_batch(uniq, depth, width)
+    est = np.asarray(cm.estimate(counters, jnp.asarray(cols)))
+    n = len(items)
+    eps = np.e / width
+    for u, e in zip(uniq, est):
+        assert e >= true[u] - 1e-3          # one-sided
+        assert e <= true[u] + 3 * eps * n   # within error budget
+
+
+def test_padding_dropped():
+    counters = cm.empty_counters(2, 16)
+    cols = jnp.asarray([[1, 2], [-1, -1]], jnp.int32)
+    counters = cm.insert_batch(counters, cols,
+                               jnp.asarray([5.0, 7.0], jnp.float32))
+    assert float(counters.sum()) == 10.0  # only the valid row, both depths
+    est = np.asarray(cm.estimate(counters, cols))
+    assert est[0] == 5.0
+    assert est[1] == 0.0
+
+
+def test_merge_is_additive():
+    a = cm.empty_counters(2, 32)
+    b = cm.empty_counters(2, 32)
+    cols = jnp.asarray(cm.columns_for_batch([b"x"], 2, 32))
+    a = cm.insert_batch(a, cols, jnp.asarray([3.0], jnp.float32))
+    b = cm.insert_batch(b, cols, jnp.asarray([4.0], jnp.float32))
+    m = cm.merge(a, b)
+    assert float(np.asarray(cm.estimate(m, cols))[0]) == 7.0
+
+
+def test_heavy_hitters_find_true_top():
+    rng = np.random.default_rng(1)
+    hh = cm.HeavyHitters(k=5, width=1 << 12)
+    # 5 heavy tags + long tail of singletons
+    stream = []
+    for i in range(5):
+        stream.extend([f"heavy{i}".encode()] * (400 - 50 * i))
+    stream.extend(f"tail{i}".encode() for i in range(2000))
+    rng.shuffle(stream)
+    for i in range(0, len(stream), 512):
+        hh.update(stream[i:i + 512])
+    top = [m for m, _ in hh.top(5)]
+    assert set(top) == {f"heavy{i}".encode() for i in range(5)}
+    # ordered by frequency
+    assert top[0] == b"heavy0"

@@ -1,0 +1,146 @@
+"""HTTP API, trace client backends, and the self-telemetry loop."""
+
+import socket
+import time
+import urllib.request
+import zlib
+
+import pytest
+
+from veneur_tpu.proto import forwardrpc_pb2 as fpb
+from veneur_tpu.proto import metricpb_pb2 as mpb
+from veneur_tpu.samplers import ssf_samples
+from veneur_tpu.server.server import Server
+from veneur_tpu.sinks.debug import DebugMetricSink
+from veneur_tpu.trace.client import (
+    Client, PacketBackend, StreamBackend, report_batch)
+from veneur_tpu.trace.tracer import Span, Tracer
+
+from tests.test_server import by_name, small_config, _send_udp, _wait_processed
+
+
+@pytest.fixture
+def http_server():
+    sink = DebugMetricSink()
+    srv = Server(small_config(http_address="127.0.0.1:0", http_quit=True),
+                 metric_sinks=[sink])
+    srv.start()
+    yield srv, sink
+    srv.shutdown()
+
+
+def _get(srv, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.http_port}{path}", timeout=5) as r:
+        return r.status, r.read()
+
+
+def test_healthcheck_version_stats(http_server):
+    srv, _ = http_server
+    assert _get(srv, "/healthcheck") == (200, b"ok")
+    code, body = _get(srv, "/version")
+    assert code == 200 and body
+    code, body = _get(srv, "/stats")
+    assert code == 200 and b"packets_received" in body
+    with pytest.raises(urllib.error.HTTPError):
+        _get(srv, "/nope")
+
+
+def test_http_import_deflate(http_server):
+    srv, sink = http_server
+    m = mpb.Metric(name="http.imported", type=mpb.Counter, scope=mpb.Global)
+    m.counter.value = 11
+    body = zlib.compress(fpb.MetricList(metrics=[m]).SerializeToString())
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.http_port}/import", data=body,
+        method="POST", headers={"Content-Encoding": "deflate"})
+    with urllib.request.urlopen(req, timeout=5) as r:
+        assert r.status == 200
+    deadline = time.time() + 5
+    while time.time() < deadline and srv.aggregator.processed < 1:
+        time.sleep(0.02)
+    srv.trigger_flush()
+    assert by_name(sink.flushed)["http.imported"].value == 11.0
+
+
+def test_trace_client_packet_backend_to_server():
+    """Client -> UDP SSF listener -> extraction -> flush."""
+    sink = DebugMetricSink()
+    srv = Server(small_config(statsd_listen_addresses=[],
+                              ssf_listen_addresses=["udp://127.0.0.1:0"]),
+                 metric_sinks=[sink])
+    srv.start()
+    try:
+        client = Client(PacketBackend(srv.local_addr()))
+        span = Span("op", service="svc")
+        span.add(ssf_samples.count("traced.count", 3))
+        span.client_finish(client)
+        report_batch(client, [ssf_samples.gauge("reported.gauge", 8)])
+        client.flush()
+        _wait_processed(srv, 2)
+        srv.trigger_flush()
+        m = by_name(sink.flushed)
+        assert m["traced.count"].value == 3.0
+        assert m["reported.gauge"].value == 8.0
+        client.close()
+    finally:
+        srv.shutdown()
+
+
+def test_trace_client_stream_backend(tmp_path):
+    path = str(tmp_path / "trace.sock")
+    sink = DebugMetricSink()
+    srv = Server(small_config(statsd_listen_addresses=[],
+                              ssf_listen_addresses=[f"unix://{path}"]),
+                 metric_sinks=[sink])
+    srv.start()
+    try:
+        client = Client(StreamBackend(path))
+        for i in range(4):
+            report_batch(client,
+                         [ssf_samples.count("stream.traced", 1)])
+        client.flush()
+        _wait_processed(srv, 4)
+        srv.trigger_flush()
+        assert by_name(sink.flushed)["stream.traced"].value == 4.0
+        client.close()
+    finally:
+        srv.shutdown()
+
+
+def test_tracer_header_propagation():
+    t = Tracer(service="api")
+    parent = t.start_span("parent")
+    headers = {}
+    parent.inject(headers)
+    child = t.extract(headers, name="child")
+    assert child.trace_id == parent.trace_id
+    assert child.parent_id == parent.id
+    ssf_span = child.finish()
+    assert ssf_span.trace_id == parent.trace_id
+
+
+def test_self_telemetry_loop():
+    """Flush self-metrics re-enter the pipeline and flush next interval
+    (server.go:309-313 channel client loop)."""
+    sink = DebugMetricSink()
+    srv = Server(small_config(), metric_sinks=[sink])
+    srv.start()
+    try:
+        _send_udp(srv.local_addr(), [b"app.metric:1|c"])
+        _wait_processed(srv, 1)
+        srv.trigger_flush()
+        # the self-report rides the span pipeline; give it a beat
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            srv.trigger_flush()
+            names = set(by_name(sink.flushed))
+            if any(n.startswith("veneur.flush.") for n in names):
+                break
+            time.sleep(0.05)
+        names = set(by_name(sink.flushed))
+        assert any(n.startswith("veneur.flush.total_duration_ns")
+                   for n in names), names
+        assert "veneur.worker.metrics_processed_total" in names
+    finally:
+        srv.shutdown()

@@ -37,6 +37,7 @@ def main(argv=None):
 
     from veneur_tpu.server.factory import new_from_config
     server = new_from_config(cfg)
+    server.exit_on_quit = True  # /quitquitquit ends the daemon process
     server.start()
     logging.getLogger("veneur_tpu").info(
         "veneur-tpu started: listeners=%s interval=%ss backend=%s",

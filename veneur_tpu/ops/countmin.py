@@ -1,0 +1,133 @@
+"""Count-min sketch: heavy-hitter counting for unbounded tag cardinality.
+
+No reference counterpart — this is the new sketch kernel BASELINE config 5
+calls for (10M-tag SSF span firehose → top-K tag frequencies). Same
+TPU-native shape as the other sketches (SURVEY §2.9): strings hash on the
+host, the device holds a fixed [depth, width] counter table updated by one
+batched scatter-add per ingest step, and estimates are a min-reduce over
+depth gathered rows.
+
+Guarantee (Cormode & Muthukrishnan): estimate >= true count, and
+estimate <= true + eps*N with probability 1-delta for width >= e/eps,
+depth >= ln(1/delta).
+"""
+
+from __future__ import annotations
+
+
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from veneur_tpu.utils.hashing import fnv1a_64, splitmix64
+
+DEFAULT_DEPTH = 4
+DEFAULT_WIDTH = 1 << 16
+
+
+def _check_width(width: int):
+    if width & (width - 1) or width <= 0:
+        raise ValueError(f"count-min width must be a power of two, "
+                         f"got {width} (column hashing masks low bits)")
+
+
+def empty_counters(depth: int = DEFAULT_DEPTH, width: int = DEFAULT_WIDTH):
+    _check_width(width)
+    return jnp.zeros((depth, width), jnp.float32)
+
+
+def columns_for(member: bytes, depth: int = DEFAULT_DEPTH,
+                width: int = DEFAULT_WIDTH) -> np.ndarray:
+    """Host-side: the D column indices for one item. One 64-bit base hash,
+    re-mixed per row — independent-enough row hashes without rehashing the
+    bytes D times."""
+    h = fnv1a_64(member)
+    return np.asarray(
+        [splitmix64(h ^ (0x9E3779B97F4A7C15 * (d + 1))) & (width - 1)
+         for d in range(depth)], np.int64).astype(np.int32)
+
+
+def columns_for_batch(members: List[bytes], depth: int = DEFAULT_DEPTH,
+                      width: int = DEFAULT_WIDTH) -> np.ndarray:
+    return np.stack([columns_for(m, depth, width) for m in members])
+
+
+@jax.jit
+def insert_batch(counters, cols, weights):
+    """counters f32[D, W], cols i32[B, D] (negative = padding, dropped),
+    weights f32[B]. One flattened scatter-add for all D rows."""
+    d, w = counters.shape
+    b = cols.shape[0]
+    rows = jnp.arange(d, dtype=jnp.int32)[None, :]        # [1, D]
+    flat = jnp.where(cols >= 0, rows * w + cols, d * w)   # [B, D]
+    upd = jnp.broadcast_to(weights[:, None], (b, d))
+    out = counters.reshape(-1).at[flat.reshape(-1)].add(
+        upd.reshape(-1), mode="drop")
+    return out.reshape(d, w)
+
+
+@jax.jit
+def estimate(counters, cols):
+    """Point estimates: min over depth of the gathered cells.
+    counters f32[D, W], cols i32[B, D] -> f32[B]."""
+    d = counters.shape[0]
+    rows = jnp.arange(d, dtype=jnp.int32)[None, :]
+    vals = counters[rows, jnp.maximum(cols, 0)]           # [B, D]
+    return jnp.where((cols >= 0).all(axis=1), vals.min(axis=1), 0.0)
+
+
+@jax.jit
+def merge(a, b):
+    """Sketch union: counter-wise sum (mergeable like the other sketches —
+    the global tier adds tables)."""
+    return a + b
+
+
+class HeavyHitters:
+    """Host-side top-K tracking over a device sketch.
+
+    Each batch: insert on device, estimate the batch's own items on device,
+    then keep a bounded dict of the highest-estimate members (pruned to
+    2K when it exceeds 4K). The sketch's one-sided error makes this a
+    superset-biased top-K, which is the standard CMS heavy-hitter
+    construction."""
+
+    def __init__(self, k: int = 100, depth: int = DEFAULT_DEPTH,
+                 width: int = DEFAULT_WIDTH):
+        self.k = k
+        self.depth = depth
+        self.width = width
+        self.counters = empty_counters(depth, width)
+        self.candidates: Dict[bytes, float] = {}
+        self.total = 0.0
+
+    def update(self, members: List[bytes],
+               weights: np.ndarray = None) -> None:
+        if not members:
+            return
+        cols = columns_for_batch(members, self.depth, self.width)
+        w = (np.ones(len(members), np.float32) if weights is None
+             else np.asarray(weights, np.float32))
+        self.counters = insert_batch(self.counters, jnp.asarray(cols),
+                                     jnp.asarray(w))
+        self.total += float(w.sum())
+        est = np.asarray(estimate(self.counters, jnp.asarray(cols)))
+        for m, e in zip(members, est):
+            self.candidates[m] = float(e)
+        if len(self.candidates) > 4 * self.k:
+            self._prune()
+
+    def _prune(self):
+        keep = sorted(self.candidates.items(), key=lambda kv: -kv[1])
+        self.candidates = dict(keep[:2 * self.k])
+
+    def top(self, k: int = None) -> List[Tuple[bytes, float]]:
+        k = k or self.k
+        return sorted(self.candidates.items(), key=lambda kv: -kv[1])[:k]
+
+    def reset(self):
+        self.counters = empty_counters(self.depth, self.width)
+        self.candidates.clear()
+        self.total = 0.0

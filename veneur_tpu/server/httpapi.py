@@ -1,0 +1,104 @@
+"""HTTP API (reference http.go:21-59 Handler + handlers_global.go).
+
+Endpoints: GET /healthcheck, GET /version, GET /builddate, POST /import,
+optional POST/GET /quitquitquit (gated on http_quit, server.go:80).
+
+/import accepts a protobuf forwardrpc.MetricList body (optionally
+zlib-deflated, matching the reference's deflate support,
+handlers_global.go:134-146). The reference's HTTP-era JSON+gob payload is
+Go-specific (encoding/gob) and is not portable; the protobuf body carries
+identical information through the same import path as gRPC.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import logging
+import threading
+import zlib
+
+from veneur_tpu import __version__ as VERSION
+
+log = logging.getLogger("veneur_tpu.server.http")
+
+BUILD_DATE = "dev"
+
+
+def start_http_server(server, address) -> "http.server.ThreadingHTTPServer":
+    """Mount the API for a veneur_tpu.server.Server; returns the running
+    ThreadingHTTPServer (its .server_address has the bound port)."""
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):
+            log.debug(fmt, *args)
+
+        def _reply(self, code, body=b"", ctype="text/plain"):
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/healthcheck":
+                self._reply(200, b"ok")
+            elif self.path == "/version":
+                self._reply(200, VERSION.encode())
+            elif self.path == "/builddate":
+                self._reply(200, BUILD_DATE.encode())
+            elif self.path == "/stats":
+                body = json.dumps({
+                    "packets_received": server.packets_received,
+                    "parse_errors": server.parse_errors,
+                    "processed": server.aggregator.processed,
+                    "flush_count": server.flush_count,
+                    "spans_received": server.span_pipeline.spans_received,
+                    "spans_dropped": server.span_pipeline.spans_dropped,
+                }).encode()
+                self._reply(200, body, "application/json")
+            elif self.path == "/quitquitquit" and server.cfg.http_quit:
+                self._quit()
+            else:
+                self._reply(404, b"not found")
+
+        def do_POST(self):
+            if self.path == "/import":
+                length = int(self.headers.get("Content-Length", "0"))
+                body = self.rfile.read(length)
+                if self.headers.get("Content-Encoding") == "deflate":
+                    try:
+                        body = zlib.decompress(body)
+                    except zlib.error:
+                        self._reply(400, b"bad deflate body")
+                        return
+                from veneur_tpu.proto import forwardrpc_pb2 as fpb
+                try:
+                    mlist = fpb.MetricList.FromString(body)
+                except Exception:
+                    self._reply(400, b"bad MetricList protobuf")
+                    return
+                server.import_metrics(list(mlist.metrics))
+                self._reply(200, b"imported")
+            elif self.path == "/quitquitquit" and server.cfg.http_quit:
+                self._quit()
+            else:
+                self._reply(404, b"not found")
+
+        def _quit(self):
+            self._reply(200, b"bye")
+
+            def stop():
+                server.shutdown()
+                if getattr(server, "exit_on_quit", False):
+                    import os
+                    os._exit(0)  # graceful-exit endpoint ends the process
+
+            threading.Thread(target=stop, daemon=True).start()
+
+    httpd = http.server.ThreadingHTTPServer(address, Handler)
+    httpd.daemon_threads = True
+    t = threading.Thread(target=httpd.serve_forever, daemon=True,
+                         name="http-api")
+    t.start()
+    return httpd

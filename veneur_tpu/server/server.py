@@ -116,6 +116,13 @@ class Server:
             num_workers=max(1, cfg.num_span_workers),
             common_tags=common_tags)
 
+        # self-telemetry: a channel trace client into our own span pipeline
+        # (trace.NewChannelClient, server.go:309-313) — self-spans re-enter
+        # the pipeline and are extracted back to metrics by ssfmetrics
+        from veneur_tpu.trace.client import ChannelBackend, Client
+        self.trace_client = Client(ChannelBackend(self.span_pipeline))
+        self._last_stats = {}
+
         self.event_samples = []       # EventWorker buffer (worker.go:527)
         self._event_lock = threading.Lock()
         self.packet_queue: "queue.Queue" = queue.Queue(maxsize=4096)
@@ -130,6 +137,8 @@ class Server:
         self._forward_client = None
         self._grpc_server = None
         self.grpc_port = None
+        self._httpd = None
+        self.http_port = None
 
     # -- tag exclusion wiring (server.go:1467-1510) -------------------------
     def _wire_excluded_tags(self):
@@ -465,6 +474,18 @@ class Server:
             wt.start()
             self._threads.append(wt)
 
+        # HTTP API (reference server.go:1303 Serve + http.go Handler)
+        if self.cfg.http_address:
+            from veneur_tpu.server.httpapi import start_http_server
+            kind, target = resolve_addr(
+                self.cfg.http_address if "//" in self.cfg.http_address
+                else f"tcp://{self.cfg.http_address}")
+            if kind != "tcp":
+                raise ValueError(
+                    f"http_address must be tcp, got {self.cfg.http_address!r}")
+            self._httpd = start_http_server(self, target)
+            self.http_port = self._httpd.server_address[1]
+
         # global-tier import server (reference importsrv/, server.go:753-762)
         if self.cfg.grpc_address:
             from veneur_tpu.forward import rpc
@@ -518,6 +539,7 @@ class Server:
 
     def _do_flush(self):
         self.last_flush = time.time()
+        flush_t0 = time.perf_counter()
         ts = int(self.last_flush)
         if self._forward_client is not None:
             flush_arrays, table, raw = self.aggregator.flush(
@@ -563,6 +585,33 @@ class Server:
                 p.flush(final)
             except Exception as e:
                 log.warning("plugin %s flush failed: %s", p.name, e)
+        self._report_self_metrics(len(final), time.perf_counter() - flush_t0)
+
+    def _report_self_metrics(self, n_flushed: int, flush_seconds: float):
+        """Every stage emits self-metrics through the pipeline itself
+        (SURVEY §5: worker counts worker.go:513, flush totals
+        flusher.go:300-336), as deltas per interval."""
+        from veneur_tpu.samplers import ssf_samples
+        from veneur_tpu.trace.client import report_batch
+
+        cur = {"veneur.packets_received_total": self.packets_received,
+               "veneur.parse_errors_total": self.parse_errors,
+               "veneur.worker.metrics_processed_total":
+                   self.aggregator.processed + 0,
+               "veneur.worker.metrics_dropped_total":
+                   self.aggregator.dropped_capacity,
+               "veneur.spans_received_total":
+                   self.span_pipeline.spans_received}
+        samples = [ssf_samples.timing("veneur.flush.total_duration_ns",
+                                      flush_seconds),
+                   ssf_samples.gauge("veneur.flush.metrics_total",
+                                     n_flushed)]
+        for name, total in cur.items():
+            delta = total - self._last_stats.get(name, 0)
+            self._last_stats[name] = total
+            if delta:
+                samples.append(ssf_samples.count(name, delta))
+        report_batch(self.trace_client, samples)
 
     def _forward(self, raw, table):
         """Serialize and ship forwardable sketch state
@@ -606,7 +655,11 @@ class Server:
                 s.close()
             except OSError:
                 pass
+        self.trace_client.close()
         self.span_pipeline.stop()
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()  # release the listening fd
         if self._grpc_server is not None:
             self._grpc_server.stop(grace=1.0)
         if self._forward_client is not None:

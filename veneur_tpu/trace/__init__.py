@@ -1,0 +1,7 @@
+from veneur_tpu.trace.client import (  # noqa: F401
+    ChannelBackend,
+    Client,
+    PacketBackend,
+    StreamBackend,
+)
+from veneur_tpu.trace.tracer import Span, Tracer  # noqa: F401

@@ -1,0 +1,108 @@
+"""Span construction + a minimal tracer (reference trace/trace.go span
+lifecycle and trace/opentracing.go header inject/extract).
+
+The reference exposes a full OpenTracing adapter; the API here covers the
+parts Veneur itself uses: StartSpan/start_span_from_context, tags,
+ClientFinish, and HTTP header propagation (trace id / span id headers,
+opentracing.go textmap carrier)."""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Dict, Optional
+
+from veneur_tpu.proto import ssf_pb2
+
+HEADER_TRACE_ID = "Trace-Id"
+HEADER_SPAN_ID = "Span-Id"
+
+
+def _new_id() -> int:
+    return random.getrandbits(63) | 1
+
+
+class Span:
+    def __init__(self, name: str, service: str = "",
+                 trace_id: Optional[int] = None,
+                 parent_id: Optional[int] = None,
+                 indicator: bool = False, tags: Optional[Dict] = None):
+        self.name = name
+        self.service = service
+        self.trace_id = trace_id or _new_id()
+        self.id = _new_id()
+        self.parent_id = parent_id or 0
+        self.indicator = indicator
+        self.error = False
+        self.tags = dict(tags or {})
+        self.start_ns = int(time.time() * 1e9)
+        self.end_ns = 0
+        self.samples = []
+
+    def set_tag(self, k: str, v: str):
+        self.tags[k] = str(v)
+
+    def add(self, *samples):
+        """Attach SSF metric samples to ride along with the span
+        (trace.go Span.Add)."""
+        self.samples.extend(samples)
+
+    def child(self, name: str, **kw) -> "Span":
+        return Span(name, service=self.service, trace_id=self.trace_id,
+                    parent_id=self.id, **kw)
+
+    def finish(self) -> ssf_pb2.SSFSpan:
+        self.end_ns = int(time.time() * 1e9)
+        return self.to_ssf()
+
+    def to_ssf(self) -> ssf_pb2.SSFSpan:
+        span = ssf_pb2.SSFSpan(
+            version=0, trace_id=self.trace_id, id=self.id,
+            parent_id=self.parent_id, service=self.service, name=self.name,
+            indicator=self.indicator, error=self.error,
+            start_timestamp=self.start_ns,
+            end_timestamp=self.end_ns or int(time.time() * 1e9))
+        for k, v in self.tags.items():
+            span.tags[k] = v
+        for s in self.samples:
+            span.metrics.append(s)
+        return span
+
+    def client_finish(self, client) -> None:
+        """finish + record on the trace client (trace.go ClientFinish)."""
+        ssf_span = self.finish()
+        if client is not None:
+            client.record(ssf_span)
+
+    # -- header propagation (opentracing.go inject/extract) -----------------
+    def inject(self, headers: Dict[str, str]) -> None:
+        headers[HEADER_TRACE_ID] = str(self.trace_id)
+        headers[HEADER_SPAN_ID] = str(self.id)
+
+
+class Tracer:
+    def __init__(self, service: str = "", client=None):
+        self.service = service
+        self.client = client
+
+    def start_span(self, name: str, parent: Optional[Span] = None,
+                   **kw) -> Span:
+        if parent is not None:
+            s = parent.child(name, **kw)
+        else:
+            s = Span(name, service=self.service, **kw)
+        return s
+
+    def extract(self, headers: Dict[str, str],
+                name: str = "request") -> Span:
+        """Continue a trace from incoming HTTP headers; malformed ids fall
+        back to a fresh trace (headers are caller-controlled)."""
+        def _id(key):
+            try:
+                return int(headers.get(key, 0) or 0) or None
+            except (TypeError, ValueError):
+                return None
+
+        return Span(name, service=self.service,
+                    trace_id=_id(HEADER_TRACE_ID),
+                    parent_id=_id(HEADER_SPAN_ID))
