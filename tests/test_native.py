@@ -1,0 +1,194 @@
+"""Native C++ ingest engine: parity with the Python parser + key table.
+
+Rung 1.5 of the test strategy (SURVEY §4): kernel-vs-reference parity on
+the same inputs."""
+
+import numpy as np
+import pytest
+
+from veneur_tpu.aggregation.host import Batcher, BatchSpec, KeyTable
+from veneur_tpu.aggregation.state import TableSpec
+from veneur_tpu.samplers import parser
+from veneur_tpu import native
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native engine not buildable")
+
+SPEC = TableSpec(counter_capacity=128, gauge_capacity=64,
+                 status_capacity=16, set_capacity=32, histo_capacity=64)
+BSPEC = BatchSpec(counter=256, gauge=128, status=16, set=64, histo=256)
+
+
+def mk():
+    return native.NativeIngest(SPEC, BSPEC)
+
+
+def emit_arrays():
+    return (np.full(BSPEC.counter, SPEC.counter_capacity, np.int32),
+            np.zeros(BSPEC.counter, np.float32),
+            np.full(BSPEC.gauge, SPEC.gauge_capacity, np.int32),
+            np.zeros(BSPEC.gauge, np.float32),
+            np.full(BSPEC.set, SPEC.set_capacity, np.int32),
+            np.zeros(BSPEC.set, np.int32),
+            np.zeros(BSPEC.set, np.uint8),
+            np.full(BSPEC.histo, SPEC.histo_capacity, np.int32),
+            np.zeros(BSPEC.histo, np.float32),
+            np.zeros(BSPEC.histo, np.float32))
+
+
+GOOD_PACKETS = [
+    b"a.b.c:1|c",
+    b"a.b.c:2.5|c|@0.5",
+    b"gauge.x:-3.25|g",
+    b"timer.t:101.5|ms",
+    b"histo.h:7|h",
+    b"dist.d:8|d",
+    b"set.s:user-42|s",
+    b"tagged:1|c|#env:prod,team:infra",
+    b"tagged:1|c|#team:infra,env:prod",      # same key, different order
+    b"scoped:4|g|#veneurlocalonly",
+    b"scoped2:4|g|#a:b,veneurglobalonly,z:y",
+    b"rate.tags:9|ms|@0.25|#k:v",
+    b"tags.rate:9|ms|#k:v|@0.25",
+]
+
+BAD_PACKETS = [
+    b"nocolon|c",
+    b":1|c",
+    b"novalue:|c",
+    b"noname:1",
+    b"x:1|",
+    b"x:1|q",
+    b"x:abc|c",
+    b"x:1_0|c",
+    b"x: 1|c",
+    b"x:1 |c",
+    b"x:inf|c",
+    b"x:nan|g",
+    b"x:0x1p3|c",
+    b"x:1|c|@2",
+    b"x:1|c|@0",
+    b"x:1|c|@0.5|@0.5",
+    b"x:1|c|#a:b|#c:d",
+    b"x:1|c|",
+    b"x:1|c||#a:b",
+    b"x:1|c|zzz",
+]
+
+
+def test_parse_parity_good():
+    """Every accepted packet lands in the same (kind, slot) as the Python
+    KeyTable fed by the Python parser, with identical staged values."""
+    eng = mk()
+    table = KeyTable(SPEC)
+    batcher = Batcher(SPEC, BSPEC)
+    for pkt in GOOD_PACKETS:
+        eng.feed(pkt)
+        m = parser.parse_metric(pkt)
+        slot = table.slot_for(m.type, m.name, m.tags, m.scope, m.digest)
+        if m.type == "counter":
+            batcher.add_counter(slot, m.value, m.sample_rate)
+        elif m.type == "gauge":
+            batcher.add_gauge(slot, m.value)
+        elif m.type == "set":
+            batcher.add_set(slot, str(m.value).encode())
+        else:
+            batcher.add_histo(slot, m.value, m.sample_rate)
+
+    arrays = emit_arrays()
+    nc, ng, ns, nh = eng.emit_into(arrays)
+    (c_slot, c_inc, g_slot, g_val, s_slot, s_reg, s_rho,
+     h_slot, h_val, h_wt) = arrays
+    assert (nc, ng, ns, nh) == (batcher.nc, batcher.ng, batcher.ns,
+                                batcher.nh)
+    np.testing.assert_array_equal(c_slot[:nc], batcher.c_slot[:nc])
+    np.testing.assert_allclose(c_inc[:nc], batcher.c_inc[:nc], rtol=1e-6)
+    np.testing.assert_array_equal(g_slot[:ng], batcher.g_slot[:ng])
+    np.testing.assert_allclose(g_val[:ng], batcher.g_val[:ng])
+    np.testing.assert_array_equal(s_slot[:ns], batcher.s_slot[:ns])
+    np.testing.assert_array_equal(s_reg[:ns], batcher.s_reg[:ns])
+    np.testing.assert_array_equal(s_rho[:ns], batcher.s_rho[:ns])
+    np.testing.assert_array_equal(h_slot[:nh], batcher.h_slot[:nh])
+    np.testing.assert_allclose(h_val[:nh], batcher.h_val[:nh])
+    np.testing.assert_allclose(h_wt[:nh], batcher.h_wt[:nh])
+
+    # key metadata parity: same names/scopes/tags in same slots
+    native_keys = {(k, s): (sc, n, t)
+                   for k, s, sc, n, t in eng.drain_new_keys()}
+    for kind_name in ("counter", "gauge", "set", "histogram"):
+        for slot, meta in table.get_meta(kind_name):
+            nk = native_keys[(meta.kind, slot)]
+            assert nk[0] == meta.scope
+            assert nk[1] == meta.name
+            assert nk[2] == ",".join(meta.tags)
+
+
+def test_parse_parity_bad():
+    eng = mk()
+    for pkt in BAD_PACKETS:
+        with pytest.raises(parser.ParseError):
+            parser.parse_metric(pkt)
+        eng.feed(pkt)
+    assert eng.stats()["parse_errors"] == len(BAD_PACKETS)
+    assert eng.stats()["processed"] == 0
+
+
+def test_randomized_digest_parity():
+    """Randomized packets: the C++ fnv1a digest and sharding must place
+    keys exactly where the Python path does (2-shard table)."""
+    rng = np.random.default_rng(9)
+    eng = native.NativeIngest(SPEC, BSPEC, n_shards=2)
+    table = KeyTable(SPEC, n_shards=2)
+    for i in range(200):
+        name = f"m{rng.integers(0, 50)}.{rng.integers(0, 4)}"
+        ntags = rng.integers(0, 4)
+        tags = [f"t{rng.integers(0, 5)}:v{rng.integers(0, 3)}"
+                for _ in range(ntags)]
+        typ = ["c", "g", "ms", "h", "s"][rng.integers(0, 5)]
+        val = "x" if typ == "s" else f"{rng.uniform(0, 100):.3f}"
+        pkt = f"{name}:{val}|{typ}"
+        if tags:
+            pkt += "|#" + ",".join(tags)
+        pkt_b = pkt.encode()
+        eng.feed(pkt_b)
+        m = parser.parse_metric(pkt_b)
+        table.slot_for(m.type, m.name, m.tags, m.scope, m.digest)
+    native_keys = {(k, s) for k, s, _, _, _ in eng.drain_new_keys()}
+    python_keys = set()
+    for kind_name in ("counter", "gauge", "set", "histogram"):
+        for slot, meta in table.get_meta(kind_name):
+            python_keys.add((meta.kind, slot))
+    assert native_keys == python_keys
+
+
+def test_specials_escalated():
+    eng = mk()
+    eng.feed(b"_e{5,5}:hello|world\n_sc|chk|1\nplain:1|c")
+    assert eng.drain_specials() == [b"_e{5,5}:hello|world", b"_sc|chk|1"]
+    assert eng.stats()["processed"] == 1
+
+
+def test_batch_full_backpressure():
+    eng = mk()
+    lines = b"\n".join(b"k%d:1|c" % (i % 100)
+                       for i in range(BSPEC.counter + 10))
+    full = eng.feed(lines)
+    assert full
+    assert eng.pending() == BSPEC.counter
+    arrays = emit_arrays()
+    nc, _, _, _ = eng.emit_into(arrays)
+    assert nc == BSPEC.counter
+    # the unconsumed tail can be re-fed
+    assert not eng.feed(eng._pending_tail)
+    nc2, _, _, _ = eng.emit_into(emit_arrays())
+    assert nc2 == 10
+
+
+def test_reset_clears_keys():
+    eng = mk()
+    eng.feed(b"a:1|c")
+    eng.drain_new_keys()
+    eng.reset()
+    eng.feed(b"a:1|c")
+    keys = eng.drain_new_keys()
+    assert len(keys) == 1  # re-allocated after reset

@@ -44,10 +44,14 @@ def _send_udp(addr, lines):
     s.close()
 
 
+def _total_parse_errors(srv):
+    return srv.parse_errors + srv.aggregator.extra_parse_errors()
+
+
 def _wait_processed(srv, n, timeout=10.0):
     t0 = time.time()
     while time.time() - t0 < timeout:
-        if srv.aggregator.processed + srv.parse_errors >= n:
+        if srv.aggregator.processed + _total_parse_errors(srv) >= n:
             return
         time.sleep(0.02)
     raise TimeoutError(
@@ -88,7 +92,7 @@ def test_udp_ingest_to_flush(server):
     # standalone (not local): percentiles emitted
     assert "a.timer.50percentile" in m
     assert m["a.set"].value == pytest.approx(2.0, abs=0.1)
-    assert srv.parse_errors == 1
+    assert _total_parse_errors(srv) == 1
     # flush resets the interval state (self-telemetry veneur.* metrics may
     # ride later intervals; only app metrics must be gone)
     sink.flushed.clear()

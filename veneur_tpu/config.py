@@ -193,6 +193,7 @@ class Config:
 
     # TPU aggregation backend (this framework's addition)
     aggregation_backend: str = "tpu"
+    native_ingest: bool = True   # C++ parse+key+stage path when buildable
     tpu_counter_capacity: int = 1 << 17
     tpu_gauge_capacity: int = 1 << 15
     tpu_status_capacity: int = 1 << 10

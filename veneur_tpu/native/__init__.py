@@ -1,0 +1,203 @@
+"""ctypes bindings for the native DogStatsD ingest engine (dogstatsd.cpp).
+
+The .so is compiled on first import (g++ -O2, cached next to the source and
+rebuilt when the source changes). `available()` gates the fast path: any
+build/load failure falls back to the pure-Python parser with a warning —
+semantics are identical (tests/test_native.py asserts parity).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import logging
+import os
+import subprocess
+from typing import List, Optional
+
+import numpy as np
+
+log = logging.getLogger("veneur_tpu.native")
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "dogstatsd.cpp")
+_lib = None
+_load_err: Optional[str] = None
+
+
+def _build_and_load():
+    global _lib, _load_err
+    if _lib is not None or _load_err is not None:
+        return
+    try:
+        with open(_SRC, "rb") as f:
+            digest = hashlib.sha256(f.read()).hexdigest()[:16]
+        so_path = os.path.join(_DIR, f"_dogstatsd_{digest}.so")
+        if not os.path.exists(so_path):
+            for stale in os.listdir(_DIR):
+                if (stale.startswith("_dogstatsd_")
+                        and stale.endswith(".so")
+                        and stale != os.path.basename(so_path)):
+                    try:
+                        os.unlink(os.path.join(_DIR, stale))
+                    except OSError:
+                        pass
+            # temp + rename so a concurrent process never dlopens a
+            # half-written ELF
+            tmp_path = f"{so_path}.{os.getpid()}.tmp"
+            subprocess.run(
+                ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                 "-o", tmp_path, _SRC],
+                check=True, capture_output=True, timeout=120)
+            os.replace(tmp_path, so_path)
+        lib = ctypes.CDLL(so_path)
+        lib.vt_new.restype = ctypes.c_void_p
+        lib.vt_new.argtypes = [ctypes.c_uint32] * 5 + [ctypes.c_int] + \
+            [ctypes.c_uint32] * 4
+        lib.vt_free.argtypes = [ctypes.c_void_p]
+        lib.vt_feed.restype = ctypes.c_int
+        lib.vt_feed.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                ctypes.c_int,
+                                ctypes.POINTER(ctypes.c_int)]
+        lib.vt_emit.argtypes = [ctypes.c_void_p] + \
+            [ctypes.c_void_p] * 10 + [ctypes.POINTER(ctypes.c_uint32)]
+        lib.vt_pending.restype = ctypes.c_int
+        lib.vt_pending.argtypes = [ctypes.c_void_p]
+        lib.vt_new_keys.restype = ctypes.c_int
+        lib.vt_new_keys.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                    ctypes.c_int]
+        lib.vt_next_special.restype = ctypes.c_int
+        lib.vt_next_special.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                        ctypes.c_int]
+        lib.vt_slot_for.restype = ctypes.c_int32
+        lib.vt_slot_for.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_char_p,
+            ctypes.c_int, ctypes.c_char_p, ctypes.c_int, ctypes.c_uint32,
+            ctypes.POINTER(ctypes.c_int)]
+        lib.vt_reset.argtypes = [ctypes.c_void_p]
+        lib.vt_stats.argtypes = [ctypes.c_void_p,
+                                 ctypes.POINTER(ctypes.c_uint64)]
+        _lib = lib
+    except Exception as e:  # noqa: BLE001 — any failure => python fallback
+        _load_err = str(e)
+        log.warning("native ingest unavailable, using python parser: %s", e)
+
+
+def available() -> bool:
+    _build_and_load()
+    return _lib is not None
+
+
+KIND_NAMES = {0: "counter", 1: "gauge", 2: "histogram", 3: "set",
+              4: "timer"}
+KIND_IDS = {v: k for k, v in KIND_NAMES.items()}
+
+
+class NativeIngest:
+    """One parser+keytable+stager instance (mirrors aggregation/host.py
+    KeyTable+Batcher, but in C++)."""
+
+    def __init__(self, spec, bspec, n_shards: int = 1):
+        _build_and_load()
+        if _lib is None:
+            raise RuntimeError(f"native ingest unavailable: {_load_err}")
+        self.spec = spec
+        self.bspec = bspec
+        self._h = _lib.vt_new(
+            spec.counter_capacity, spec.gauge_capacity, spec.set_capacity,
+            spec.histo_capacity, n_shards, spec.hll_precision,
+            bspec.counter, bspec.gauge, bspec.set, bspec.histo)
+        self._keybuf = ctypes.create_string_buffer(1 << 20)
+        self._specialbuf = ctypes.create_string_buffer(1 << 16)
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h and _lib is not None:
+            _lib.vt_free(h)
+            self._h = None
+
+    def feed(self, data: bytes) -> bool:
+        """Parse a packet buffer; returns True if a staging area filled and
+        emit() should run (remaining bytes are auto-refed after emit by the
+        caller loop in NativeAggregator)."""
+        consumed = ctypes.c_int(0)
+        self._pending_tail = b""
+        rc = _lib.vt_feed(self._h, data, len(data), ctypes.byref(consumed))
+        if rc:
+            self._pending_tail = data[consumed.value:]
+            return True
+        return False
+
+    def emit_into(self, batcher_arrays) -> tuple:
+        """Copy staged samples into numpy arrays. batcher_arrays is the
+        tuple (c_slot, c_inc, g_slot, g_val, s_slot, s_reg, s_rho, h_slot,
+        h_val, h_wt) of pre-sentinel-filled numpy arrays."""
+        counts = (ctypes.c_uint32 * 4)()
+        ptrs = [a.ctypes.data_as(ctypes.c_void_p) for a in batcher_arrays]
+        _lib.vt_emit(self._h, *ptrs, counts)
+        return tuple(counts)
+
+    def pending(self) -> int:
+        return _lib.vt_pending(self._h)
+
+    def slot_for(self, kind: str, name: str, joined_tags: str, scope: int,
+                 digest: int):
+        """(slot, was_new) for a Python-side caller sharing the native slot
+        space; slot is None at capacity."""
+        was_new = ctypes.c_int(0)
+        name_b = name.encode("utf-8", "surrogateescape")
+        tags_b = joined_tags.encode("utf-8", "surrogateescape")
+        slot = _lib.vt_slot_for(
+            self._h, KIND_IDS[kind], scope, name_b, len(name_b),
+            tags_b, len(tags_b), digest & 0xFFFFFFFF,
+            ctypes.byref(was_new))
+        return (None if slot < 0 else slot), bool(was_new.value)
+
+    def drain_new_keys(self) -> List[tuple]:
+        """[(kind, slot, scope, name, joined_tags)] allocated since the
+        last drain."""
+        n = _lib.vt_new_keys(self._h, self._keybuf,
+                             len(self._keybuf))
+        if n < 0:
+            self._keybuf = ctypes.create_string_buffer(-n * 2)
+            n = _lib.vt_new_keys(self._h, self._keybuf, len(self._keybuf))
+        out = []
+        raw = self._keybuf.raw[:n]
+        off = 0
+        while off < n:
+            kind = raw[off]
+            slot = int.from_bytes(raw[off + 1:off + 5], "little",
+                                  signed=True)
+            scope = raw[off + 5]
+            nl = int.from_bytes(raw[off + 6:off + 8], "little")
+            name = raw[off + 8:off + 8 + nl].decode(
+                "utf-8", "surrogateescape")
+            off += 8 + nl
+            tl = int.from_bytes(raw[off:off + 2], "little")
+            tags = raw[off + 2:off + 2 + tl].decode(
+                "utf-8", "surrogateescape")
+            off += 2 + tl
+            out.append((KIND_NAMES[kind], slot, scope, name, tags))
+        return out
+
+    def drain_specials(self) -> List[bytes]:
+        """Event/service-check lines the C++ parser escalated."""
+        out = []
+        while True:
+            n = _lib.vt_next_special(self._h, self._specialbuf,
+                                     len(self._specialbuf))
+            if n == 0:
+                break
+            if n < 0:
+                self._specialbuf = ctypes.create_string_buffer(-n * 2)
+                continue
+            out.append(self._specialbuf.raw[:n])
+        return out
+
+    def reset(self):
+        _lib.vt_reset(self._h)
+
+    def stats(self) -> dict:
+        s = (ctypes.c_uint64 * 3)()
+        _lib.vt_stats(self._h, s)
+        return {"processed": s[0], "parse_errors": s[1], "dropped": s[2]}

@@ -1,0 +1,506 @@
+// Native DogStatsD ingest engine: parse + key table + batch staging.
+//
+// Replaces the Python host hot loop (samplers/parser.py parse_metric +
+// aggregation/host.py KeyTable/Batcher) below the UDP socket with one C++
+// pass per packet buffer. Semantics are bit-identical to the Python parser
+// (itself mirroring reference samplers/parser.go:298 ParseMetric):
+//   - `name:value|type[|@rate][|#tags]`, sections at most once
+//   - type by first byte: c/g/d/h/m(s)/s (parser.go:331-344)
+//   - tags sorted then joined with ","; first sorted tag with prefix
+//     veneurlocalonly/veneurglobalonly stripped into the scope
+//     (parser.go:397-407)
+//   - 32-bit FNV-1a digest over name+type+joined-tags = shard key
+//   - set members hashed fnv1a64+splitmix64 (utils/hashing.py hll_reg_rho)
+//   - slot = shard*per_shard + next_free[shard], shard = digest % n_shards
+//     (aggregation/host.py _KindTable.slot_for)
+//
+// Events (_e{) and service checks (_sc) are rare; they are handed back to
+// Python verbatim (vt_next_special).
+//
+// Exposed as a C ABI for ctypes; see veneur_tpu/native/__init__.py.
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t FNV32_OFFSET = 0x811C9DC5u;
+constexpr uint32_t FNV32_PRIME = 0x01000193u;
+constexpr uint64_t FNV64_OFFSET = 0xCBF29CE484222325ull;
+constexpr uint64_t FNV64_PRIME = 0x100000001B3ull;
+
+inline uint32_t fnv32(const char* p, size_t n, uint32_t h) {
+  for (size_t i = 0; i < n; i++) {
+    h ^= (uint8_t)p[i];
+    h *= FNV32_PRIME;
+  }
+  return h;
+}
+
+inline uint64_t fnv64(const char* p, size_t n) {
+  uint64_t h = FNV64_OFFSET;
+  for (size_t i = 0; i < n; i++) {
+    h ^= (uint8_t)p[i];
+    h *= FNV64_PRIME;
+  }
+  return h;
+}
+
+inline uint64_t splitmix64(uint64_t x) {
+  x += 0x9E3779B97F4A7C15ull;
+  x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ull;
+  x = (x ^ (x >> 27)) * 0x94D049BB133111EBull;
+  return x ^ (x >> 31);
+}
+
+enum Kind { K_COUNTER = 0, K_GAUGE = 1, K_HISTO = 2, K_SET = 3, K_TIMER = 4 };
+enum Scope { S_MIXED = 0, S_LOCAL = 1, S_GLOBAL = 2 };
+
+struct KindTable {
+  uint32_t capacity = 0;
+  uint32_t n_shards = 1;
+  uint32_t per_shard = 0;
+  std::unordered_map<std::string, int32_t> by_key;
+  std::vector<uint32_t> next_free;
+  uint64_t dropped = 0;
+
+  void init(uint32_t cap, uint32_t shards) {
+    capacity = cap;
+    n_shards = shards;
+    per_shard = cap / shards;
+    next_free.assign(shards, 0);
+  }
+  void reset() {
+    by_key.clear();
+    next_free.assign(n_shards, 0);
+  }
+};
+
+// serialized record of a newly-allocated slot, drained by Python for
+// flush-time labeling (SlotMeta)
+struct NewKey {
+  uint8_t kind;
+  int32_t slot;
+  uint8_t scope;
+  std::string name;
+  std::string joined_tags;
+};
+
+struct Parser {
+  // tables: counter, gauge, set, histo (histogram+timer share, key
+  // prefixed with the kind byte like Python's ("timer", name, tags) keys)
+  KindTable counters, gauges, sets, histos;
+  int hll_precision = 14;
+
+  // staging (fixed batch capacities; slot sentinel fill done by Python)
+  uint32_t bc, bg, bs, bh;
+  std::vector<int32_t> c_slot;  std::vector<float> c_inc;
+  std::vector<int32_t> g_slot;  std::vector<float> g_val;
+  std::vector<int32_t> s_slot;  std::vector<int32_t> s_reg;
+  std::vector<uint8_t> s_rho;
+  std::vector<int32_t> h_slot;  std::vector<float> h_val;
+  std::vector<float> h_wt;
+  uint32_t nc = 0, ng = 0, ns = 0, nh = 0;
+
+  std::vector<NewKey> new_keys;
+  std::deque<std::string> specials;  // _e{ / _sc lines for Python
+
+  uint64_t processed = 0;
+  uint64_t parse_errors = 0;
+
+  // scratch
+  std::vector<std::pair<const char*, size_t>> tag_views;
+  std::string keybuf, joined;
+
+  void init(uint32_t cc, uint32_t gc, uint32_t sc, uint32_t hc,
+            uint32_t shards, int precision, uint32_t bc_, uint32_t bg_,
+            uint32_t bs_, uint32_t bh_) {
+    counters.init(cc, shards);
+    gauges.init(gc, shards);
+    sets.init(sc, shards);
+    histos.init(hc, shards);
+    hll_precision = precision;
+    bc = bc_; bg = bg_; bs = bs_; bh = bh_;
+    c_slot.resize(bc); c_inc.resize(bc);
+    g_slot.resize(bg); g_val.resize(bg);
+    s_slot.resize(bs); s_reg.resize(bs); s_rho.resize(bs);
+    h_slot.resize(bh); h_val.resize(bh); h_wt.resize(bh);
+  }
+
+  bool any_full() const {
+    return nc >= bc || ng >= bg || ns >= bs || nh >= bh;
+  }
+
+  int32_t slot_for(KindTable& t, uint8_t kind, uint8_t scope,
+                   const char* name, size_t name_len, uint32_t digest) {
+    // key = kind byte + name + '\x1f' + joined tags (joined is in `joined`)
+    keybuf.clear();
+    keybuf.push_back((char)kind);
+    keybuf.append(name, name_len);
+    keybuf.push_back('\x1f');
+    keybuf.append(joined);
+    auto it = t.by_key.find(keybuf);
+    if (it != t.by_key.end()) return it->second;
+    uint32_t shard = digest % t.n_shards;
+    uint32_t nxt = t.next_free[shard];
+    if (nxt >= t.per_shard) {
+      t.dropped++;
+      return -1;
+    }
+    t.next_free[shard] = nxt + 1;
+    int32_t slot = (int32_t)(shard * t.per_shard + nxt);
+    t.by_key.emplace(keybuf, slot);
+    new_keys.push_back(NewKey{kind, slot, scope,
+                              std::string(name, name_len), joined});
+    return slot;
+  }
+
+  // strict float parse: Go strconv.ParseFloat-alike (no surrounding
+  // whitespace, full consumption, finite)
+  static bool parse_value(const char* p, size_t n, double* out) {
+    if (n == 0) return false;
+    if (isspace((unsigned char)p[0])) return false;
+    char buf[64];
+    if (n >= sizeof(buf)) return false;
+    // strtod accepts C99 hex floats; Python float() / the wire format do not
+    if (memchr(p, 'x', n) || memchr(p, 'X', n)) return false;
+    memcpy(buf, p, n);
+    buf[n] = 0;
+    char* end = nullptr;
+    double v = strtod(buf, &end);
+    if (end != buf + n) return false;
+    if (!std::isfinite(v)) return false;
+    return *out = v, true;
+  }
+
+  // returns 0 ok, 1 parse error, 2 special (event/service check), 3 full
+  int parse_line(const char* line, size_t len) {
+    if (len == 0) return 0;
+    if (len >= 3 && line[0] == '_' &&
+        ((line[1] == 'e' && line[2] == '{') ||
+         (line[1] == 's' && line[2] == 'c'))) {
+      specials.emplace_back(line, len);
+      return 2;
+    }
+    // split into pipe chunks
+    const char* colon = (const char*)memchr(line, ':', len);
+    const char* pipe1 = (const char*)memchr(line, '|', len);
+    if (!colon || !pipe1 || colon > pipe1) return 1;
+    const char* name = line;
+    size_t name_len = colon - line;
+    if (name_len == 0) return 1;
+    const char* value = colon + 1;
+    size_t value_len = pipe1 - value;
+
+    const char* rest = pipe1 + 1;
+    size_t rest_len = len - (rest - line);
+    // type chunk
+    const char* pipe2 = (const char*)memchr(rest, '|', rest_len);
+    size_t type_len = pipe2 ? (size_t)(pipe2 - rest) : rest_len;
+    if (type_len == 0) return 1;
+
+    uint8_t kind;
+    const char* kind_str;
+    size_t kind_str_len;
+    switch (rest[0]) {
+      case 'c': kind = K_COUNTER; kind_str = "counter"; kind_str_len = 7; break;
+      case 'g': kind = K_GAUGE;   kind_str = "gauge";   kind_str_len = 5; break;
+      case 'd':
+      case 'h': kind = K_HISTO;   kind_str = "histogram"; kind_str_len = 9; break;
+      case 'm': kind = K_TIMER;   kind_str = "timer";   kind_str_len = 5; break;
+      case 's': kind = K_SET;     kind_str = "set";     kind_str_len = 3; break;
+      default: return 1;
+    }
+
+    uint32_t h = fnv32(name, name_len, FNV32_OFFSET);
+    h = fnv32(kind_str, kind_str_len, h);
+
+    double value_f = 0;
+    if (kind != K_SET) {
+      // reject '_' (Python/Go reject digit separators, strtod would too
+      // via full-consumption, but be explicit for e.g. "1_0")
+      if (!parse_value(value, value_len, &value_f)) return 1;
+    }
+
+    // optional sections
+    double rate = 1.0;
+    bool found_rate = false, found_tags = false;
+    uint8_t scope = S_MIXED;
+    joined.clear();
+    const char* p = pipe2 ? pipe2 : rest + rest_len;
+    while (p < line + len) {
+      p++;  // skip '|'
+      size_t remain = len - (p - line);
+      const char* next = (const char*)memchr(p, '|', remain);
+      size_t clen = next ? (size_t)(next - p) : remain;
+      if (clen == 0) return 1;
+      if (p[0] == '@') {
+        if (found_rate) return 1;
+        double r;
+        if (!parse_value(p + 1, clen - 1, &r)) return 1;
+        if (r <= 0.0 || r > 1.0) return 1;
+        rate = r;
+        found_rate = true;
+      } else if (p[0] == '#') {
+        if (found_tags) return 1;
+        found_tags = true;
+        // split tags on ',', sort, strip first magic, join
+        tag_views.clear();
+        const char* t = p + 1;
+        const char* tag_end = p + clen;
+        while (t <= tag_end) {
+          const char* comma =
+              (const char*)memchr(t, ',', tag_end - t);
+          size_t tl = comma ? (size_t)(comma - t) : (size_t)(tag_end - t);
+          tag_views.emplace_back(t, tl);
+          if (!comma) break;
+          t = comma + 1;
+        }
+        std::sort(tag_views.begin(), tag_views.end(),
+                  [](const auto& a, const auto& b) {
+                    int c = memcmp(a.first, b.first,
+                                   std::min(a.second, b.second));
+                    if (c != 0) return c < 0;
+                    return a.second < b.second;
+                  });
+        // first sorted tag with a magic prefix is stripped into the scope
+        static const char LOCALONLY[] = "veneurlocalonly";
+        static const char GLOBALONLY[] = "veneurglobalonly";
+        size_t strip = SIZE_MAX;
+        for (size_t i = 0; i < tag_views.size(); i++) {
+          const auto& tv = tag_views[i];
+          if (tv.second >= 15 && memcmp(tv.first, LOCALONLY, 15) == 0) {
+            scope = S_LOCAL;
+            strip = i;
+            break;
+          }
+          if (tv.second >= 16 && memcmp(tv.first, GLOBALONLY, 16) == 0) {
+            scope = S_GLOBAL;
+            strip = i;
+            break;
+          }
+        }
+        bool first = true;
+        for (size_t i = 0; i < tag_views.size(); i++) {
+          if (i == strip) continue;
+          if (!first) joined.push_back(',');
+          joined.append(tag_views[i].first, tag_views[i].second);
+          first = false;
+        }
+        h = fnv32(joined.data(), joined.size(), h);
+      } else {
+        return 1;
+      }
+      if (!next) break;
+      p = next;
+    }
+    if (!found_tags) joined.clear();
+
+    switch (kind) {
+      case K_COUNTER: {
+        int32_t slot = slot_for(counters, kind, scope, name, name_len, h);
+        if (slot < 0) return 0;
+        c_slot[nc] = slot;
+        c_inc[nc] = (float)(value_f * (1.0 / rate));
+        nc++;
+        break;
+      }
+      case K_GAUGE: {
+        int32_t slot = slot_for(gauges, kind, scope, name, name_len, h);
+        if (slot < 0) return 0;
+        g_slot[ng] = slot;
+        g_val[ng] = (float)value_f;
+        ng++;
+        break;
+      }
+      case K_SET: {
+        int32_t slot = slot_for(sets, kind, scope, name, name_len, h);
+        if (slot < 0) return 0;
+        uint64_t mh = splitmix64(fnv64(value, value_len));
+        uint32_t reg = (uint32_t)(mh >> (64 - hll_precision));
+        uint64_t restbits = mh << hll_precision;
+        int rho;
+        if (restbits == 0) {
+          rho = 64 - hll_precision + 1;
+        } else {
+          int lz = __builtin_clzll(restbits);
+          rho = std::min(lz, 64 - hll_precision) + 1;
+        }
+        s_slot[ns] = slot;
+        s_reg[ns] = (int32_t)reg;
+        s_rho[ns] = (uint8_t)rho;
+        ns++;
+        break;
+      }
+      case K_HISTO:
+      case K_TIMER: {
+        int32_t slot = slot_for(histos, kind, scope, name, name_len, h);
+        if (slot < 0) return 0;
+        h_slot[nh] = slot;
+        h_val[nh] = (float)value_f;
+        h_wt[nh] = (float)(1.0 / rate);
+        nh++;
+        break;
+      }
+    }
+    processed++;
+    return 0;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* vt_new(uint32_t counter_cap, uint32_t gauge_cap, uint32_t set_cap,
+             uint32_t histo_cap, uint32_t n_shards, int hll_precision,
+             uint32_t bc, uint32_t bg, uint32_t bs, uint32_t bh) {
+  auto* p = new Parser();
+  p->init(counter_cap, gauge_cap, set_cap, histo_cap,
+          n_shards ? n_shards : 1, hll_precision, bc, bg, bs, bh);
+  return p;
+}
+
+void vt_free(void* h) { delete (Parser*)h; }
+
+// Feed a newline-separated packet buffer. Stops early if a staging area
+// fills; *consumed reports how many input bytes were handled. Returns 1 if
+// stopped-for-full, else 0.
+int vt_feed(void* hp, const char* data, int len, int* consumed) {
+  auto* p = (Parser*)hp;
+  int off = 0;
+  while (off < len) {
+    if (p->any_full()) {
+      *consumed = off;
+      return 1;
+    }
+    const char* nl = (const char*)memchr(data + off, '\n', len - off);
+    int line_len = nl ? (int)(nl - (data + off)) : (len - off);
+    int rc = p->parse_line(data + off, line_len);
+    if (rc == 1) p->parse_errors++;
+    off += line_len + (nl ? 1 : 0);
+  }
+  *consumed = off;
+  return 0;
+}
+
+// Copy staged samples into caller-provided buffers (caller pre-fills slot
+// buffers with sentinels) and reset staging. counts_out: [nc, ng, ns, nh].
+void vt_emit(void* hp, int32_t* c_slot, float* c_inc, int32_t* g_slot,
+             float* g_val, int32_t* s_slot, int32_t* s_reg, uint8_t* s_rho,
+             int32_t* h_slot, float* h_val, float* h_wt,
+             uint32_t* counts_out) {
+  auto* p = (Parser*)hp;
+  memcpy(c_slot, p->c_slot.data(), p->nc * sizeof(int32_t));
+  memcpy(c_inc, p->c_inc.data(), p->nc * sizeof(float));
+  memcpy(g_slot, p->g_slot.data(), p->ng * sizeof(int32_t));
+  memcpy(g_val, p->g_val.data(), p->ng * sizeof(float));
+  memcpy(s_slot, p->s_slot.data(), p->ns * sizeof(int32_t));
+  memcpy(s_reg, p->s_reg.data(), p->ns * sizeof(int32_t));
+  memcpy(s_rho, p->s_rho.data(), p->ns * sizeof(uint8_t));
+  memcpy(h_slot, p->h_slot.data(), p->nh * sizeof(int32_t));
+  memcpy(h_val, p->h_val.data(), p->nh * sizeof(float));
+  memcpy(h_wt, p->h_wt.data(), p->nh * sizeof(float));
+  counts_out[0] = p->nc;
+  counts_out[1] = p->ng;
+  counts_out[2] = p->ns;
+  counts_out[3] = p->nh;
+  p->nc = p->ng = p->ns = p->nh = 0;
+}
+
+int vt_pending(void* hp) {
+  auto* p = (Parser*)hp;
+  return (int)(p->nc + p->ng + p->ns + p->nh);
+}
+
+// Drain new-key records into buf as
+// [u8 kind][i32 slot][u8 scope][u16 name_len][name][u16 tags_len][tags]*.
+// Returns bytes written, or -needed when cap is too small (nothing
+// consumed in that case).
+int vt_new_keys(void* hp, char* buf, int cap) {
+  auto* p = (Parser*)hp;
+  int need = 0;
+  for (const auto& k : p->new_keys)
+    need += 1 + 4 + 1 + 2 + (int)k.name.size() + 2 + (int)k.joined_tags.size();
+  if (need > cap) return -need;
+  char* w = buf;
+  for (const auto& k : p->new_keys) {
+    *w++ = (char)k.kind;
+    memcpy(w, &k.slot, 4); w += 4;
+    *w++ = (char)k.scope;
+    uint16_t nl = (uint16_t)k.name.size();
+    memcpy(w, &nl, 2); w += 2;
+    memcpy(w, k.name.data(), nl); w += nl;
+    uint16_t tl = (uint16_t)k.joined_tags.size();
+    memcpy(w, &tl, 2); w += 2;
+    memcpy(w, k.joined_tags.data(), tl); w += tl;
+  }
+  p->new_keys.clear();
+  return (int)(w - buf);
+}
+
+// Pop one escalated (_e{ / _sc) line; returns its length, 0 if none,
+// -needed if cap too small (line stays queued).
+int vt_next_special(void* hp, char* buf, int cap) {
+  auto* p = (Parser*)hp;
+  if (p->specials.empty()) return 0;
+  const std::string& s = p->specials.front();
+  if ((int)s.size() > cap) return -(int)s.size();
+  memcpy(buf, s.data(), s.size());
+  int n = (int)s.size();
+  p->specials.pop_front();
+  return n;
+}
+
+// Slot allocation for Python-side callers (imports, span-extracted
+// metrics) so native wire ingest and the Python paths share one slot
+// space. kind: 0=counter 1=gauge 2=histogram 3=set 4=timer. *was_new is
+// set to 1 when this call allocated the slot. Returns -1 when the shard
+// is at capacity.
+int32_t vt_slot_for(void* hp, int kind, int scope, const char* name,
+                    int name_len, const char* tags, int tags_len,
+                    uint32_t digest, int* was_new) {
+  auto* p = (Parser*)hp;
+  KindTable* t;
+  switch (kind) {
+    case K_COUNTER: t = &p->counters; break;
+    case K_GAUGE: t = &p->gauges; break;
+    case K_SET: t = &p->sets; break;
+    case K_HISTO:
+    case K_TIMER: t = &p->histos; break;
+    default: return -1;
+  }
+  p->joined.assign(tags, tags_len);
+  size_t before = p->new_keys.size();
+  int32_t slot = p->slot_for(*t, (uint8_t)kind, (uint8_t)scope, name,
+                             name_len, digest);
+  *was_new = p->new_keys.size() > before ? 1 : 0;
+  return slot;
+}
+
+// Flush boundary: clear key maps (state is flush-scoped, worker.go:498).
+void vt_reset(void* hp) {
+  auto* p = (Parser*)hp;
+  p->counters.reset();
+  p->gauges.reset();
+  p->sets.reset();
+  p->histos.reset();
+  p->new_keys.clear();
+}
+
+void vt_stats(void* hp, uint64_t* out) {
+  auto* p = (Parser*)hp;
+  out[0] = p->processed;
+  out[1] = p->parse_errors;
+  out[2] = p->counters.dropped + p->gauges.dropped + p->sets.dropped +
+           p->histos.dropped;
+}
+
+}  // extern "C"

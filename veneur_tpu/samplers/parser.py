@@ -152,11 +152,18 @@ def parse_metric(packet: bytes) -> UDPMetric:
         if lead == 0x40:  # '@'
             if found_rate:
                 raise ParseError("multiple sample rates specified")
+            rate_b = chunk[1:]
+            # same strictness as the value: no underscores (Python float
+            # accepts '0.2_5', the wire format does not) and finite — a
+            # NaN rate would pass the range checks below (NaN comparisons
+            # are false) and poison counters with value*(1/NaN)
+            if b"_" in rate_b or rate_b != rate_b.strip():
+                raise ParseError("invalid float for sample rate")
             try:
-                rate = float(chunk[1:])
+                rate = float(rate_b)
             except ValueError:
                 raise ParseError("invalid float for sample rate")
-            if rate <= 0 or rate > 1:
+            if rate != rate or not (0 < rate <= 1):
                 raise ParseError("sample rate must be >0 and <=1")
             m.sample_rate = rate
             found_rate = True

@@ -43,6 +43,10 @@ class Aggregator:
         self.processed = 0
         self.dropped_capacity = 0
 
+    def extra_parse_errors(self) -> int:
+        """Parse errors counted below the Python layer (native engine)."""
+        return 0
+
     # -- ingest -------------------------------------------------------------
     def _on_batch(self, batch):
         self.state = ingest_step(self.state, batch, spec=self.spec)

@@ -50,7 +50,8 @@ def start_http_server(server, address) -> "http.server.ThreadingHTTPServer":
             elif self.path == "/stats":
                 body = json.dumps({
                     "packets_received": server.packets_received,
-                    "parse_errors": server.parse_errors,
+                    "parse_errors": server.parse_errors
+                    + server.aggregator.extra_parse_errors(),
                     "processed": server.aggregator.processed,
                     "flush_count": server.flush_count,
                     "spans_received": server.span_pipeline.spans_received,

@@ -1,0 +1,218 @@
+"""Native-ingest aggregation backend.
+
+Wire packets are parsed, keyed, and staged entirely in C++
+(veneur_tpu/native/dogstatsd.cpp); the Python side only moves completed
+batches to the device. Python-originated samples (imports, span-extracted
+metrics, service checks) share the same slot space through vt_slot_for and
+stage through the ordinary Python Batcher — both batch streams feed the
+same jitted ingest step.
+
+Slot metadata (SlotMeta for flush labeling) is reconstructed lazily from
+the C++ engine's new-key records; status checks keep a pure-Python table
+(they never ride the native wire path's kinds).
+
+Known imprecisions, documented:
+
+- A histo slot first created by the import path and later hit by native
+  wire samples keeps imported_only=True for the interval (the native path
+  doesn't report per-slot direct-hit sets), so its aggregates are
+  suppressed on a global tier — strictly conservative (percentiles still
+  flush).
+- Gauge last-write-wins is per-stream: when the same gauge key arrives
+  both over the wire (native staging) and via Python-side paths
+  (span-extracted/imported) in one interval, the flush order is
+  deterministic (native batch first, Python batch second → Python-side
+  write wins) but not arrival-ordered across the two streams. The
+  single-stream case — by far the common one — is exactly ordered.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from veneur_tpu.aggregation.host import (
+    Batcher, BatchSpec, KeyTable, SlotMeta, _KindTable)
+from veneur_tpu.aggregation.state import TableSpec
+from veneur_tpu.native import NativeIngest
+from veneur_tpu.server.aggregator import Aggregator
+
+
+class NativeKeyTable:
+    """KeyTable facade over the C++ slot maps + a Python status table."""
+
+    def __init__(self, spec: TableSpec, eng: NativeIngest, n_shards: int):
+        self.spec = spec
+        self.eng = eng
+        self.n_shards = n_shards
+        self.status = _KindTable(spec.status_capacity, n_shards)
+        # drained metadata: kind-table name -> [(slot, SlotMeta)]
+        self.meta = {"counter": [], "gauge": [], "set": [], "histo": []}
+        self.by_slot = {"counter": {}, "gauge": {}, "set": {}, "histo": {}}
+        self._finalized = False
+
+    _TABLE = staticmethod(KeyTable._table_name)
+
+    def _drain(self):
+        if self._finalized:
+            return
+        for kind, slot, scope, name, joined in self.eng.drain_new_keys():
+            tname = self._TABLE(kind)
+            if slot in self.by_slot[tname]:
+                # registered python-side with the exact tag tuple already
+                continue
+            m = SlotMeta(name=name,
+                         tags=tuple(joined.split(",")) if joined else (),
+                         scope=scope, kind=kind)
+            self.meta[tname].append((slot, m))
+            self.by_slot[tname][slot] = m
+
+    def slot_for(self, kind: str, name: str, tags: tuple, scope: int,
+                 digest: int, hostname: str = "", imported: bool = False):
+        if kind == "status":
+            key = (kind, name, tags)
+            return self.status.slot_for(
+                key, digest,
+                lambda: SlotMeta(name=name, tags=tags, scope=scope,
+                                 kind=kind, hostname=hostname))
+        joined = ",".join(tags)
+        slot, was_new = self.eng.slot_for(kind, name, joined, scope, digest)
+        if slot is not None and was_new:
+            # register the exact tuple now — tags from SSF maps may contain
+            # commas, which a joined-string round-trip would corrupt
+            tname = self._TABLE(kind)
+            m = SlotMeta(name=name, tags=tags, scope=scope, kind=kind,
+                         hostname=hostname, imported_only=imported)
+            self.meta[tname].append((slot, m))
+            self.by_slot[tname][slot] = m
+        return slot
+
+    def get_meta(self, kind: str):
+        self._drain()
+        if kind == "status":
+            return self.status.meta
+        return self.meta[self._TABLE(kind)]
+
+    def meta_for_slot(self, kind: str, slot: int):
+        if kind == "status":
+            return self.status.by_slot.get(slot)
+        self._drain()
+        return self.by_slot[self._TABLE(kind)].get(slot)
+
+    def dropped(self) -> int:
+        return self.eng.stats()["dropped"] + self.status.dropped
+
+    def finalize(self):
+        """Detach: absorb remaining key records, stop draining (the engine's
+        maps are about to be reset for the next interval)."""
+        self._drain()
+        self._finalized = True
+
+
+class NativeAggregator(Aggregator):
+    def __init__(self, spec: TableSpec, bspec: BatchSpec = BatchSpec(),
+                 n_shards: int = 1, compact_every: int = 32,
+                 fold_every: int = 64):
+        super().__init__(spec, bspec, n_shards, compact_every, fold_every)
+        self.eng = NativeIngest(spec, bspec, n_shards)
+        self.table = NativeKeyTable(spec, self.eng, n_shards)
+        self._alloc_emit_buffers()
+
+    def _alloc_emit_buffers(self):
+        b, spec = self.bspec, self.spec
+        self._c_slot = np.empty(b.counter, np.int32)
+        self._c_inc = np.zeros(b.counter, np.float32)
+        self._g_slot = np.empty(b.gauge, np.int32)
+        self._g_val = np.zeros(b.gauge, np.float32)
+        self._s_slot = np.empty(b.set, np.int32)
+        self._s_reg = np.zeros(b.set, np.int32)
+        self._s_rho = np.zeros(b.set, np.uint8)
+        self._h_slot = np.empty(b.histo, np.int32)
+        self._h_val = np.zeros(b.histo, np.float32)
+        self._h_wt = np.zeros(b.histo, np.float32)
+        # status never rides the native path; constant empty lanes
+        self._st_slot = np.full(b.status, spec.status_capacity, np.int32)
+        self._st_val = np.zeros(b.status, np.float32)
+
+    # -- wire path -----------------------------------------------------------
+    def feed(self, data: bytes) -> List[bytes]:
+        """Parse a packet buffer natively; returns escalated event/service-
+        check lines for the caller to handle via the Python parser."""
+        full = self.eng.feed(data)
+        while full:
+            self._emit_native()
+            tail = self.eng._pending_tail
+            if not tail:
+                break
+            full = self.eng.feed(tail)
+        return self.eng.drain_specials()
+
+    def _emit_native(self):
+        from veneur_tpu.aggregation.step import Batch
+        spec = self.spec
+        self._c_slot.fill(spec.counter_capacity)
+        self._g_slot.fill(spec.gauge_capacity)
+        self._s_slot.fill(spec.set_capacity)
+        self._h_slot.fill(spec.histo_capacity)
+        self._h_wt.fill(0.0)
+        self._c_inc.fill(0.0)
+        nc, ng, ns, nh = self.eng.emit_into(
+            (self._c_slot, self._c_inc, self._g_slot, self._g_val,
+             self._s_slot, self._s_reg, self._s_rho, self._h_slot,
+             self._h_val, self._h_wt))
+        if nc + ng + ns + nh == 0:
+            return
+        batch = Batch(
+            counter_slot=self._c_slot.copy(), counter_inc=self._c_inc.copy(),
+            gauge_slot=self._g_slot.copy(), gauge_val=self._g_val.copy(),
+            status_slot=self._st_slot, status_val=self._st_val,
+            set_slot=self._s_slot.copy(), set_reg=self._s_reg.copy(),
+            set_rho=self._s_rho.copy(),
+            histo_slot=self._h_slot.copy(), histo_val=self._h_val.copy(),
+            histo_wt=self._h_wt.copy(),
+        )
+        self._on_batch(batch)
+
+    def extra_parse_errors(self) -> int:
+        return self.eng.stats()["parse_errors"]
+
+    # `processed` spans both ingest paths: the C++ engine's count plus the
+    # Python-side samples (imports, extracted metrics, service checks).
+    @property
+    def processed(self):
+        native = self.eng.stats()["processed"] if hasattr(self, "eng") else 0
+        return self._py_processed + native
+
+    @processed.setter
+    def processed(self, v):
+        native = self.eng.stats()["processed"] if hasattr(self, "eng") else 0
+        self._py_processed = v - native
+
+    # dropped spans both paths too: engine drops + python-side drops
+    # (status-table capacity, import drops)
+    @property
+    def dropped_capacity(self):
+        native = self.eng.stats()["dropped"] if hasattr(self, "eng") else 0
+        return self._py_dropped + native
+
+    @dropped_capacity.setter
+    def dropped_capacity(self, v):
+        native = self.eng.stats()["dropped"] if hasattr(self, "eng") else 0
+        self._py_dropped = v - native
+
+    # -- flush ---------------------------------------------------------------
+    def flush(self, percentiles, want_raw: bool = False):
+        self._emit_native()
+        detached = self.table
+        detached.finalize()
+        result = super().flush(percentiles, want_raw)
+        # super() replaced self.table with a fresh Python KeyTable; the
+        # native engine keeps the slot space, so re-wrap it post-reset
+        self.eng.reset()
+        self.table = NativeKeyTable(self.spec, self.eng, self.n_shards)
+        if want_raw:
+            flush_arrays, _, raw = result
+            return flush_arrays, detached, raw
+        flush_arrays, _ = result
+        return flush_arrays, detached

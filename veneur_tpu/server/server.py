@@ -83,16 +83,26 @@ class Server:
         self.interval = cfg.parse_interval()
         self.hostname = cfg.hostname
         self.tags = list(cfg.tags)
-        self.aggregator = Aggregator(
-            spec_from_config(cfg),
-            BatchSpec(counter=cfg.tpu_batch_counter,
-                      gauge=cfg.tpu_batch_gauge,
-                      status=cfg.tpu_batch_status,
-                      set=cfg.tpu_batch_set,
-                      histo=cfg.tpu_batch_histo),
+        agg_args = dict(
+            spec=spec_from_config(cfg),
+            bspec=BatchSpec(counter=cfg.tpu_batch_counter,
+                            gauge=cfg.tpu_batch_gauge,
+                            status=cfg.tpu_batch_status,
+                            set=cfg.tpu_batch_set,
+                            histo=cfg.tpu_batch_histo),
             n_shards=max(1, cfg.tpu_n_shards) if cfg.tpu_n_shards else 1,
             compact_every=cfg.tpu_compact_every,
             fold_every=cfg.tpu_fold_every)
+        self._native = False
+        if cfg.native_ingest:
+            from veneur_tpu import native
+            if native.available():
+                from veneur_tpu.server.native_aggregator import (
+                    NativeAggregator)
+                self.aggregator = NativeAggregator(**agg_args)
+                self._native = True
+        if not self._native:
+            self.aggregator = Aggregator(**agg_args)
         self.metric_sinks = list(metric_sinks or [])
         self.span_sinks = list(span_sinks or [])
         self.plugins = list(plugins or [])
@@ -175,7 +185,13 @@ class Server:
             log.debug("bad packet %r: %s", packet[:64], e)
 
     def _process_packets(self, data: bytes) -> None:
-        """reference server.go:1081 processMetricPacket + SplitBytes."""
+        """reference server.go:1081 processMetricPacket + SplitBytes. With
+        the native engine, the whole buffer (splitting included) is handled
+        in C++; only events/service checks come back up."""
+        if self._native:
+            for special in self.aggregator.feed(data):
+                self.handle_metric_packet(special)
+            return
         for line in data.split(b"\n"):
             if line:
                 self.handle_metric_packet(line)
@@ -595,7 +611,8 @@ class Server:
         from veneur_tpu.trace.client import report_batch
 
         cur = {"veneur.packets_received_total": self.packets_received,
-               "veneur.parse_errors_total": self.parse_errors,
+               "veneur.parse_errors_total":
+                   self.parse_errors + self.aggregator.extra_parse_errors(),
                "veneur.worker.metrics_processed_total":
                    self.aggregator.processed + 0,
                "veneur.worker.metrics_dropped_total":
