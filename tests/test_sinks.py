@@ -1,0 +1,197 @@
+"""Sink implementations against local fake endpoints (the reference's
+httptest.Server idiom, SURVEY §4) and the prometheus translator."""
+
+import http.server
+import json
+import threading
+import zlib
+
+import pytest
+
+from veneur_tpu.samplers.intermetric import COUNTER, GAUGE, InterMetric
+from veneur_tpu.sinks.datadog import DatadogMetricSink
+from veneur_tpu.sinks.grpsink import GRPCSpanSink, serve_span_sink
+from veneur_tpu.sinks.kafka import KafkaMetricSink, KafkaSpanSink
+from veneur_tpu.sinks.signalfx import SignalFxMetricSink
+from veneur_tpu.sinks.splunk import SplunkSpanSink
+from veneur_tpu.sinks.xray import XRaySpanSink
+
+from tests.test_spans import make_span
+
+
+class _Capture(http.server.BaseHTTPRequestHandler):
+    captured = []
+
+    def log_message(self, *a):
+        pass
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(n)
+        if self.headers.get("Content-Encoding") == "deflate":
+            body = zlib.decompress(body)
+        type(self).captured.append(
+            (self.path, {k.lower(): v for k, v in self.headers.items()},
+             body))
+        self.send_response(200)
+        self.send_header("Content-Length", "2")
+        self.end_headers()
+        self.wfile.write(b"ok")
+
+
+@pytest.fixture
+def fake_api():
+    class Handler(_Capture):
+        captured = []
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}", Handler.captured
+    srv.shutdown()
+    srv.server_close()
+
+
+def im(name, value, mtype=COUNTER, tags=(), host="box"):
+    return InterMetric(name=name, timestamp=1000, value=value,
+                       tags=list(tags), type=mtype, hostname=host)
+
+
+def test_datadog_sink_posts_series(fake_api):
+    url, captured = fake_api
+    sink = DatadogMetricSink(api_key="k", hostname="box", api_url=url,
+                             interval_s=10)
+    sink.flush([im("c1", 50.0), im("g1", 3.0, GAUGE, tags=["a:b"])])
+    assert len(captured) == 1
+    path, headers, body = captured[0]
+    assert path.startswith("/api/v1/series")
+    series = json.loads(body)["series"]
+    by = {s["metric"]: s for s in series}
+    # counters as rates with interval (datadog.go:375)
+    assert by["c1"]["type"] == "rate"
+    assert by["c1"]["points"][0][1] == 5.0
+    assert by["c1"]["interval"] == 10
+    assert by["g1"]["type"] == "gauge"
+    assert by["g1"]["tags"] == ["a:b"]
+
+
+def test_signalfx_sink_vary_by_token(fake_api):
+    url, captured = fake_api
+    sink = SignalFxMetricSink(
+        api_key="default", endpoint=url, hostname="box",
+        vary_key_by="customer",
+        per_tag_api_keys={"acme": "acme-token"})
+    sink.flush([im("m1", 1.0, tags=["customer:acme"]),
+                im("m2", 2.0, GAUGE, tags=["customer:other"])])
+    tokens = {h["x-sf-token"] for _, h, _ in captured}
+    assert tokens == {"acme-token", "default"}
+    for _, h, body in captured:
+        payload = json.loads(body)
+        for dp in payload["counter"] + payload["gauge"]:
+            assert dp["dimensions"]["host"] == "box"
+
+
+def test_splunk_sink_batches_and_samples(fake_api):
+    url, captured = fake_api
+    sink = SplunkSpanSink(hec_address=url, token="tok", hostname="box",
+                          batch_size=2, sample_rate=1)
+    for i in range(3):
+        sink.ingest(make_span(trace_id=100 + i, span_id=i + 1))
+    sink.flush()
+    assert len(captured) == 2  # one full batch + one flush remainder
+    _, headers, body = captured[0]
+    assert headers["authorization"] == "Splunk tok"
+    events = [json.loads(line) for line in body.splitlines()]
+    assert len(events) == 2
+    assert events[0]["event"]["service"] == "svc"
+    # sampling: keep 1-in-2 traces
+    sampled = SplunkSpanSink(hec_address=url, token="t", hostname="b",
+                             batch_size=10, sample_rate=2)
+    for i in range(10):
+        sampled.ingest(make_span(trace_id=i, span_id=i + 1))
+    assert sampled.skipped == 5
+
+
+def test_xray_sink_datagrams():
+    import socket
+    recv = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    recv.bind(("127.0.0.1", 0))
+    recv.settimeout(5)
+    port = recv.getsockname()[1]
+    sink = XRaySpanSink(daemon_address=f"127.0.0.1:{port}",
+                        sample_percentage=100.0,
+                        annotation_tags=["env"])
+    span = make_span(trace_id=12345, span_id=77)
+    span.tags["env"] = "prod"
+    span.tags["secret"] = "x"
+    sink.ingest(span)
+    data = recv.recv(65536)
+    header, payload = data.split(b"\n", 1)
+    assert json.loads(header) == {"format": "json", "version": 1}
+    seg = json.loads(payload)
+    assert seg["trace_id"].startswith("1-")
+    assert seg["annotations"] == {"env": "prod"}
+    assert seg["id"] == f"{77:016x}"
+    recv.close()
+
+
+def test_grpsink_roundtrip():
+    got = []
+    server, port = serve_span_sink(got.append)
+    sink = GRPCSpanSink(f"127.0.0.1:{port}")
+    sink.ingest(make_span(span_id=42))
+    assert sink.sent == 1
+    assert got[0].id == 42
+    sink.close()
+    server.stop(grace=1)
+
+
+def test_kafka_sink_with_injected_producer():
+    sent = []
+
+    def producer(topic, key, value):
+        sent.append((topic, key, value))
+
+    msink = KafkaMetricSink("broker:9092", metric_topic="metrics",
+                            producer=producer)
+    msink.flush([im("k1", 5.0)])
+    assert sent[0][0] == "metrics"
+    assert json.loads(sent[0][2])["name"] == "k1"
+
+    ssink = KafkaSpanSink("broker:9092", span_topic="spans",
+                          serialization="protobuf", producer=producer)
+    ssink.ingest(make_span(trace_id=9, span_id=8))
+    topic, key, value = sent[-1]
+    assert topic == "spans"
+    from veneur_tpu.proto import ssf_pb2
+    back = ssf_pb2.SSFSpan.FromString(value)
+    assert back.id == 8
+
+
+def test_prometheus_translator():
+    from veneur_tpu.cli.prometheus import Translator, parse_exposition
+    text = """
+# TYPE http_requests_total counter
+http_requests_total{code="200"} 100
+# TYPE temp gauge
+temp 36.5
+# TYPE lat histogram
+lat_bucket{le="0.1"} 40
+lat_bucket{le="+Inf"} 50
+lat_sum 12.5
+lat_count 50
+"""
+    types, samples = parse_exposition(text)
+    assert types["http_requests_total"] == "counter"
+    tr = Translator(added_tags=["svc:web"])
+    first = tr.translate(types, samples)
+    # counters/histograms emit nothing on the priming poll; gauges do
+    pkts = [p.decode() for p in first]
+    assert any(p.startswith("temp:36.5|g") for p in pkts)
+    assert not any(p.startswith("http_requests_total") for p in pkts)
+
+    text2 = text.replace("100", "130").replace("} 40", "} 44")
+    t2, s2 = parse_exposition(text2)
+    second = [p.decode() for p in tr.translate(t2, s2)]
+    assert "http_requests_total:30|c|#code:200,svc:web" in second
+    assert any(p.startswith("lat_bucket:4|c|#le:0.1") for p in second)

@@ -1,0 +1,170 @@
+"""veneur-prometheus: poll a Prometheus /metrics endpoint and translate to
+statsd (reference cmd/veneur-prometheus: main.go polling loop,
+translate.go type translation with counter delta cache).
+
+Translation rules (translate.go):
+- counter  -> statsd count of the DELTA since the last poll (first poll
+  primes the cache, emits nothing)
+- gauge / untyped -> statsd gauge
+- histogram -> each bucket count delta as a count tagged le=<bound>, plus
+  _sum/_count deltas
+- summary -> quantile values as gauges tagged quantile=<q>, plus
+  _sum/_count deltas
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import re
+import socket
+import sys
+import time
+import urllib.request
+
+log = logging.getLogger("veneur_tpu.prometheus")
+
+_LINE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})?\s+(?P<value>[^ ]+)(?:\s+\d+)?$")
+_LABEL = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_exposition(text: str):
+    """-> (types: {name: type}, samples: [(name, labels dict, value)])."""
+    types = {}
+    samples = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            if len(parts) >= 4:
+                types[parts[2]] = parts[3]
+            continue
+        if line.startswith("#"):
+            continue
+        m = _LINE.match(line)
+        if not m:
+            continue
+        labels = dict(_LABEL.findall(m.group("labels") or ""))
+        try:
+            value = float(m.group("value"))
+        except ValueError:
+            continue
+        samples.append((m.group("name"), labels, value))
+    return types, samples
+
+
+def _series_key(name, labels):
+    return (name, tuple(sorted(labels.items())))
+
+
+class Translator:
+    """Stateful poll-to-statsd translation with the counter delta cache
+    (translate.go cache semantics)."""
+
+    def __init__(self, added_tags=()):
+        self.cache = {}
+        self.added_tags = list(added_tags)
+        self.primed = False
+
+    def _tags(self, labels, extra=()):
+        tags = [f"{k}:{v}" for k, v in sorted(labels.items())]
+        tags += self.added_tags
+        tags += list(extra)
+        return tags
+
+    def _pkt(self, name, value, mtype, tags):
+        s = f"{name}:{value}|{mtype}"
+        if tags:
+            s += "|#" + ",".join(tags)
+        return s.encode()
+
+    def _delta(self, key, value):
+        prev = self.cache.get(key)
+        self.cache[key] = value
+        if prev is None or value < prev:  # reset detection
+            return None
+        return value - prev
+
+    def translate(self, types, samples):
+        packets = []
+        for name, labels, value in samples:
+            base = name
+            for suffix in ("_bucket", "_sum", "_count"):
+                if name.endswith(suffix) and base[:-len(suffix)] in types:
+                    base = name[:-len(suffix)]
+                    break
+            mtype = types.get(name) or types.get(base, "untyped")
+            if mtype == "counter":
+                d = self._delta(_series_key(name, labels), value)
+                if d is not None and d > 0:
+                    packets.append(self._pkt(name, f"{d:g}", "c",
+                                             self._tags(labels)))
+            elif mtype in ("gauge", "untyped"):
+                packets.append(self._pkt(name, f"{value:g}", "g",
+                                         self._tags(labels)))
+            elif mtype == "histogram":
+                # bucket/count/sum are all cumulative -> deltas as counts
+                d = self._delta(_series_key(name, labels), value)
+                if d is not None and d > 0:
+                    packets.append(self._pkt(name, f"{d:g}", "c",
+                                             self._tags(labels)))
+            elif mtype == "summary":
+                if name.endswith(("_sum", "_count")):
+                    d = self._delta(_series_key(name, labels), value)
+                    if d is not None and d > 0:
+                        packets.append(self._pkt(name, f"{d:g}", "c",
+                                                 self._tags(labels)))
+                else:  # quantile gauge
+                    packets.append(self._pkt(name, f"{value:g}", "g",
+                                             self._tags(labels)))
+        return packets
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="veneur-tpu-prometheus")
+    ap.add_argument("-p", dest="prometheus_url",
+                    default="http://localhost:9090/metrics",
+                    help="Prometheus metrics endpoint to poll")
+    ap.add_argument("-h2", "--statsd-host", dest="statsd",
+                    default="127.0.0.1:8126")
+    ap.add_argument("-i", dest="interval", default="10s")
+    ap.add_argument("-a", dest="added_tags", default="",
+                    help="comma-separated tags added to every metric")
+    ap.add_argument("-once", action="store_true",
+                    help="poll once (two fetches for deltas) and exit")
+    args = ap.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO)
+    from veneur_tpu.config import parse_duration
+    interval = parse_duration(args.interval)
+    host, _, port = args.statsd.partition(":")
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    addr = (host, int(port or 8126))
+
+    tr = Translator([t for t in args.added_tags.split(",") if t])
+    polls = 0
+    while True:
+        try:
+            with urllib.request.urlopen(args.prometheus_url,
+                                        timeout=10) as resp:
+                text = resp.read().decode()
+            types, samples = parse_exposition(text)
+            packets = tr.translate(types, samples)
+            for p in packets:
+                sock.sendto(p, addr)
+            log.info("poll %d: %d samples -> %d packets", polls,
+                     len(samples), len(packets))
+        except Exception as e:
+            log.warning("poll failed: %s", e)
+        polls += 1
+        if args.once and polls >= 2:
+            return 0
+        time.sleep(interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

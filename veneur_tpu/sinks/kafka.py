@@ -1,0 +1,117 @@
+"""Kafka metric + span sink (reference sinks/kafka/kafka.go).
+
+The reference uses sarama async producers; this image carries no Kafka
+client library, so the producer is injectable: any callable
+`produce(topic: str, key: bytes, value: bytes)` (e.g.
+confluent_kafka.Producer(...).produce). Without one, construction tries
+`kafka-python` / `confluent_kafka` and raises a clear error if neither
+exists — the factory only wires this sink when kafka_broker is set.
+
+Serialization mirrors the reference: metrics as JSON, spans as protobuf or
+JSON (kafka_span_serialization_format), hash-partitioned by trace id via
+the message key (kafka.go:228-306), span sampling by tag/rate.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Callable, List, Optional
+
+from veneur_tpu.sinks.base import MetricSink, SpanSink, filter_acceptable
+
+log = logging.getLogger("veneur_tpu.sinks.kafka")
+
+
+def _default_producer(broker: str) -> Callable:
+    try:
+        from confluent_kafka import Producer  # type: ignore
+
+        p = Producer({"bootstrap.servers": broker})
+
+        def produce(topic, key, value):
+            p.produce(topic, key=key, value=value)
+            p.poll(0)
+
+        return produce
+    except ImportError:
+        pass
+    try:
+        from kafka import KafkaProducer  # type: ignore
+
+        p = KafkaProducer(bootstrap_servers=broker)
+        return lambda topic, key, value: p.send(topic, key=key, value=value)
+    except ImportError:
+        raise RuntimeError(
+            "kafka sink requires confluent_kafka or kafka-python, or an "
+            "injected producer callable")
+
+
+class KafkaMetricSink(MetricSink):
+    name = "kafka"
+
+    def __init__(self, broker: str, metric_topic: str,
+                 check_topic: str = "", producer: Optional[Callable] = None):
+        self.metric_topic = metric_topic
+        self.check_topic = check_topic
+        self.produce = producer or _default_producer(broker)
+        self.flushed = 0
+
+    def flush(self, metrics):
+        for m in filter_acceptable(metrics, self.name):
+            topic = (self.check_topic
+                     if m.type == "status" and self.check_topic
+                     else self.metric_topic)
+            value = json.dumps({
+                "name": m.name, "timestamp": m.timestamp,
+                "value": m.value, "tags": m.tags, "type": m.type,
+                "hostname": m.hostname,
+            }).encode()
+            try:
+                self.produce(topic, m.name.encode(), value)
+                self.flushed += 1
+            except Exception as e:
+                log.error("kafka produce failed: %s", e)
+
+
+class KafkaSpanSink(SpanSink):
+    name = "kafka"
+
+    def __init__(self, broker: str, span_topic: str,
+                 serialization: str = "protobuf",
+                 sample_rate_percent: int = 100, sample_tag: str = "",
+                 producer: Optional[Callable] = None):
+        self.span_topic = span_topic
+        self.serialization = serialization
+        self.sample_rate_percent = sample_rate_percent
+        self.sample_tag = sample_tag
+        self.produce = producer or _default_producer(broker)
+        self.sent = 0
+        self.skipped = 0
+
+    def ingest(self, span) -> None:
+        # sampling: by tag value hash when a sample tag is configured,
+        # else by trace id (kafka.go:228-306)
+        if self.sample_rate_percent < 100:
+            basis = (hash(span.tags.get(self.sample_tag, ""))
+                     if self.sample_tag else span.trace_id)
+            if (basis % 100) >= self.sample_rate_percent:
+                self.skipped += 1
+                return
+        key = b"%016x" % (span.trace_id & ((1 << 64) - 1))
+        if self.serialization == "json":
+            value = json.dumps({
+                "trace_id": span.trace_id, "id": span.id,
+                "parent_id": span.parent_id, "name": span.name,
+                "service": span.service, "error": span.error,
+                "start_timestamp": span.start_timestamp,
+                "end_timestamp": span.end_timestamp,
+                "tags": dict(span.tags),
+            }).encode()
+        else:
+            value = span.SerializeToString()
+        try:
+            self.produce(self.span_topic, key, value)
+            self.sent += 1
+        except Exception as e:
+            log.error("kafka span produce failed: %s", e)

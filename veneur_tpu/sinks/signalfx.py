@@ -1,0 +1,94 @@
+"""SignalFx metric sink (reference sinks/signalfx/signalfx.go).
+
+Datapoints posted as JSON to `{endpoint}/v2/datapoint` with an X-SF-Token
+header; counters as cumulative counters, everything else as gauges. The
+reference's per-tag API-token fan-out (vary_key_by + per-tag token map,
+signalfx.go:240-344) selects a client per metric by the value of one tag.
+No sfxclient dependency — urllib like the datadog sink.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import urllib.request
+from typing import Dict, List
+
+from veneur_tpu.samplers.intermetric import COUNTER, InterMetric
+from veneur_tpu.sinks.base import MetricSink, filter_acceptable
+
+log = logging.getLogger("veneur_tpu.sinks.signalfx")
+
+
+class SignalFxMetricSink(MetricSink):
+    name = "signalfx"
+
+    def __init__(self, api_key: str, endpoint: str, hostname: str,
+                 hostname_tag: str = "host",
+                 vary_key_by: str = "",
+                 per_tag_api_keys: Dict[str, str] = None,
+                 flush_max_per_body: int = 5000,
+                 metric_name_prefix_drops: List[str] = (),
+                 metric_tag_prefix_drops: List[str] = (),
+                 tags: List[str] = ()):
+        self.api_key = api_key
+        self.endpoint = endpoint.rstrip("/")
+        self.hostname = hostname
+        self.hostname_tag = hostname_tag
+        self.vary_key_by = vary_key_by
+        self.per_tag_api_keys = dict(per_tag_api_keys or {})
+        self.flush_max_per_body = flush_max_per_body
+        self.prefix_drops = list(metric_name_prefix_drops)
+        self.tag_prefix_drops = list(metric_tag_prefix_drops)
+        self.common_tags = list(tags)
+
+    def _datapoint(self, m: InterMetric):
+        dims = {self.hostname_tag: m.hostname or self.hostname}
+        for t in self.strip_excluded(m.tags) + self.common_tags:
+            if any(t.startswith(p) for p in self.tag_prefix_drops):
+                continue
+            k, _, v = t.partition(":")
+            dims[k] = v
+        return {"metric": m.name, "value": m.value,
+                "timestamp": int(m.timestamp * 1000), "dimensions": dims}
+
+    def _token_for(self, m: InterMetric) -> str:
+        """vary-by token selection (signalfx.go client fan-out)."""
+        if self.vary_key_by:
+            prefix = self.vary_key_by + ":"
+            for t in m.tags:
+                if t.startswith(prefix):
+                    return self.per_tag_api_keys.get(t[len(prefix):],
+                                                     self.api_key)
+        return self.api_key
+
+    def flush(self, metrics):
+        metrics = filter_acceptable(metrics, self.name)
+        by_token: Dict[str, Dict[str, list]] = {}
+        for m in metrics:
+            if any(m.name.startswith(p) for p in self.prefix_drops):
+                continue
+            kind = "counter" if m.type == COUNTER else "gauge"
+            body = by_token.setdefault(self._token_for(m),
+                                       {"counter": [], "gauge": []})
+            body[kind].append(self._datapoint(m))
+        for token, body in by_token.items():
+            points = body["counter"] + body["gauge"]
+            for i in range(0, max(len(points), 1), self.flush_max_per_body):
+                chunk = {
+                    "counter": body["counter"][i:i + self.flush_max_per_body],
+                    "gauge": body["gauge"][i:i + self.flush_max_per_body],
+                }
+                self._post(token, chunk)
+
+    def _post(self, token, body):
+        req = urllib.request.Request(
+            f"{self.endpoint}/v2/datapoint",
+            data=json.dumps(body).encode(), method="POST",
+            headers={"Content-Type": "application/json",
+                     "X-SF-Token": token})
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                resp.read()
+        except Exception as e:
+            log.error("signalfx flush failed: %s", e)

