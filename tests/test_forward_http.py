@@ -1,0 +1,120 @@
+"""HTTP-era forward path, unique-timeseries counting, datadog span sink,
+emit -ssf mode."""
+
+import json
+import socket
+import time
+
+import pytest
+
+from veneur_tpu.server.server import Server
+from veneur_tpu.sinks.debug import DebugMetricSink
+
+from tests.test_server import by_name, small_config, _send_udp, _wait_processed
+from tests.test_sinks import fake_api  # noqa: F401 — fixture
+from tests.test_spans import make_span
+
+
+def test_http_forward_to_global():
+    """local --HTTP /import--> global (flusher.go:338 flushForward)."""
+    gsink = DebugMetricSink()
+    glob = Server(small_config(http_address="127.0.0.1:0"),
+                  metric_sinks=[gsink])
+    glob.start()
+    local = Server(small_config(
+        forward_address=f"http://127.0.0.1:{glob.http_port}"),
+        metric_sinks=[DebugMetricSink()])
+    local.start()
+    try:
+        _send_udp(local.local_addr(), [b"httpfwd.count:21|c|#veneurglobalonly"])
+        _wait_processed(local, 1)
+        local.trigger_flush()
+        deadline = time.time() + 10
+        while time.time() < deadline and glob.aggregator.processed < 1:
+            time.sleep(0.05)
+        glob.trigger_flush()
+        assert by_name(gsink.flushed)["httpfwd.count"].value == 21.0
+    finally:
+        local.shutdown()
+        glob.shutdown()
+
+
+def test_unique_timeseries_counting():
+    from veneur_tpu.aggregation.host import KeyTable
+    from veneur_tpu.aggregation.state import TableSpec
+    from veneur_tpu.server.flusher import unique_timeseries
+
+    spec = TableSpec(counter_capacity=32, gauge_capacity=16,
+                     status_capacity=8, set_capacity=8, histo_capacity=16)
+    t = KeyTable(spec)
+    t.slot_for("counter", "c.mixed", (), 0, 1)
+    t.slot_for("counter", "c.global", (), 2, 2)
+    t.slot_for("gauge", "g.mixed", (), 0, 3)
+    t.slot_for("timer", "t.mixed", (), 0, 4)
+    t.slot_for("timer", "t.local", (), 1, 5)
+    t.slot_for("set", "s.mixed", (), 0, 6)
+    t.slot_for("status", "st", (), 0, 7)
+    # global instance counts everything
+    assert unique_timeseries(t, is_local=False) == 7
+    # local instance: non-forwarded only — c.mixed, g.mixed, t.local, status
+    assert unique_timeseries(t, is_local=True) == 4
+
+
+def test_unique_timeseries_self_metric():
+    sink = DebugMetricSink()
+    srv = Server(small_config(count_unique_timeseries=True),
+                 metric_sinks=[sink])
+    srv.start()
+    try:
+        _send_udp(srv.local_addr(), [b"u1:1|c", b"u2:2|c", b"u1:3|c"])
+        _wait_processed(srv, 3)
+        srv.trigger_flush()
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            srv.trigger_flush()
+            m = by_name(sink.flushed)
+            if "veneur.flush.unique_timeseries_total" in m:
+                break
+            time.sleep(0.05)
+        m = by_name(sink.flushed)
+        # 2 unique keys + any veneur.* self-metrics allocated that interval
+        assert m["veneur.flush.unique_timeseries_total"].value >= 2
+        assert "global_veneur:true" in m[
+            "veneur.flush.unique_timeseries_total"].tags
+    finally:
+        srv.shutdown()
+
+
+def test_datadog_span_sink(fake_api):  # noqa: F811
+    url, captured = fake_api
+    from veneur_tpu.sinks.datadog_spans import DatadogSpanSink
+    sink = DatadogSpanSink(url, buffer_size=100)
+    sink.ingest(make_span(trace_id=1, span_id=2, start=1, end=2))
+    sink.ingest(make_span(trace_id=1, span_id=3, start=1, end=3))
+    sink.ingest(make_span(trace_id=9, span_id=4, start=1, end=2))
+    sink.flush()
+    path, _, body = captured[0]
+    assert path == "/v0.3/traces"
+    traces = json.loads(body)
+    assert len(traces) == 2  # grouped by trace id
+    flat = [s for t in traces for s in t]
+    assert {s["span_id"] for s in flat} == {2, 3, 4}
+    assert all(s["duration"] > 0 for s in flat)
+
+
+def test_emit_ssf_mode():
+    recv = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    recv.bind(("127.0.0.1", 0))
+    recv.settimeout(5)
+    port = recv.getsockname()[1]
+    from veneur_tpu.cli.emit import main as emit_main
+    rc = emit_main(["-hostport", f"udp://127.0.0.1:{port}", "-ssf",
+                    "-name", "ssf.emitted", "-count", "5",
+                    "-tag", "env:dev"])
+    assert rc == 0
+    from veneur_tpu.protocol.wire import parse_ssf
+    span = parse_ssf(recv.recv(65536))
+    assert span.metrics[0].name == "ssf.emitted"
+    assert span.metrics[0].value == 5.0
+    assert span.metrics[0].tags["env"] == "dev"
+    recv.close()

@@ -46,7 +46,9 @@ def build_service_check_packet(name, status, tags=(), message=""):
     return body.encode()
 
 
-def open_sink(hostport: str):
+def open_sink(hostport: str, ssf: bool = False):
+    """ssf=True opens unix:// as a stream (the server's SSF unix listener
+    is SOCK_STREAM with framed spans); statsd unix:// is datagram."""
     from veneur_tpu.server.server import resolve_addr
     kind, target = resolve_addr(hostport)
     if kind == "udp":
@@ -54,6 +56,9 @@ def open_sink(hostport: str):
         sock.connect(target)
     elif kind == "tcp":
         sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.connect(target)
+    elif kind == "unix" and ssf:
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         sock.connect(target)
     else:
         sock = socket.socket(socket.AF_UNIX, socket.SOCK_DGRAM)
@@ -76,17 +81,32 @@ def main(argv=None):
     ap.add_argument("-sc_name", default="")
     ap.add_argument("-sc_status", type=int, default=0)
     ap.add_argument("-sc_msg", default="")
+    ap.add_argument("-ssf", action="store_true",
+                    help="emit SSF protobuf instead of statsd text "
+                         "(reference veneur-emit -ssf)")
+    ap.add_argument("-service", default="veneur-emit")
+    ap.add_argument("-indicator", action="store_true")
     ap.add_argument("-command", nargs=argparse.REMAINDER, default=None,
-                    help="run command, emit its wall time as a timer")
+                    help="run command, emit its wall time as a timer "
+                         "(with -ssf: as a full span)")
     ap.add_argument("-replay", type=int, default=0,
                     help="benchmark mode: send N random counter packets")
     ap.add_argument("-replay_names", type=int, default=10000)
     args = ap.parse_args(argv)
 
     tags = [t for t in args.tag.split(",") if t]
-    kind, sock = open_sink(args.hostport)
+    if args.ssf and (args.event_title or args.sc_name
+                     or args.sample_rate != 1.0):
+        print("-ssf mode does not support events, service checks, or "
+              "sample rates (reference veneur-emit rejects these too)",
+              file=sys.stderr)
+        return 2
+    kind, sock = open_sink(args.hostport, ssf=args.ssf)
     nl = b"\n" if kind == "tcp" else b""
     packets = []
+
+    if args.ssf:
+        return _emit_ssf(args, tags, kind, sock)
 
     if args.command:
         t0 = time.perf_counter()
@@ -133,6 +153,51 @@ def main(argv=None):
         sock.send(p + nl)
     sock.close()
     return 0
+
+
+def _emit_ssf(args, tags, kind, sock):
+    """SSF output mode (reference cmd/veneur-emit -ssf: metrics ride a
+    carrier span; -command emits a real timed span, main.go:440
+    timeCommand)."""
+    from veneur_tpu.proto import ssf_pb2
+    from veneur_tpu.protocol.wire import write_ssf
+    from veneur_tpu.samplers import ssf_samples
+    from veneur_tpu.trace.tracer import Span
+
+    tag_map = dict(t.split(":", 1) if ":" in t else (t, "")
+                   for t in tags)
+    rc = 0
+    if args.command:
+        span = Span(args.name or " ".join(args.command),
+                    service=args.service, indicator=args.indicator,
+                    tags=tag_map)
+        rc = subprocess.call(args.command)
+        span.error = rc != 0
+        ssf_span = span.finish()
+    else:
+        ssf_span = ssf_pb2.SSFSpan()
+        samples = []
+        if args.count is not None:
+            samples.append(ssf_samples.count(args.name, args.count, tag_map))
+        if args.gauge is not None:
+            samples.append(ssf_samples.gauge(args.name, args.gauge, tag_map))
+        if args.timing is not None:
+            from veneur_tpu.config import parse_duration
+            samples.append(ssf_samples.timing(
+                args.name, parse_duration(args.timing), tag_map))
+        if args.set_ is not None:
+            samples.append(ssf_samples.set_(args.name, args.set_, tag_map))
+        for s in samples:
+            ssf_span.metrics.append(s)
+
+    if kind in ("tcp", "unix"):
+        f = sock.makefile("wb")
+        write_ssf(f, ssf_span)
+        f.flush()
+    else:
+        sock.send(ssf_span.SerializeToString())
+    sock.close()
+    return rc
 
 
 if __name__ == "__main__":

@@ -39,6 +39,35 @@ class ForwardClient:
         self._channel.close()
 
 
+class HTTPForwardClient:
+    """HTTP-era forwarding (reference flusher.go:338 flushForward →
+    POST /import): the same MetricList protobuf body the gRPC path carries,
+    zlib-deflated, to the peer's /import endpoint (httpapi.py). The
+    reference's JSON+gob body is Go-specific; the protobuf body is this
+    framework's portable equivalent."""
+
+    def __init__(self, address: str):
+        self.address = address.rstrip("/")
+        if not self.address.startswith(("http://", "https://")):
+            self.address = "http://" + self.address
+
+    def send_metrics(self, metrics: List, timeout: float = 10.0) -> None:
+        import urllib.request
+        import zlib
+
+        body = zlib.compress(
+            fpb.MetricList(metrics=metrics).SerializeToString())
+        req = urllib.request.Request(
+            f"{self.address}/import", data=body, method="POST",
+            headers={"Content-Type": "application/x-protobuf",
+                     "Content-Encoding": "deflate"})
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            resp.read()
+
+    def close(self):
+        pass
+
+
 def make_forward_service(handler: Callable[[List], None]):
     """A generic gRPC handler for the Forward service calling
     `handler(metrics)` per request (the shape of reference

@@ -70,6 +70,11 @@ def new_from_config(cfg: Config, extra_metric_sinks=(), extra_span_sinks=(),
     # tracing sinks only exist when spans can arrive
     # (reference server.go:516 gates on ssf_listen_addresses)
     spans_enabled = bool(cfg.ssf_listen_addresses)
+    if spans_enabled and cfg.datadog_trace_api_address:
+        from veneur_tpu.sinks.datadog_spans import DatadogSpanSink
+        span_sinks.append(DatadogSpanSink(
+            cfg.datadog_trace_api_address,
+            buffer_size=cfg.datadog_span_buffer_size or 16384))
     if spans_enabled and cfg.splunk_hec_address:
         from veneur_tpu.config import parse_duration
         from veneur_tpu.sinks.splunk import SplunkSpanSink

@@ -48,6 +48,25 @@ def percentile_name(p: float) -> str:
     return f"{int(p * 100)}percentile"
 
 
+def unique_timeseries(table: KeyTable, is_local: bool) -> int:
+    """Count of unique timeseries this interval, per the reference's
+    sampling rules (worker.go:300-341 SampleTimeseries): a global instance
+    counts everything; a local one counts only what it will NOT forward
+    (counters/gauges unless global-scoped; histos/sets/timers only when
+    local-only; status always). Exact (slot allocation is per-key), where
+    the reference uses an HLL estimate over digests."""
+    n = 0
+    for kind in ("counter", "gauge", "set", "histogram", "status"):
+        for _slot, meta in table.get_meta(kind):
+            if not is_local or meta.kind == "status":
+                n += 1
+            elif meta.kind in ("counter", "gauge"):
+                n += meta.scope != SCOPE_GLOBAL
+            else:  # histogram / timer / set
+                n += meta.scope == SCOPE_LOCAL
+    return n
+
+
 def generate_intermetrics(flush: Dict[str, np.ndarray], table: KeyTable,
                           *, percentiles: List[float], aggregates: List[str],
                           is_local: bool, timestamp: int,

@@ -132,6 +132,7 @@ class Server:
         from veneur_tpu.trace.client import ChannelBackend, Client
         self.trace_client = Client(ChannelBackend(self.span_pipeline))
         self._last_stats = {}
+        self._unique_ts = None
 
         self.event_samples = []       # EventWorker buffer (worker.go:527)
         self._event_lock = threading.Lock()
@@ -511,14 +512,21 @@ class Server:
                 else f"tcp://{self.cfg.grpc_address}")
             self._grpc_server, self.grpc_port = rpc.serve(
                 self.import_metrics, f"{target[0]}:{target[1]}")
-        # forwarding client, dialed once at start (server.go:843-851)
+        # forwarding client, dialed once at start (server.go:843-851);
+        # http(s):// addresses take the HTTP /import path unless
+        # forward_use_grpc forces gRPC (flusher.go:84-95 dispatch)
         if self.cfg.is_local:
-            from veneur_tpu.forward.rpc import ForwardClient
+            from veneur_tpu.forward.rpc import (
+                ForwardClient, HTTPForwardClient)
             addr = self.cfg.forward_address
-            for prefix in ("http://", "https://", "grpc://", "tcp://"):
-                if addr.startswith(prefix):
-                    addr = addr[len(prefix):]
-            self._forward_client = ForwardClient(addr)
+            is_http = addr.startswith(("http://", "https://"))
+            if is_http and not self.cfg.forward_use_grpc:
+                self._forward_client = HTTPForwardClient(addr)
+            else:
+                for prefix in ("http://", "https://", "grpc://", "tcp://"):
+                    if addr.startswith(prefix):
+                        addr = addr[len(prefix):]
+                self._forward_client = ForwardClient(addr)
 
     def import_metrics(self, metrics: List) -> None:
         """gRPC import entry: enqueue onto the pipeline thread
@@ -567,6 +575,10 @@ class Server:
                              daemon=True).start()
         else:
             flush_arrays, table = self.aggregator.flush(self.cfg.percentiles)
+
+        if self.cfg.count_unique_timeseries:
+            from veneur_tpu.server.flusher import unique_timeseries
+            self._unique_ts = unique_timeseries(table, self.cfg.is_local)
 
         # span sinks flush concurrently (flusher.go:56 go flushTraces)
         threading.Thread(target=self.span_pipeline.flush,
@@ -623,6 +635,11 @@ class Server:
                                       flush_seconds),
                    ssf_samples.gauge("veneur.flush.metrics_total",
                                      n_flushed)]
+        if self._unique_ts is not None:
+            samples.append(ssf_samples.count(
+                "veneur.flush.unique_timeseries_total", self._unique_ts,
+                {"global_veneur": str(not self.cfg.is_local).lower()}))
+            self._unique_ts = None
         for name, total in cur.items():
             delta = total - self._last_stats.get(name, 0)
             self._last_stats[name] = total
