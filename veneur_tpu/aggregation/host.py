@@ -244,9 +244,31 @@ class Batcher:
         return (self.nc + self.ng + self.nst + self.ns + self.nh
                 + self.nhs)
 
-    def emit(self) -> Optional[Batch]:
+    def force_emit(self) -> Batch:
+        """Emit unconditionally (possibly all-padding) WITHOUT notifying
+        on_batch — for callers that stack per-shard batches themselves
+        (server/sharded_aggregator.py)."""
+        b = self.emit(notify=False)
+        if b is None:
+            b = Batch(
+                counter_slot=self.c_slot.copy(), counter_inc=self.c_inc.copy(),
+                gauge_slot=self.g_slot.copy(), gauge_val=self.g_val.copy(),
+                status_slot=self.st_slot.copy(), status_val=self.st_val.copy(),
+                set_slot=self.s_slot.copy(), set_reg=self.s_reg.copy(),
+                set_rho=self.s_rho.copy(),
+                histo_slot=self.h_slot.copy(), histo_val=self.h_val.copy(),
+                histo_wt=self.h_wt.copy(),
+                histo_stat_slot=self.hs_slot.copy(),
+                histo_stat_min=self.hs_min.copy(),
+                histo_stat_max=self.hs_max.copy(),
+                histo_stat_recip=self.hs_recip.copy(),
+            )
+        return b
+
+    def emit(self, notify: bool = True) -> Optional[Batch]:
         """Build a padded Batch from staged samples, reset staging, and pass
-        it to on_batch (if set). Returns the Batch (None if empty)."""
+        it to on_batch (if set and notify). Returns the Batch (None if
+        empty)."""
         if self.pending() == 0:
             return None
         batch = Batch(
@@ -275,6 +297,6 @@ class Batcher:
         self.c_inc[:self.nc] = 0.0
         self.h_wt[:self.nh] = 0.0
         self.nc = self.ng = self.nst = self.ns = self.nh = self.nhs = 0
-        if self.on_batch is not None:
+        if notify and self.on_batch is not None:
             self.on_batch(batch)
         return batch

@@ -96,9 +96,9 @@ def main(argv=None):
 
     tags = [t for t in args.tag.split(",") if t]
     if args.ssf and (args.event_title or args.sc_name
-                     or args.sample_rate != 1.0):
-        print("-ssf mode does not support events, service checks, or "
-              "sample rates (reference veneur-emit rejects these too)",
+                     or args.sample_rate != 1.0 or args.replay):
+        print("-ssf mode does not support events, service checks, sample "
+              "rates, or -replay (reference veneur-emit rejects these too)",
               file=sys.stderr)
         return 2
     kind, sock = open_sink(args.hostport, ssf=args.ssf)

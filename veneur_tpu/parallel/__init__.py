@@ -4,6 +4,8 @@ from veneur_tpu.parallel.sharded import (  # noqa: F401
     make_mesh,
     sharded_empty_state,
     make_sharded_ingest,
+    make_sharded_fold,
+    make_sharded_compact,
     make_merged_flush,
     stack_batches,
 )

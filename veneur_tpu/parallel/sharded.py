@@ -185,6 +185,28 @@ def _merge_replica_block(state: DeviceState, spec: TableSpec):
     return merged
 
 
+def make_sharded_fold(mesh: Mesh):
+    """Per-tile fold_scalars over the mesh (bounds f32 accumulator error
+    exactly like the single-device fold_every cadence)."""
+    from veneur_tpu.aggregation.step import fold_scalars
+    vv = jax.vmap(jax.vmap(fold_scalars))
+    fn = jax.shard_map(vv, mesh=mesh,
+                       in_specs=P(REPLICA_AXIS, SHARD_AXIS),
+                       out_specs=P(REPLICA_AXIS, SHARD_AXIS))
+    return jax.jit(fn, donate_argnums=(0,))
+
+
+def make_sharded_compact(mesh: Mesh, spec: TableSpec):
+    """Per-tile digest re-compression over the mesh."""
+    from veneur_tpu.aggregation.step import compact_core
+    core = partial(compact_core, spec=spec)
+    vv = jax.vmap(jax.vmap(core))
+    fn = jax.shard_map(vv, mesh=mesh,
+                       in_specs=P(REPLICA_AXIS, SHARD_AXIS),
+                       out_specs=P(REPLICA_AXIS, SHARD_AXIS))
+    return jax.jit(fn, donate_argnums=(0,))
+
+
 def make_merged_flush(mesh: Mesh, spec: TableSpec):
     """Jitted (state[R,S,...], qs[Q]) -> flush dict with leading [S] dim:
     replica-merged, per-shard final aggregates. The replica merge is the

@@ -66,6 +66,11 @@ def resolve_addr(addr: str):
     raise ValueError(f"unsupported listener scheme in {addr!r}")
 
 
+def _native_available() -> bool:
+    from veneur_tpu import native
+    return native.available()
+
+
 def spec_from_config(cfg: Config) -> TableSpec:
     return TableSpec(
         counter_capacity=cfg.tpu_counter_capacity,
@@ -94,14 +99,26 @@ class Server:
             compact_every=cfg.tpu_compact_every,
             fold_every=cfg.tpu_fold_every)
         self._native = False
-        if cfg.native_ingest:
-            from veneur_tpu import native
-            if native.available():
-                from veneur_tpu.server.native_aggregator import (
-                    NativeAggregator)
-                self.aggregator = NativeAggregator(**agg_args)
-                self._native = True
-        if not self._native:
+        n_shards = agg_args["n_shards"]
+        if cfg.tpu_n_shards == 0:
+            # auto: one shard per accelerator when several are attached
+            # (virtual CPU meshes stay single-shard unless explicitly
+            # configured — tests opt in via tpu_n_shards)
+            import jax
+            devices = jax.devices()
+            if len(devices) > 1 and devices[0].platform != "cpu":
+                n_shards = len(devices)
+        if n_shards > 1:
+            # device scale-out: sharded mesh backend (parallel/sharded.py)
+            from veneur_tpu.server.sharded_aggregator import (
+                ShardedAggregator)
+            agg_args["n_shards"] = n_shards
+            self.aggregator = ShardedAggregator(**agg_args)
+        elif cfg.native_ingest and _native_available():
+            from veneur_tpu.server.native_aggregator import NativeAggregator
+            self.aggregator = NativeAggregator(**agg_args)
+            self._native = True
+        else:
             self.aggregator = Aggregator(**agg_args)
         self.metric_sinks = list(metric_sinks or [])
         self.span_sinks = list(span_sinks or [])

@@ -1,0 +1,239 @@
+"""Multi-device aggregation backend: the key table sharded over a device
+mesh (veneur_tpu/parallel/sharded.py) behind the same Aggregator interface
+the Server uses.
+
+The key space splits across `n_shards` mesh tiles by the reference's
+`Digest % numWorkers` rule (host.py assigns slot = shard*per_shard+idx, so
+the GLOBAL slot flattening of per-shard flush arrays lines up with the
+KeyTable's slot numbers by construction). Each shard has its own staging
+Batcher; batches emit for ALL shards together (stacked [1, S, ...]) so one
+sharded ingest program serves every step, with each tile's scatters local
+to its device.
+
+Config: tpu_n_shards > 1 (or 0 = one shard per local device when several
+devices are present). Native C++ staging currently pairs with the
+single-device backend; sharded mode uses Python staging (the mesh path is
+about device scale-out, not host parse throughput).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from veneur_tpu.aggregation.host import Batcher, BatchSpec, KeyTable
+from veneur_tpu.aggregation.state import TableSpec
+from veneur_tpu.server.aggregator import Aggregator
+
+
+def per_shard_spec(spec: TableSpec, n_shards: int) -> TableSpec:
+    import dataclasses
+    for field in ("counter_capacity", "gauge_capacity", "status_capacity",
+                  "set_capacity", "histo_capacity"):
+        cap = getattr(spec, field)
+        if cap % n_shards or cap < n_shards:
+            raise ValueError(
+                f"tpu_{field} ({cap}) must be a positive multiple of "
+                f"tpu_n_shards ({n_shards})")
+    return dataclasses.replace(
+        spec,
+        counter_capacity=spec.counter_capacity // n_shards,
+        gauge_capacity=spec.gauge_capacity // n_shards,
+        status_capacity=spec.status_capacity // n_shards,
+        set_capacity=spec.set_capacity // n_shards,
+        histo_capacity=spec.histo_capacity // n_shards)
+
+
+class ShardedAggregator(Aggregator):
+    def __init__(self, spec: TableSpec, bspec: BatchSpec = BatchSpec(),
+                 n_shards: int = 2, compact_every: int = 32,
+                 fold_every: int = 64):
+        import jax
+        from veneur_tpu.parallel import (
+            make_mesh, make_merged_flush, make_sharded_ingest,
+            sharded_empty_state)
+
+        self.spec = spec            # total capacities (KeyTable slot space)
+        self.pspec = per_shard_spec(spec, n_shards)
+        self.bspec = bspec
+        self.n_shards = n_shards
+        self.compact_every = compact_every
+        self.fold_every = fold_every
+
+        self.mesh = make_mesh(1, n_shards)
+        self._ingest = make_sharded_ingest(self.mesh, self.pspec)
+        self._flush = make_merged_flush(self.mesh, self.pspec)
+        from veneur_tpu.parallel import (
+            make_sharded_compact, make_sharded_fold)
+        self._fold = make_sharded_fold(self.mesh)
+        self._compact = make_sharded_compact(self.mesh, self.pspec)
+        self._empty = partial(sharded_empty_state, self.pspec, 1, n_shards,
+                              self.mesh)
+        self.state = self._empty()
+        self.table = KeyTable(spec, n_shards)
+        self.batchers = self._make_batchers()
+        self._hll_slots: List[Tuple[int, int]] = []  # (shard, local_slot)
+        self._hll_rows: List[np.ndarray] = []
+        self._steps = 0
+        self.processed = 0
+        self.dropped_capacity = 0
+
+    # -- slot routing --------------------------------------------------------
+    def _local(self, kind: str, slot: int) -> Tuple[int, int]:
+        """global slot -> (shard, local slot); per-kind shard width."""
+        per = self.table.tables[KeyTable._table_name(kind)].per_shard
+        return slot // per, slot % per
+
+    def process_metric(self, m) -> None:
+        kind = m.type
+        slot = self.table.slot_for(kind, m.name, m.tags, m.scope, m.digest,
+                                   hostname=m.hostname)
+        if slot is None:
+            self.dropped_capacity += 1
+            return
+        if kind in ("histogram", "timer"):
+            mt = self.table.meta_for_slot(kind, slot)
+            if mt is not None and mt.imported_only:
+                mt.imported_only = False
+        shard, local = self._local(kind, slot)
+        b = self.batchers[shard]
+        if kind == "counter":
+            b.add_counter(local, float(m.value), m.sample_rate)
+        elif kind == "gauge":
+            b.add_gauge(local, float(m.value))
+        elif kind == "status":
+            b.add_status(local, float(m.value))
+            mt = self.table.meta_for_slot("status", slot)
+            if mt is not None:
+                mt.message = m.message
+        elif kind == "set":
+            member = m.value if isinstance(m.value, bytes) else str(
+                m.value).encode()
+            b.add_set(local, member)
+        elif kind in ("histogram", "timer"):
+            b.add_histo(local, float(m.value), m.sample_rate)
+        self.processed += 1
+
+    def import_metric(self, kind: str, name: str, tags: tuple, scope: int,
+                      digest: int, payload: dict) -> None:
+        slot = self.table.slot_for(kind, name, tags, scope, digest,
+                                   imported=True)
+        if slot is None:
+            self.dropped_capacity += 1
+            return
+        shard, local = self._local(kind, slot)
+        b = self.batchers[shard]
+        if kind == "counter":
+            b.add_counter(local, float(payload["value"]), 1.0)
+        elif kind == "gauge":
+            b.add_gauge(local, float(payload["value"]))
+        elif kind == "set":
+            regs = payload["registers"]
+            if regs.shape[0] != self.pspec.registers:
+                raise ValueError("imported HLL register-count mismatch")
+            self._hll_slots.append((shard, local))
+            self._hll_rows.append(regs)
+        elif kind in ("histogram", "timer"):
+            means = np.asarray(payload["means"], np.float32)
+            weights = np.asarray(payload["weights"], np.float32)
+            live = weights > 0
+            means, weights = means[live], weights[live]
+            for v, w in zip(means, weights):
+                b.add_histo_weighted(local, float(v), float(w))
+            recip = payload.get("recip")
+            recip_corr = 0.0
+            if recip is not None and np.all(means != 0.0):
+                recip_corr = float(recip) - float(np.sum(weights / means))
+            b.add_histo_stats(local, float(payload.get("min", np.inf)),
+                              float(payload.get("max", -np.inf)),
+                              recip_corr)
+        self.processed += 1
+
+    # -- device steps --------------------------------------------------------
+    def _make_batchers(self):
+        """One staging Batcher per shard; when ANY shard's lane fills, every
+        shard emits (padded) so the stacked [1, S] batch stays rectangular
+        and one compiled program serves every step."""
+        return [Batcher(self.pspec, self.bspec,
+                        on_batch=partial(self._on_shard_batch, i))
+                for i in range(self.n_shards)]
+
+    def _on_shard_batch(self, shard: int, batch):
+        from veneur_tpu.parallel import stack_batches
+        row = [batch if i == shard else b.force_emit()
+               for i, b in enumerate(self.batchers)]
+        self.state = self._ingest(self.state,
+                                  stack_batches([row], 1, self.n_shards))
+        self._steps += 1
+        # same accumulator-precision cadence as the single-device backend
+        # (Aggregator._on_batch): compact digests / fold f32 accumulators
+        if self._steps % self.compact_every == 0:
+            self.state = self._compact(self.state)
+        if self._steps % self.fold_every == 0:
+            self.state = self._fold(self.state)
+
+    def _emit_all(self):
+        from veneur_tpu.parallel import stack_batches
+        if not any(b.pending() for b in self.batchers):
+            return
+        row = [b.force_emit() for b in self.batchers]
+        self.state = self._ingest(self.state,
+                                  stack_batches([row], 1, self.n_shards))
+        self._steps += 1
+
+    def _apply_hll_imports(self):
+        """Imported HLL rows merge host-side then re-place sharded (rare
+        path: only a global tier with sharded state receives these)."""
+        if not self._hll_slots:
+            return
+        import jax
+        import jax.numpy as jnp
+        from veneur_tpu.parallel.sharded import state_sharding
+
+        hll = np.array(self.state.hll)   # [1, S, K, R] host copy
+        for (shard, local), regs in zip(self._hll_slots, self._hll_rows):
+            hll[0, shard, local] = np.maximum(hll[0, shard, local], regs)
+        self.state = self.state._replace(hll=jax.device_put(
+            jnp.asarray(hll), state_sharding(self.mesh)))
+        self._hll_slots, self._hll_rows = [], []
+
+    # -- flush ---------------------------------------------------------------
+    def flush(self, percentiles, want_raw: bool = False):
+        import jax.numpy as jnp
+
+        self._emit_all()
+        self._apply_hll_imports()
+        state, table = self.state, self.table
+        self.state = self._empty()
+        self.table = KeyTable(self.spec, self.n_shards)
+        self.batchers = self._make_batchers()
+        self._steps = 0
+
+        qs = jnp.asarray(percentiles or [0.5], jnp.float32)
+        out = self._flush(state, qs)
+        # flatten [S, K_per] -> [S*K_per]: matches KeyTable's global slots
+        result = {k: np.asarray(v).reshape((-1,) + np.asarray(v).shape[2:])
+                  for k, v in out.items()}
+        if want_raw:
+            def flat(x, extra=()):
+                a = np.asarray(x)
+                return a.reshape((-1,) + a.shape[3:])  # drop [R=1, S]
+
+            w = flat(state.h_w)
+            wm = flat(state.h_wm)
+            raw = {
+                "counter": result["counter"],
+                "gauge": result["gauge"],
+                "hll": np.asarray(state.hll).reshape(
+                    (-1, self.pspec.registers)),
+                "h_mean": np.where(w > 0, wm / np.maximum(w, 1e-30), 0.0),
+                "h_weight": w,
+                "h_min": flat(state.h_min),
+                "h_max": flat(state.h_max),
+                "h_recip": flat(state.h_recip_hi) + flat(state.h_recip_lo)
+                + flat(state.h_recip_acc),
+            }
+            return result, table, raw
+        return result, table
