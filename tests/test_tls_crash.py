@@ -1,0 +1,159 @@
+"""TLS client-cert auth matrix (reference server_test.go:469 TestTCPConfig)
++ crash-reporting client + self-metric scope normalization."""
+
+import datetime
+import socket
+import ssl
+import subprocess
+import time
+
+import pytest
+
+from veneur_tpu.server.server import Server
+from veneur_tpu.sinks.debug import DebugMetricSink
+
+from tests.test_server import by_name, small_config, _wait_processed
+
+
+@pytest.fixture(scope="module")
+def certs(tmp_path_factory):
+    """CA + server cert + client cert (signed) + rogue client cert
+    (self-signed) via openssl."""
+    d = tmp_path_factory.mktemp("tls")
+
+    def run(*args):
+        subprocess.run(args, check=True, capture_output=True, cwd=d)
+
+    run("openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+        "-keyout", "ca.key", "-out", "ca.crt", "-days", "1",
+        "-subj", "/CN=test-ca")
+    for name, signer in (("server", "ca"), ("client", "ca")):
+        run("openssl", "req", "-newkey", "rsa:2048", "-nodes",
+            "-keyout", f"{name}.key", "-out", f"{name}.csr",
+            "-subj", f"/CN={name}", "-addext",
+            "subjectAltName=IP:127.0.0.1")
+        run("openssl", "x509", "-req", "-in", f"{name}.csr",
+            "-CA", f"{signer}.crt", "-CAkey", f"{signer}.key",
+            "-CAcreateserial", "-out", f"{name}.crt", "-days", "1",
+            "-copy_extensions", "copyall")
+    run("openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+        "-keyout", "rogue.key", "-out", "rogue.crt", "-days", "1",
+        "-subj", "/CN=rogue")
+    return d
+
+
+def read(d, name):
+    return (d / name).read_text()
+
+
+@pytest.fixture
+def tls_server(certs):
+    sink = DebugMetricSink()
+    srv = Server(small_config(
+        statsd_listen_addresses=["tcp://127.0.0.1:0"],
+        tls_key=read(certs, "server.key"),
+        tls_certificate=read(certs, "server.crt"),
+        tls_authority_certificate=read(certs, "ca.crt")),
+        metric_sinks=[sink])
+    srv.start()
+    yield srv, sink, certs
+    srv.shutdown()
+
+
+def _tls_connect(addr, certs, cert=None, key=None):
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    ctx.load_verify_locations(str(certs / "ca.crt"))
+    ctx.check_hostname = False
+    ctx.verify_mode = ssl.CERT_NONE
+    if cert:
+        ctx.load_cert_chain(str(certs / cert), str(certs / key))
+    raw = socket.create_connection(addr, timeout=5)
+    return ctx.wrap_socket(raw)
+
+
+def test_tls_correct_client_cert(tls_server):
+    srv, sink, certs = tls_server
+    s = _tls_connect(srv.local_addr(), certs, "client.crt", "client.key")
+    s.sendall(b"tls.counter:8|c\n")
+    s.close()
+    _wait_processed(srv, 1)
+    srv.trigger_flush()
+    assert by_name(sink.flushed)["tls.counter"].value == 8.0
+
+
+def test_tls_no_or_wrong_cert_rejected(tls_server):
+    srv, sink, certs = tls_server
+    before = srv.aggregator.processed
+    # no client cert: handshake must fail
+    with pytest.raises((ssl.SSLError, OSError)):
+        s = _tls_connect(srv.local_addr(), certs)
+        s.sendall(b"tls.nocert:1|c\n")
+        s.recv(1)  # force the alert to surface
+    # self-signed (wrong CA) cert: rejected too
+    with pytest.raises((ssl.SSLError, OSError)):
+        s = _tls_connect(srv.local_addr(), certs, "rogue.crt", "rogue.key")
+        s.sendall(b"tls.rogue:1|c\n")
+        s.recv(1)
+    time.sleep(0.3)
+    assert srv.aggregator.processed == before
+
+
+def test_sentry_client_payload():
+    import json
+    from veneur_tpu.utils.crash import SentryClient
+
+    c = SentryClient("https://abc123@sentry.example.com/42")
+    assert c.store_url == "https://sentry.example.com/api/42/store/"
+    sent = {}
+
+    def fake_send(event):
+        sent.update(event)
+
+    c._send = fake_send
+    try:
+        raise RuntimeError("boom")
+    except RuntimeError as e:
+        c.capture_exception(e)
+    exc = sent["exception"]["values"][0]
+    assert exc["type"] == "RuntimeError"
+    assert exc["value"] == "boom"
+    assert exc["stacktrace"]["frames"]
+
+    with pytest.raises(ValueError):
+        SentryClient("not-a-dsn")
+
+
+def test_consume_panic_reraises():
+    from veneur_tpu.utils import crash
+
+    with pytest.raises(KeyError):
+        try:
+            raise KeyError("k")
+        except KeyError as e:
+            crash.consume_panic(e)
+
+
+def test_self_metric_scope_normalization():
+    sink = DebugMetricSink()
+    srv = Server(small_config(
+        veneur_metrics_scopes={"counter": "local"},
+        veneur_metrics_additional_tags=["deploy:canary"]),
+        metric_sinks=[sink])
+    srv.start()
+    try:
+        srv.trigger_flush()  # generates self-metrics
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            srv.trigger_flush()
+            m = by_name(sink.flushed)
+            hit = [x for x in sink.flushed
+                   if x.name == "veneur.flush.metrics_total"]
+            if hit:
+                break
+            time.sleep(0.05)
+        hit = [x for x in sink.flushed
+               if x.name.startswith("veneur.flush.")]
+        assert hit
+        assert any("deploy:canary" in x.tags for x in hit)
+    finally:
+        srv.shutdown()

@@ -417,6 +417,16 @@ class Server:
 
     def start(self):
         """reference server.go:771 Start + networking.go:19 StartStatsd."""
+        if self.cfg.sentry_dsn:
+            from veneur_tpu.utils import crash
+            crash.setup(self.cfg.sentry_dsn)
+            crash.hook_threads()
+        if self.cfg.enable_profiling:
+            # reference server.go:1337 pkg/profile CPU profile; dumped as
+            # pstats at shutdown
+            import cProfile
+            self._profiler = cProfile.Profile()
+            self._profiler.enable()
         for sink in self.metric_sinks + self.span_sinks:
             sink.start()
         t = threading.Thread(target=self._pipeline_loop, daemon=True,
@@ -662,7 +672,32 @@ class Server:
             self._last_stats[name] = total
             if delta:
                 samples.append(ssf_samples.count(name, delta))
+        self._normalize_self_samples(samples)
         report_batch(self.trace_client, samples)
+
+    def _normalize_self_samples(self, samples):
+        """veneur_metrics_scopes / veneur_metrics_additional_tags applied
+        to self-telemetry (reference scopedstatsd/client.go:33-58 +
+        normalizeSpans server.go:179-238)."""
+        from veneur_tpu.proto import ssf_pb2
+        scopes = self.cfg.veneur_metrics_scopes or {}
+        scope_by_type = {
+            ssf_pb2.SSFSample.COUNTER: scopes.get("counter"),
+            ssf_pb2.SSFSample.GAUGE: scopes.get("gauge"),
+            ssf_pb2.SSFSample.HISTOGRAM: scopes.get("histogram"),
+            ssf_pb2.SSFSample.SET: scopes.get("set"),
+            ssf_pb2.SSFSample.STATUS: scopes.get("status"),
+        }
+        extra = [t.split(":", 1) if ":" in t else (t, "")
+                 for t in self.cfg.veneur_metrics_additional_tags]
+        for s in samples:
+            want = scope_by_type.get(s.metric)
+            if want == "local":
+                s.scope = ssf_pb2.SSFSample.LOCAL
+            elif want == "global":
+                s.scope = ssf_pb2.SSFSample.GLOBAL
+            for k, v in extra:
+                s.tags[k] = v
 
     def _forward(self, raw, table):
         """Serialize and ship forwardable sketch state
@@ -706,6 +741,12 @@ class Server:
                 s.close()
             except OSError:
                 pass
+        prof = getattr(self, "_profiler", None)
+        if prof is not None:
+            prof.disable()
+            path = "/tmp/veneur_tpu_profile.pstats"
+            prof.dump_stats(path)
+            log.info("CPU profile written to %s", path)
         self.trace_client.close()
         self.span_pipeline.stop()
         if self._httpd is not None:
