@@ -71,3 +71,110 @@ def test_out_of_range_slot_dropped():
     out = hll.insert_batch(regs, jnp.asarray(slot), jnp.asarray(reg),
                            jnp.asarray(rho))
     assert float(jnp.sum(out)) == 0.0
+
+
+# -- reference (axiomhq) wire-format compatibility --------------------------
+
+def test_serialize_axiomhq_dense_layout():
+    """serialize() emits the reference sketch's MarshalBinary dense layout:
+    [version=1][p][b][sparse=0][m/2 BE32][nibble-packed], register 2i in
+    the high nibble (hyperloglog.go:274-319, registers.go reg.set)."""
+    rng = np.random.default_rng(7)
+    regs = rng.integers(0, 14, size=1 << 14).astype(np.uint8)
+    data = hll.serialize(regs, 14)
+    assert data[0] == 1          # version
+    assert data[1] == 14         # p
+    assert data[2] == 0          # b (min register is 0)
+    assert data[3] == 0          # dense
+    assert int.from_bytes(data[4:8], "big") == (1 << 14) // 2
+    body = np.frombuffer(data[8:], np.uint8)
+    np.testing.assert_array_equal(body >> 4, regs[0::2])
+    np.testing.assert_array_equal(body & 0x0F, regs[1::2])
+
+
+def test_serialize_roundtrip_exact_small_values():
+    rng = np.random.default_rng(8)
+    regs = rng.integers(0, 16, size=1 << 14).astype(np.uint8)
+    p, back = hll.deserialize(hll.serialize(regs, 14))
+    assert p == 14
+    np.testing.assert_array_equal(back, regs)
+
+
+def test_serialize_roundtrip_rebased_large_values():
+    # all registers nonzero with spread <= 15: base-rebased, still exact
+    rng = np.random.default_rng(9)
+    regs = rng.integers(11, 25, size=1 << 14).astype(np.uint8)
+    data = hll.serialize(regs, 14)
+    assert data[2] > 0  # base engaged
+    p, back = hll.deserialize(data)
+    np.testing.assert_array_equal(back, regs)
+
+
+def test_serialize_saturates_like_reference_insert():
+    # a zero register forces b=0; rho > 15 tailcuts at 15 exactly as the
+    # reference's insert clamp (hyperloglog.go:169-180 capacity-1)
+    regs = np.zeros(1 << 14, np.uint8)
+    regs[5] = 40
+    regs[6] = 3
+    p, back = hll.deserialize(hll.serialize(regs, 14))
+    assert back[5] == 15
+    assert back[6] == 3
+    assert back[0] == 0
+
+
+def test_deserialize_sparse_form():
+    """Hand-build a sparse MarshalBinary payload (tmpSet + compressedList,
+    sparse.go:54 / compressed.go:55) and check it lands in the right
+    registers with the right rho."""
+    from veneur_tpu.utils.hashing import metro_hash_64
+
+    members = [b"user-%d" % i for i in range(30)]
+    hashes = [metro_hash_64(m) for m in members]
+    p, pp = 14, 25
+
+    def encode_hash(x):
+        # sparse.go encodeHash
+        idx = (x >> (64 - pp)) & ((1 << pp) - 1)
+        if (x >> (64 - pp)) & ((1 << (pp - p)) - 1) == 0:
+            low = (x & ((1 << (64 - pp)) - 1)) << pp
+            w = low | (1 << (pp - 1))
+            zeros = (64 - w.bit_length()) + 1 if w else 64
+            return (idx << 7) | (zeros << 1) | 1
+        return idx << 1
+
+    keys = sorted({encode_hash(x) for x in hashes})
+    # half in tmpSet, half in the compressed (delta-varint) list
+    tmp, lst = keys[::2], keys[1::2]
+    payload = bytes([1, p, 0, 1])
+    payload += len(tmp).to_bytes(4, "big")
+    for k in tmp:
+        payload += k.to_bytes(4, "big")
+    body = b""
+    last = 0
+    for k in lst:
+        delta = k - last
+        while delta & ~0x7F:
+            body += bytes([(delta & 0x7F) | 0x80])
+            delta >>= 7
+        body += bytes([delta & 0x7F])
+        last = k
+    payload += len(lst).to_bytes(4, "big") + last.to_bytes(4, "big")
+    payload += len(body).to_bytes(4, "big") + body
+
+    got_p, regs = hll.deserialize(payload)
+    assert got_p == p
+    # oracle: direct dense insert of the same members
+    from veneur_tpu.utils.hashing import hll_reg_rho
+    want = np.zeros(1 << p, np.uint8)
+    for m in members:
+        reg, rho = hll_reg_rho(m, p)
+        want[reg] = max(want[reg], rho)
+    np.testing.assert_array_equal(regs, want)
+
+
+def test_legacy_vhll_still_decodes():
+    regs = np.arange(1 << 14, dtype=np.uint8) % 13
+    data = hll.MAGIC + bytes([14]) + regs.tobytes()
+    p, back = hll.deserialize(data)
+    assert p == 14
+    np.testing.assert_array_equal(back, regs)

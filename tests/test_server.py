@@ -220,3 +220,76 @@ def test_sink_routing_and_tag_exclusion(server):
     assert "plain" in m
     # exclusion applies at sink level
     assert sink.strip_excluded(m["plain"].tags) == ["keep:y"]
+
+
+def test_ingest_continues_during_slow_sink_flush(server):
+    """A slow sink must never stall ingest: flush runs on a dedicated
+    thread, the pipeline thread only swaps state (flusher.go:105-115 runs
+    sink flushes on the flush goroutine, workers keep consuming)."""
+    srv, sink = server
+
+    class SlowSink(DebugMetricSink):
+        name = "slow"
+
+        def flush(self, metrics):
+            time.sleep(3.0)
+            super().flush(metrics)
+
+    addr = srv.local_addr()
+    # warm-up interval: compiles ingest/flush programs so the measurement
+    # below sees steady-state behavior, not first-compile latency
+    _send_udp(addr, [b"warm.counter:1|c"])
+    _wait_processed(srv, 1)
+    srv.trigger_flush()
+
+    slow = SlowSink()
+    srv.metric_sinks.append(slow)
+    _send_udp(addr, [b"pre.counter:1|c"])
+    _wait_key(srv, "counter", "pre.counter")
+    flushes0 = srv.flush_count
+
+    # kick off the flush without waiting; the slow sink holds it for 3s
+    srv.trigger_flush(wait=False)
+    time.sleep(0.3)  # let the swap happen and the sink start sleeping
+
+    # ingest must proceed while the flush is still inside the slow sink
+    t0 = time.time()
+    processed0 = srv.aggregator.processed
+    _send_udp(addr, [b"during.counter:%d|c" % i for i in range(50)])
+    _wait_processed_delta(srv, processed0, 50, timeout=2.0)
+    ingest_latency = time.time() - t0
+    assert ingest_latency < 2.0, (
+        f"ingest stalled {ingest_latency:.1f}s behind a slow sink flush")
+
+    # the slow flush eventually completes with the slow sink's data
+    with srv._flush_done:
+        srv._flush_done.wait_for(lambda: srv.flush_count > flushes0,
+                                 timeout=10.0)
+    assert "pre.counter" in by_name(slow.flushed)
+
+    # and the during-flush traffic lands in the NEXT interval
+    srv.trigger_flush()
+    assert "during.counter" in by_name(sink.flushed)
+
+
+def _wait_key(srv, kind, name, timeout=10.0):
+    """Wait until a metric key is registered in the live interval's table —
+    unlike `processed` counts, immune to self-telemetry loop-back races."""
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        if any(m.name == name
+               for _, m in srv.aggregator.table.get_meta(kind)):
+            return
+        time.sleep(0.02)
+    raise TimeoutError(f"key {name} never registered")
+
+
+def _wait_processed_delta(srv, base, n, timeout=10.0):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        if srv.aggregator.processed - base >= n:
+            return
+        time.sleep(0.02)
+    raise TimeoutError(
+        f"only {srv.aggregator.processed - base}/{n} processed "
+        f"after {timeout}s")

@@ -10,7 +10,8 @@
 //     veneurlocalonly/veneurglobalonly stripped into the scope
 //     (parser.go:397-407)
 //   - 32-bit FNV-1a digest over name+type+joined-tags = shard key
-//   - set members hashed fnv1a64+splitmix64 (utils/hashing.py hll_reg_rho)
+//   - set members hashed MetroHash64 seed 1337 (utils/hashing.py
+//     hll_reg_rho; the reference sketch's member hash)
 //   - slot = shard*per_shard + next_free[shard], shard = digest % n_shards
 //     (aggregation/host.py _KindTable.slot_for)
 //
@@ -53,11 +54,77 @@ inline uint64_t fnv64(const char* p, size_t n) {
   return h;
 }
 
-inline uint64_t splitmix64(uint64_t x) {
-  x += 0x9E3779B97F4A7C15ull;
-  x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ull;
-  x = (x ^ (x >> 27)) * 0x94D049BB133111EBull;
-  return x ^ (x >> 31);
+inline uint64_t rotr64(uint64_t x, int r) {
+  return (x >> r) | (x << (64 - r));
+}
+
+inline uint64_t load64(const char* p) {
+  uint64_t v;
+  memcpy(&v, p, 8);  // little-endian host assumed (x86/arm LE)
+  return v;
+}
+inline uint32_t load32(const char* p) {
+  uint32_t v;
+  memcpy(&v, p, 4);
+  return v;
+}
+inline uint16_t load16(const char* p) {
+  uint16_t v;
+  memcpy(&v, p, 2);
+  return v;
+}
+
+// MetroHash64 (J. Andrew Rogers, public domain), seed 1337 — the member
+// hash of the reference's vendored HLL sketch; must match the Python
+// utils/hashing.py metro_hash_64 bit-for-bit so both ingest paths place a
+// member in the same register, and match the reference fleet for
+// cross-implementation sketch unions.
+inline uint64_t metro64(const char* p, size_t n, uint64_t seed = 1337) {
+  const uint64_t k0 = 0xD6D018F5, k1 = 0xA2AA033B, k2 = 0x62992FC1,
+                 k3 = 0x30BC5B29;
+  const char* end = p + n;
+  uint64_t h = (seed + k2) * k0;
+  if (n >= 32) {
+    uint64_t v0 = h, v1 = h, v2 = h, v3 = h;
+    while (end - p >= 32) {
+      v0 += load64(p) * k0; p += 8; v0 = rotr64(v0, 29) + v2;
+      v1 += load64(p) * k1; p += 8; v1 = rotr64(v1, 29) + v3;
+      v2 += load64(p) * k2; p += 8; v2 = rotr64(v2, 29) + v0;
+      v3 += load64(p) * k3; p += 8; v3 = rotr64(v3, 29) + v1;
+    }
+    v2 ^= rotr64(((v0 + v3) * k0) + v1, 37) * k1;
+    v3 ^= rotr64(((v1 + v2) * k1) + v0, 37) * k0;
+    v0 ^= rotr64(((v0 + v2) * k0) + v3, 37) * k1;
+    v1 ^= rotr64(((v1 + v3) * k1) + v2, 37) * k0;
+    h += v0 ^ v1;
+  }
+  if (end - p >= 16) {
+    uint64_t w0 = h + load64(p) * k2; p += 8; w0 = rotr64(w0, 29) * k3;
+    uint64_t w1 = h + load64(p) * k2; p += 8; w1 = rotr64(w1, 29) * k3;
+    w0 ^= rotr64(w0 * k0, 21) + w1;
+    w1 ^= rotr64(w1 * k3, 21) + w0;
+    h += w1;
+  }
+  if (end - p >= 8) {
+    h += load64(p) * k3; p += 8;
+    h ^= rotr64(h, 55) * k1;
+  }
+  if (end - p >= 4) {
+    h += (uint64_t)load32(p) * k3; p += 4;
+    h ^= rotr64(h, 26) * k1;
+  }
+  if (end - p >= 2) {
+    h += (uint64_t)load16(p) * k3; p += 2;
+    h ^= rotr64(h, 48) * k1;
+  }
+  if (end - p >= 1) {
+    h += (uint64_t)(uint8_t)(*p) * k3;
+    h ^= rotr64(h, 37) * k1;
+  }
+  h ^= rotr64(h, 28);
+  h *= k0;
+  h ^= rotr64(h, 29);
+  return h;
 }
 
 enum Kind { K_COUNTER = 0, K_GAUGE = 1, K_HISTO = 2, K_SET = 3, K_TIMER = 4 };
@@ -323,7 +390,7 @@ struct Parser {
       case K_SET: {
         int32_t slot = slot_for(sets, kind, scope, name, name_len, h);
         if (slot < 0) return 0;
-        uint64_t mh = splitmix64(fnv64(value, value_len));
+        uint64_t mh = metro64(value, value_len);
         uint32_t reg = (uint32_t)(mh >> (64 - hll_precision));
         uint64_t restbits = mh << hll_precision;
         int rho;

@@ -5,9 +5,10 @@ registers) per set key and does Insert / Merge(union = register max) /
 Estimate (reference samplers/samplers.go:367-463). Here a batch of sketches is
 one uint8 array [..., R]:
 
-- insert: the host hashes the member string to 64 bits (metrohash in the
-  reference's vendored lib; we use xxhash-style splitmix on the host) and
-  ships (register_index, rho) pairs; the device does a deduplicated
+- insert: the host hashes the member string to 64 bits with MetroHash64
+  seed 1337 — the exact member hash of the reference's vendored sketch, so
+  sketches union correctly across a mixed fleet — and ships
+  (register_index, rho) pairs; the device does a deduplicated
   scatter-max (sort by register → segment-max → unique-index scatter),
 - merge/union: elementwise ``maximum`` — which over a device mesh is exactly
   ``lax.pmax``, making the reference's global set-union (worker.go:438-495
@@ -117,27 +118,116 @@ def merge_rows(registers, slot, rows):
     return registers.at[slot].max(rows, mode="drop")
 
 
-MAGIC = b"VHLL"
+MAGIC = b"VHLL"          # legacy round-1 wire format (still decodable)
+_SPARSE_PP = 25          # axiomhq sparse precision (hyperloglog.go pp)
 
 
 def serialize(registers, precision: int = DEFAULT_PRECISION) -> bytes:
-    """Forwarding bytes for one key's registers (this framework's wire
-    format for metricpb.SetValue.hyper_log_log; the reference ships
-    axiomhq/hyperloglog MarshalBinary, which is implementation-defined —
-    sketch bytes only interoperate between same-impl tiers)."""
+    """Wire bytes for one key's registers in the reference sketch's
+    MarshalBinary layout (axiomhq/hyperloglog hyperloglog.go:274): dense
+    form `[version=1][p][b][sparse=0][len(m/2) BE32][m/2 nibble-packed
+    bytes]`, register value = b + stored nibble, register 2i in the high
+    nibble of byte i. A reference global can UnmarshalBinary these bytes
+    directly, so forwarded set metrics merge across a mixed fleet.
+
+    Base selection mirrors the reference's rebase invariant (b only ever
+    grows to the register minimum): exact whenever the register spread fits
+    in a nibble, saturating at b+15 otherwise — the same tailcut loss the
+    reference's own insert applies (hyperloglog.go:169-180).
+    """
     import numpy as np
-    return MAGIC + bytes([precision]) + np.asarray(registers, np.uint8).tobytes()
+    regs = np.asarray(registers, np.uint8)
+    m = regs.shape[0]
+    mn, mx = int(regs.min()), int(regs.max())
+    b = 0
+    if mn > 0 and mx > 15:
+        b = min(mn, mx - 15)
+    stored = np.clip(regs.astype(np.int32) - b, 0, 15).astype(np.uint8)
+    packed = ((stored[0::2] << 4) | stored[1::2]).astype(np.uint8)
+    return (bytes([1, precision, b, 0]) + (m // 2).to_bytes(4, "big")
+            + packed.tobytes())
+
+
+def _decode_sparse_hash(k: int, p: int):
+    """axiomhq sparse.go decodeHash: sparse key -> (register, rho)."""
+    pp = _SPARSE_PP
+    if k & 1:
+        r = ((k >> 1) & 0x3F) + pp - p
+        idx = (k >> (32 - p)) & ((1 << p) - 1)
+    else:
+        shifted = (k << (32 - pp + p - 1)) & 0xFFFFFFFF
+        # clz32(shifted) + 1; shifted==0 cannot occur for a valid key
+        r = (33 - shifted.bit_length()) if shifted else 32
+        idx = (k >> (pp - p + 1)) & ((1 << p) - 1)
+    return idx, r
+
+
+def _deserialize_axiomhq(data: bytes):
+    import numpy as np
+    p = data[1]
+    b = data[2]
+    m = 1 << p
+    if data[3] == 1:
+        # sparse form: tmpSet (BE32 count + BE32 keys) then compressedList
+        # (count, last, varint-delta list) — decode into dense registers,
+        # exactly the sketch's own toNormal() conversion
+        regs = np.zeros(m, np.uint8)
+        (tssz,) = _be32(data, 4)
+        off = 8
+        keys = []
+        for _ in range(tssz):
+            keys.append(int.from_bytes(data[off:off + 4], "big"))
+            off += 4
+        off += 8  # compressedList count + last (we re-derive from deltas)
+        (sz,) = _be32(data, off)
+        off += 4
+        buf = data[off:off + sz]
+        i, last = 0, 0
+        while i < len(buf):
+            x, j = 0, i
+            while buf[j] & 0x80:
+                x |= (buf[j] & 0x7F) << ((j - i) * 7)
+                j += 1
+            x |= buf[j] << ((j - i) * 7)
+            last += x
+            keys.append(last)
+            i = j + 1
+        for k in keys:
+            idx, r = _decode_sparse_hash(k, p)
+            if r > regs[idx]:
+                regs[idx] = r
+        return p, regs
+    (sz,) = _be32(data, 4)
+    packed = np.frombuffer(data[8:8 + sz], np.uint8)
+    if packed.shape[0] != m // 2:
+        raise ValueError("HLL dense payload length mismatch")
+    regs = np.empty(m, np.uint8)
+    regs[0::2] = packed >> 4
+    regs[1::2] = packed & 0x0F
+    if b:
+        regs = (regs.astype(np.int32) + b).astype(np.uint8)
+    return p, regs
+
+
+def _be32(data: bytes, off: int):
+    return (int.from_bytes(data[off:off + 4], "big"),)
 
 
 def deserialize(data: bytes):
+    """Parse sketch wire bytes -> (precision, uint8 registers[2^p]).
+
+    Accepts the reference's axiomhq MarshalBinary bytes (dense AND sparse
+    forms) and this framework's legacy VHLL dump."""
     import numpy as np
-    if data[:4] != MAGIC:
-        raise ValueError("bad HLL payload")
-    precision = data[4]
-    regs = np.frombuffer(data[5:], np.uint8)
-    if regs.shape[0] != (1 << precision):
-        raise ValueError("HLL payload length mismatch")
-    return precision, regs
+    if data[:4] == MAGIC:
+        precision = data[4]
+        regs = np.frombuffer(data[5:], np.uint8)
+        if regs.shape[0] != (1 << precision):
+            raise ValueError("HLL payload length mismatch")
+        return precision, regs
+    if len(data) >= 8 and data[0] == 1 and 4 <= data[1] <= 18:
+        return _deserialize_axiomhq(data)
+    raise ValueError("unrecognized HLL payload")
 
 
 @partial(jax.jit, static_argnames=("precision",))

@@ -155,14 +155,11 @@ class Aggregator:
                                            self._hll_rows[b:])
 
     # -- flush --------------------------------------------------------------
-    def flush(self, percentiles: List[float], want_raw: bool = False
-              ) -> Tuple[Dict[str, np.ndarray], KeyTable]:
-        """Map-swap (worker.go:498): detach live state+table, reset fresh,
-        then run the flush computation on the detached interval. With
-        want_raw, also returns the folded sketch state (numpy) for
-        forwarding serialization."""
-        import jax.numpy as jnp
-
+    def swap(self):
+        """Map-swap (worker.go:498): detach live state+table, reset fresh.
+        This is the ONLY flush work that must run on the pipeline thread;
+        everything downstream operates on the detached (immutable) interval
+        and can run on a flush thread while new samples accumulate."""
         self.batcher.emit()
         while self._hll_slots:
             self._flush_hll_imports()
@@ -170,6 +167,16 @@ class Aggregator:
         self.state = empty_state(self.spec)
         self.table = KeyTable(self.spec, self.n_shards)
         self._steps = 0
+        return state, table
+
+    def compute_flush(self, state, table, percentiles: List[float],
+                      want_raw: bool = False
+                      ) -> Tuple[Dict[str, np.ndarray], KeyTable]:
+        """Flush math on a detached interval (safe off the pipeline thread:
+        JAX arrays are immutable and dispatch is thread-safe). With
+        want_raw, also returns the folded sketch state (numpy) for
+        forwarding serialization."""
+        import jax.numpy as jnp
 
         state = fold_scalars(state)
         state = compact(state, spec=self.spec)
@@ -192,3 +199,9 @@ class Aggregator:
             }
             return result, table, raw
         return result, table
+
+    def flush(self, percentiles: List[float], want_raw: bool = False
+              ) -> Tuple[Dict[str, np.ndarray], KeyTable]:
+        """swap + compute in one call (single-threaded callers, tests)."""
+        state, table = self.swap()
+        return self.compute_flush(state, table, percentiles, want_raw)

@@ -202,17 +202,13 @@ class NativeAggregator(Aggregator):
         self._py_dropped = v - native
 
     # -- flush ---------------------------------------------------------------
-    def flush(self, percentiles, want_raw: bool = False):
+    def swap(self):
         self._emit_native()
         detached = self.table
         detached.finalize()
-        result = super().flush(percentiles, want_raw)
+        state, _ = super().swap()
         # super() replaced self.table with a fresh Python KeyTable; the
         # native engine keeps the slot space, so re-wrap it post-reset
         self.eng.reset()
         self.table = NativeKeyTable(self.spec, self.eng, self.n_shards)
-        if want_raw:
-            flush_arrays, _, raw = result
-            return flush_arrays, detached, raw
-        flush_arrays, _ = result
-        return flush_arrays, detached
+        return state, detached

@@ -154,12 +154,22 @@ class Server:
         self.event_samples = []       # EventWorker buffer (worker.go:527)
         self._event_lock = threading.Lock()
         self.packet_queue: "queue.Queue" = queue.Queue(maxsize=4096)
+        # detached flush intervals; drained by the dedicated flush thread
+        # (flusher.go:105-115 runs on its own goroutine — the pipeline/worker
+        # threads never wait on sinks). Bounded: each job holds a detached
+        # device-state snapshot, so a backlogged flush worker must drop
+        # intervals rather than grow without limit.
+        self._flush_jobs: "queue.Queue" = queue.Queue(maxsize=4)
+        self.flush_intervals_dropped = 0
         self.last_flush = time.time()
+        self.last_flush_done = time.time()
         self.flush_count = 0
         self.parse_errors = 0
+        self.import_errors = 0
         self.packets_received = 0
         self._shutdown = threading.Event()
         self._threads: List[threading.Thread] = []
+        self._pipeline_thread: Optional[threading.Thread] = None
         self._sockets: List[socket.socket] = []
         self._flush_done = threading.Condition()
         self._forward_client = None
@@ -221,13 +231,41 @@ class Server:
             if item is _STOP:
                 return
             if item is _FLUSH:
+                # The pipeline thread does ONLY the state/table swap; all
+                # downstream flush work (device flush math, intermetric
+                # generation, sink fan-out, plugins) happens on the flush
+                # thread so ingest never stalls behind a slow sink
+                # (flusher.go:105-115 semantics).
+                now = time.time()
+                self.last_flush = now
                 try:
-                    self._do_flush()
+                    state, table = self.aggregator.swap()
                 except Exception:
-                    # a failed flush must never kill the pipeline thread;
-                    # state was already swapped, next interval starts clean
-                    log.exception("flush failed")
-                finally:
+                    log.exception("flush swap failed")
+                    with self._flush_done:
+                        self.flush_count += 1
+                        self._flush_done.notify_all()
+                    continue
+                # snapshot pipeline-owned counters here: the native engine's
+                # stats call isn't safe to interleave with feed()
+                stats = {
+                    "packets_received": self.packets_received,
+                    "parse_errors": self.parse_errors
+                    + self.aggregator.extra_parse_errors(),
+                    "processed": self.aggregator.processed + 0,
+                    "dropped": self.aggregator.dropped_capacity,
+                    "import_errors": self.import_errors,
+                    "spans_received": self.span_pipeline.spans_received,
+                    "intervals_dropped": self.flush_intervals_dropped,
+                }
+                try:
+                    self._flush_jobs.put_nowait((state, table, stats, now))
+                except queue.Full:
+                    # flush worker is badly behind (the watchdog tracks a
+                    # fully stuck one); dropping the interval bounds memory
+                    # — each job holds a full detached device state
+                    self.flush_intervals_dropped += 1
+                    log.error("flush worker backlogged; dropped interval")
                     with self._flush_done:
                         self.flush_count += 1
                         self._flush_done.notify_all()
@@ -238,6 +276,10 @@ class Server:
                     try:
                         import_into(self.aggregator, metric)
                     except Exception as e:
+                        # counted into self-telemetry so a mixed fleet sees
+                        # incompatible payloads (e.g. foreign sketch bytes)
+                        # instead of silently losing them
+                        self.import_errors += 1
                         log.warning("bad imported metric %s: %s",
                                     metric.name, e)
                 continue
@@ -432,7 +474,12 @@ class Server:
         t = threading.Thread(target=self._pipeline_loop, daemon=True,
                              name="pipeline")
         t.start()
+        self._pipeline_thread = t
         self._threads.append(t)
+        fw = threading.Thread(target=self._flush_worker, daemon=True,
+                              name="flush-worker")
+        fw.start()
+        self._threads.append(fw)
 
         for addr in self.cfg.statsd_listen_addresses:
             kind, target = resolve_addr(addr)
@@ -588,20 +635,43 @@ class Server:
                     lambda: self.flush_count > gen,
                     timeout=max(self.interval, 30.0))
 
-    def _do_flush(self):
-        self.last_flush = time.time()
+    def _flush_worker(self):
+        """Dedicated flush thread: drains detached intervals and runs the
+        full flush fan-out. Serializes overlapping flushes; a slow sink
+        delays at most the NEXT flush, never ingest."""
+        while True:
+            job = self._flush_jobs.get()
+            if job is _STOP:
+                return
+            state, table, stats, swapped_at = job
+            try:
+                self._do_flush(state, table, stats, swapped_at)
+            except Exception:
+                # a failed flush must never kill the flush thread; state
+                # was already swapped, next interval starts clean
+                log.exception("flush failed")
+            finally:
+                self.last_flush_done = time.time()
+                with self._flush_done:
+                    self.flush_count += 1
+                    self._flush_done.notify_all()
+
+    def _do_flush(self, state, table, stats, swapped_at):
         flush_t0 = time.perf_counter()
-        ts = int(self.last_flush)
+        # stamp with the interval's swap time, not the job's run time — a
+        # queued interval must not shift into the next time bucket
+        ts = int(swapped_at)
         if self._forward_client is not None:
-            flush_arrays, table, raw = self.aggregator.flush(
-                self.cfg.percentiles, want_raw=True)
+            flush_arrays, table, raw = self.aggregator.compute_flush(
+                state, table, self.cfg.percentiles, want_raw=True)
             # fire-and-forget, concurrent with sink flushes
             # (flusher.go:84-95); _forward logs and counts its own errors,
-            # and the pipeline thread must never block on a slow global tier
+            # and the flush thread must never block on a slow global tier
             threading.Thread(target=self._forward, args=(raw, table),
                              daemon=True).start()
         else:
-            flush_arrays, table = self.aggregator.flush(self.cfg.percentiles)
+            flush_arrays, table = self.aggregator.compute_flush(
+                state, table, self.cfg.percentiles)
 
         if self.cfg.count_unique_timeseries:
             from veneur_tpu.server.flusher import unique_timeseries
@@ -625,39 +695,45 @@ class Server:
             aggregates=self.cfg.aggregates,
             is_local=self.cfg.is_local,
             timestamp=ts, hostname=self.hostname)
-        if not final:
-            return
-        # parallel sink flushes + barrier (flusher.go:105-115)
-        threads = [threading.Thread(target=self._flush_sink,
-                                    args=(s, final)) for s in self.metric_sinks]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join(timeout=self.interval)
-        # plugins run post-flush (flusher.go:117-131)
-        for p in self.plugins:
-            try:
-                p.flush(final)
-            except Exception as e:
-                log.warning("plugin %s flush failed: %s", p.name, e)
-        self._report_self_metrics(len(final), time.perf_counter() - flush_t0)
+        if final:
+            # parallel sink flushes + barrier (flusher.go:105-115)
+            threads = [threading.Thread(target=self._flush_sink,
+                                        args=(s, final))
+                       for s in self.metric_sinks]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=self.interval)
+            # plugins run post-flush (flusher.go:117-131)
+            for p in self.plugins:
+                try:
+                    p.flush(final)
+                except Exception as e:
+                    log.warning("plugin %s flush failed: %s", p.name, e)
+        # Self-telemetry is reported even for an empty interval — the
+        # reference always tallies flush totals (flusher.go:300-336), and an
+        # idle server must still bootstrap veneur.flush.* / packet counters
+        # into its own pipeline.
+        self._report_self_metrics(len(final), time.perf_counter() - flush_t0,
+                                  stats)
 
-    def _report_self_metrics(self, n_flushed: int, flush_seconds: float):
+    def _report_self_metrics(self, n_flushed: int, flush_seconds: float,
+                             stats: dict):
         """Every stage emits self-metrics through the pipeline itself
         (SURVEY §5: worker counts worker.go:513, flush totals
-        flusher.go:300-336), as deltas per interval."""
+        flusher.go:300-336), as deltas per interval. `stats` is the counter
+        snapshot taken on the pipeline thread at swap time."""
         from veneur_tpu.samplers import ssf_samples
         from veneur_tpu.trace.client import report_batch
 
-        cur = {"veneur.packets_received_total": self.packets_received,
-               "veneur.parse_errors_total":
-                   self.parse_errors + self.aggregator.extra_parse_errors(),
-               "veneur.worker.metrics_processed_total":
-                   self.aggregator.processed + 0,
-               "veneur.worker.metrics_dropped_total":
-                   self.aggregator.dropped_capacity,
-               "veneur.spans_received_total":
-                   self.span_pipeline.spans_received}
+        cur = {"veneur.packets_received_total": stats["packets_received"],
+               "veneur.parse_errors_total": stats["parse_errors"],
+               "veneur.worker.metrics_processed_total": stats["processed"],
+               "veneur.worker.metrics_dropped_total": stats["dropped"],
+               "veneur.import.errors_total": stats["import_errors"],
+               "veneur.flush.intervals_dropped_total":
+                   stats["intervals_dropped"],
+               "veneur.spans_received_total": stats["spans_received"]}
         samples = [ssf_samples.timing("veneur.flush.total_duration_ns",
                                       flush_seconds),
                    ssf_samples.gauge("veneur.flush.metrics_total",
@@ -724,13 +800,17 @@ class Server:
 
     def _watchdog(self):
         """reference server.go:900 FlushWatchdog: crash-only restart if
-        flushes stall for N intervals."""
+        flushes stall for N intervals. Two stall modes now that flush runs
+        on its own thread: the pipeline stops swapping (last_flush stale)
+        or the flush worker wedges inside a sink/plugin (last_flush_done
+        stale while swaps continue)."""
         missed = self.cfg.flush_watchdog_missed_flushes
         while not self._shutdown.wait(self.interval / 2):
-            if time.time() - self.last_flush > missed * self.interval:
+            stale = min(self.last_flush, self.last_flush_done)
+            if time.time() - stale > missed * self.interval:
                 log.critical(
-                    "flush watchdog: no flush for %d intervals, aborting",
-                    missed)
+                    "flush watchdog: no completed flush for %d intervals, "
+                    "aborting", missed)
                 os._exit(3)
 
     def shutdown(self):
@@ -757,5 +837,11 @@ class Server:
         if self._forward_client is not None:
             self._forward_client.close()
         self.packet_queue.put(_STOP)
+        # drain order matters: the pipeline thread may still enqueue a final
+        # flush job; only after it exits is it safe to stop the flush worker
+        # (a _STOP racing ahead of that job would strand the last interval)
+        if self._pipeline_thread is not None:
+            self._pipeline_thread.join(timeout=5.0)
+        self._flush_jobs.put(_STOP)
         for t in self._threads:
             t.join(timeout=2.0)

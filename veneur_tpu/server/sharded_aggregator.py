@@ -200,9 +200,7 @@ class ShardedAggregator(Aggregator):
         self._hll_slots, self._hll_rows = [], []
 
     # -- flush ---------------------------------------------------------------
-    def flush(self, percentiles, want_raw: bool = False):
-        import jax.numpy as jnp
-
+    def swap(self):
         self._emit_all()
         self._apply_hll_imports()
         state, table = self.state, self.table
@@ -210,6 +208,11 @@ class ShardedAggregator(Aggregator):
         self.table = KeyTable(self.spec, self.n_shards)
         self.batchers = self._make_batchers()
         self._steps = 0
+        return state, table
+
+    def compute_flush(self, state, table, percentiles,
+                      want_raw: bool = False):
+        import jax.numpy as jnp
 
         qs = jnp.asarray(percentiles or [0.5], jnp.float32)
         out = self._flush(state, qs)

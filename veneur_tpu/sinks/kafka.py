@@ -91,9 +91,12 @@ class KafkaSpanSink(SpanSink):
 
     def ingest(self, span) -> None:
         # sampling: by tag value hash when a sample tag is configured,
-        # else by trace id (kafka.go:228-306)
+        # else by trace id (kafka.go:228-306). The hash must be stable
+        # across restarts and fleet members so a sampled trace stays whole
+        # — builtin hash() is PYTHONHASHSEED-randomized, fnv1a is not.
         if self.sample_rate_percent < 100:
-            basis = (hash(span.tags.get(self.sample_tag, ""))
+            from veneur_tpu.utils.hashing import fnv1a_64
+            basis = (fnv1a_64(span.tags.get(self.sample_tag, "").encode())
                      if self.sample_tag else span.trace_id)
             if (basis % 100) >= self.sample_rate_percent:
                 self.skipped += 1

@@ -73,12 +73,14 @@ class SignalFxMetricSink(MetricSink):
                                        {"counter": [], "gauge": []})
             body[kind].append(self._datapoint(m))
         for token, body in by_token.items():
-            points = body["counter"] + body["gauge"]
-            for i in range(0, max(len(points), 1), self.flush_max_per_body):
-                chunk = {
-                    "counter": body["counter"][i:i + self.flush_max_per_body],
-                    "gauge": body["gauge"][i:i + self.flush_max_per_body],
-                }
+            # chunk across BOTH kinds so one POST never exceeds
+            # flush_max_per_body total points
+            points = ([("counter", p) for p in body["counter"]]
+                      + [("gauge", p) for p in body["gauge"]])
+            for i in range(0, len(points), self.flush_max_per_body):
+                chunk = {"counter": [], "gauge": []}
+                for kind, p in points[i:i + self.flush_max_per_body]:
+                    chunk[kind].append(p)
                 self._post(token, chunk)
 
     def _post(self, token, body):

@@ -2,11 +2,14 @@
 
 The reference shards every hop by a 32-bit FNV-1a digest over
 name + type + sorted-joined-tags (reference samplers/parser.go:325-420 and
-importsrv/server.go:141-148), and hashes set members with a 64-bit hash for
-HyperLogLog insertion. We keep identical digest semantics (FNV-1a 32) so a
-deployment can mix reference and TPU instances behind one proxy, and use
-FNV-1a 64 + a splitmix64 finalizer for HLL member hashing (any well-mixed
-64-bit hash family gives the same HLL error envelope).
+importsrv/server.go:141-148), and hashes set members with MetroHash64
+(seed 1337) for HyperLogLog insertion (its vendored
+axiomhq/hyperloglog hashFunc). We keep BOTH identical: the FNV-1a 32
+digest so a deployment can mix reference and TPU instances behind one
+proxy, and the metro member hash so set sketches union correctly across a
+mixed fleet — with different member hashes the same user id would land in
+different registers on the two implementations and the merged estimate
+would double-count.
 """
 
 from __future__ import annotations
@@ -40,10 +43,79 @@ def splitmix64(x: int) -> int:
     return x ^ (x >> 31)
 
 
+def _rotr(x: int, r: int) -> int:
+    return ((x >> r) | (x << (64 - r))) & _M64
+
+
+def metro_hash_64(data: bytes, seed: int = 1337) -> int:
+    """MetroHash64 (J. Andrew Rogers' public-domain algorithm).
+
+    This is the HLL member hash of the reference's vendored
+    axiomhq/hyperloglog (hashFunc = metro Hash64 with seed 1337); set
+    members must hash identically across a mixed fleet or merged sketches
+    double-count common members.
+    """
+    k0, k1, k2, k3 = 0xD6D018F5, 0xA2AA033B, 0x62992FC1, 0x30BC5B29
+    h = ((seed + k2) * k0) & _M64
+    n = len(data)
+    i = 0
+
+    def u64(j):
+        return int.from_bytes(data[j:j + 8], "little")
+
+    if n >= 32:
+        v0 = v1 = v2 = v3 = h
+        while n - i >= 32:
+            v0 = (v0 + u64(i) * k0) & _M64
+            v0 = (_rotr(v0, 29) + v2) & _M64
+            v1 = (v1 + u64(i + 8) * k1) & _M64
+            v1 = (_rotr(v1, 29) + v3) & _M64
+            v2 = (v2 + u64(i + 16) * k2) & _M64
+            v2 = (_rotr(v2, 29) + v0) & _M64
+            v3 = (v3 + u64(i + 24) * k3) & _M64
+            v3 = (_rotr(v3, 29) + v1) & _M64
+            i += 32
+        v2 ^= (_rotr(((v0 + v3) * k0 + v1) & _M64, 37) * k1) & _M64
+        v3 ^= (_rotr(((v1 + v2) * k1 + v0) & _M64, 37) * k0) & _M64
+        v0 ^= (_rotr(((v0 + v2) * k0 + v3) & _M64, 37) * k1) & _M64
+        v1 ^= (_rotr(((v1 + v3) * k1 + v2) & _M64, 37) * k0) & _M64
+        h = (h + (v0 ^ v1)) & _M64
+    if n - i >= 16:
+        w0 = (h + u64(i) * k2) & _M64
+        w0 = (_rotr(w0, 29) * k3) & _M64
+        w1 = (h + u64(i + 8) * k2) & _M64
+        w1 = (_rotr(w1, 29) * k3) & _M64
+        w0 ^= (_rotr((w0 * k0) & _M64, 21) + w1) & _M64
+        w1 ^= (_rotr((w1 * k3) & _M64, 21) + w0) & _M64
+        h = (h + w1) & _M64
+        i += 16
+    if n - i >= 8:
+        h = (h + u64(i) * k3) & _M64
+        h ^= (_rotr(h, 55) * k1) & _M64
+        i += 8
+    if n - i >= 4:
+        h = (h + int.from_bytes(data[i:i + 4], "little") * k3) & _M64
+        h ^= (_rotr(h, 26) * k1) & _M64
+        i += 4
+    if n - i >= 2:
+        h = (h + int.from_bytes(data[i:i + 2], "little") * k3) & _M64
+        h ^= (_rotr(h, 48) * k1) & _M64
+        i += 2
+    if n - i >= 1:
+        h = (h + data[i] * k3) & _M64
+        h ^= (_rotr(h, 37) * k1) & _M64
+    h ^= _rotr(h, 28)
+    h = (h * k0) & _M64
+    h ^= _rotr(h, 29)
+    return h
+
+
 def hll_reg_rho(member: bytes, precision: int):
     """(register index, rho) for one set member — host half of the HLL insert
-    (device half is ops/hll.insert_batch)."""
-    h = splitmix64(fnv1a_64(member))
+    (device half is ops/hll.insert_batch). Index/rho split follows the
+    reference sketch's getPosVal (top p bits → register; rho = clz of the
+    rest + 1, capped at 64-p+1), on the metro member hash."""
+    h = metro_hash_64(member)
     reg = h >> (64 - precision)
     rest = (h << precision) & _M64
     if rest == 0:
