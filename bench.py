@@ -90,12 +90,32 @@ def main():
     dt = time.perf_counter() - t0
 
     rate = per_step * steps / dt
-    print(json.dumps({
+    out = {
         "metric": "aggregation_samples_per_sec_per_chip_1M_keys",
         "value": round(rate, 1),
         "unit": "samples/sec",
         "vs_baseline": round(rate / 50e6, 4),
-    }))
+    }
+
+    # End-to-end pipeline numbers (BASELINE configs 1-5): wire bytes →
+    # parse → key → stage → H2D → device → flush → sink, with accuracy
+    # gates. The kernel number above is the chip ceiling; these are the
+    # whole system.
+    if os.environ.get("BENCH_SKIP_E2E", "") != "1":
+        try:
+            from benchmarks import e2e
+            scale_env = os.environ.get("BENCH_E2E_SCALE")
+            scale = float(scale_env) if scale_env else (
+                0.25 if on_tpu else 0.02)
+            out["e2e"] = e2e.main(scale=scale)
+            cfg2 = next((r for r in out["e2e"] if r["config"] == 2), None)
+            if cfg2:
+                out["e2e_samples_per_sec"] = cfg2["samples_per_sec"]
+                out["e2e_p99_err_mean"] = cfg2["p99_err_mean"]
+        except Exception as e:  # bench must still print its line
+            out["e2e_error"] = f"{type(e).__name__}: {e}"
+
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,1 @@
+"""End-to-end benchmark harness (BASELINE.md configs 1-5)."""

@@ -1,0 +1,435 @@
+"""The five BASELINE benchmark configs, end-to-end.
+
+Where round-1's bench.py timed only the jitted device step, these run the
+WHOLE pipeline — wire bytes → parse → key/dictionary → staging → H2D →
+device scatter (with compact/fold at production cadence) → flush math →
+sink — the path the reference's own benchmarks cover
+(server_test.go:1139 BenchmarkServerFlush, worker_test.go:506
+BenchmarkWork, parser_test.go:818 BenchmarkParseMetric).
+
+Configs (BASELINE.md §North-star):
+  1. counter replay over REAL UDP loopback → blackhole sink
+  2. 100k-name Zipf-latency timers → t-digest p50/p90/p99 vs exact
+  3. 1M unique uids → HLL cardinality vs exact
+  4. 64 local → 1 global gRPC forward, mixed counter+digest merge
+  5. SSF span firehose → count-min heavy hitters (+ extraction timers)
+
+Configs 2/3 feed pre-built wire packets through the server's packet queue
+(everything UDP gives except the kernel socket read) so the accuracy
+oracle is lossless; config 1 uses real sockets and reports drops honestly.
+
+Run:  python -m benchmarks.e2e [--config N] [--scale S]
+Each config prints one JSON object; `main()` returns the list of results
+(bench.py embeds them in its single output line).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import socket
+import sys
+import threading
+import time
+
+import numpy as np
+
+DEFAULT_PORT = 0
+FLUSH_WAIT = 60.0
+
+
+def midpoint_quantile(vals, q):
+    """Quantile of raw samples under the t-digest midpoint-mass convention —
+    what a PERFECT digest (one centroid per sample) returns, and the
+    convention of the Go reference digest (merging_digest.go:302 Quantile).
+    Using numpy's order-statistic interpolation as the oracle instead would
+    charge the sketch for a definitional difference that grows as 1/n."""
+    v = np.sort(np.asarray(vals, np.float64))
+    n = len(v)
+    mids = np.arange(n) + 0.5
+    xs = np.concatenate([[0.0], mids, [float(n)]])
+    ys = np.concatenate([[v[0]], v, [v[-1]]])
+    return float(np.interp(q * n, xs, ys))
+
+
+def _mk_server(metric_sinks, span_sinks=(), udp=False, **cfg_kw):
+    from veneur_tpu.config import Config
+    from veneur_tpu.server.server import Server
+    defaults = dict(
+        interval="10s", hostname="bench", metric_max_length=4096,
+        read_buffer_size_bytes=4 * 1024 * 1024,
+        percentiles=[0.5, 0.9, 0.99], aggregates=["min", "max", "count"],
+        statsd_listen_addresses=(["udp://127.0.0.1:0"] if udp else []),
+        num_readers=1,
+        span_channel_capacity=8192)
+    defaults.update(cfg_kw)
+    srv = Server(Config(**defaults), metric_sinks=list(metric_sinks),
+                 span_sinks=list(span_sinks))
+    srv.start()
+    return srv
+
+
+def _drain(srv, want_processed, timeout=600.0):
+    """Wait until the pipeline has consumed `want_processed` samples (or
+    the packet queue is empty and counts stopped moving)."""
+    t0 = time.time()
+    last = -1
+    while time.time() - t0 < timeout:
+        done = srv.aggregator.processed + srv.aggregator.dropped_capacity
+        if done >= want_processed:
+            return done
+        if srv.packet_queue.qsize() == 0 and done == last:
+            return done  # drops upstream of the queue; nothing left to do
+        last = done
+        time.sleep(0.05)
+    return srv.aggregator.processed + srv.aggregator.dropped_capacity
+
+
+def _feed_queue(srv, payloads):
+    """Lossless feed: pre-built wire payloads straight into the pipeline
+    queue (the post-socket path: split, parse, key, stage, H2D, ingest)."""
+    put = srv.packet_queue.put
+    for p in payloads:
+        put(p)
+
+
+# -- config 1: UDP counter replay → blackhole --------------------------------
+
+def config1_counter_replay(scale=1.0):
+    """10k-name DogStatsD counter replay via UDP loopback (BASELINE #1;
+    the reference's veneur-emit replay mode is the traffic model)."""
+    from veneur_tpu.sinks.blackhole import BlackholeMetricSink
+
+    names = 10_000
+    datagrams = max(200, int(50_000 * scale))
+    lines_per = 40
+    rng = np.random.default_rng(1)
+
+    payloads = []
+    for _ in range(datagrams):
+        ns = rng.integers(0, names, lines_per)
+        payloads.append(b"\n".join(
+            b"replay.counter.%d:1|c" % n for n in ns))
+    total = datagrams * lines_per
+
+    srv = _mk_server([BlackholeMetricSink()], udp=True,
+                     tpu_counter_capacity=1 << 14)
+    try:
+        addr = srv.local_addr()
+        # warm the compiled path so the timed region is steady-state
+        srv.packet_queue.put(b"replay.counter.0:1|c")
+        srv.trigger_flush()
+
+        sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        t0 = time.perf_counter()
+        for p in payloads:
+            sock.sendto(p, addr)
+        done = _drain(srv, total)
+        srv.trigger_flush()          # full interval incl. flush math
+        dt = time.perf_counter() - t0
+        sock.close()
+
+        processed = srv.aggregator.processed
+        return {
+            "config": 1, "name": "udp_counter_replay",
+            "samples_per_sec": round(processed / dt, 1),
+            "samples_sent": total,
+            "samples_processed": int(processed),
+            "drop_fraction": round(1.0 - done / total, 4),
+            "wall_seconds": round(dt, 3),
+        }
+    finally:
+        srv.shutdown()
+
+
+# -- config 2: Zipf-latency timers → quantile accuracy -----------------------
+
+def config2_zipf_timers(scale=1.0):
+    """100k names × heavy-tail latencies → t-digest p50/p90/p99 error vs
+    exact (BASELINE #2; accuracy gate ≤1% p99)."""
+    from veneur_tpu.sinks.debug import DebugMetricSink
+
+    names = max(1000, int(100_000 * scale))
+    samples = max(5000, int(1_000_000 * scale))
+    rng = np.random.default_rng(2)
+
+    # Zipf-rank name popularity; latencies lognormal (heavy tail)
+    ranks = np.arange(1, names + 1, dtype=np.float64)
+    pname = (1.0 / ranks) / np.sum(1.0 / ranks)
+    name_of = rng.choice(names, size=samples, p=pname)
+    vals = rng.lognormal(3.0, 0.9, samples).astype(np.float32)
+
+    by_name_vals = {}
+    lines = []
+    for n, v in zip(name_of, vals):
+        lines.append(b"lat.%d:%.4f|ms" % (n, v))
+        by_name_vals.setdefault(int(n), []).append(float(v))
+    per = 40
+    payloads = [b"\n".join(lines[i:i + per])
+                for i in range(0, len(lines), per)]
+
+    sink = DebugMetricSink()
+    srv = _mk_server([sink], tpu_histo_capacity=1 << 17,
+                     tpu_batch_histo=1 << 14)
+    try:
+        t0 = time.perf_counter()
+        _feed_queue(srv, payloads)
+        _drain(srv, samples)
+        srv.trigger_flush()
+        dt = time.perf_counter() - t0
+
+        flushed = {m.name: m.value for m in sink.flushed}
+        errs = {0.5: [], 0.9: [], 0.99: []}
+        checked = 0
+        # check the most-sampled names (stable exact quantiles)
+        top = sorted(by_name_vals, key=lambda n: -len(by_name_vals[n]))[:200]
+        for n in top:
+            v = np.asarray(by_name_vals[n])
+            if len(v) < 10:
+                continue
+            for q in errs:
+                key = f"lat.{n}.{int(q * 100)}percentile"
+                if key not in flushed:
+                    continue
+                exact = midpoint_quantile(v, q)
+                if exact > 0:
+                    errs[q].append(abs(flushed[key] - exact) / exact)
+            checked += 1
+        return {
+            "config": 2, "name": "zipf_timers",
+            "samples_per_sec": round(samples / dt, 1),
+            "names": names, "samples": samples,
+            "names_checked": checked,
+            "p50_err_mean": round(float(np.mean(errs[0.5])), 5),
+            "p99_err_mean": round(float(np.mean(errs[0.99])), 5),
+            "p99_err_max": round(float(np.max(errs[0.99])), 5),
+            "wall_seconds": round(dt, 3),
+        }
+    finally:
+        srv.shutdown()
+
+
+# -- config 3: 1M-uid sets → HLL accuracy ------------------------------------
+
+def config3_set_cardinality(scale=1.0):
+    """1M unique user ids into set metrics → HLL estimate vs exact
+    (BASELINE #3)."""
+    from veneur_tpu.sinks.debug import DebugMetricSink
+
+    uids = max(20_000, int(1_000_000 * scale))
+    keys = 4
+    lines = [b"users.active.%d:uid-%d|s" % (i % keys, i)
+             for i in range(uids)]
+    per = 40
+    payloads = [b"\n".join(lines[i:i + per])
+                for i in range(0, len(lines), per)]
+
+    sink = DebugMetricSink()
+    srv = _mk_server([sink], tpu_set_capacity=16, tpu_batch_set=1 << 13)
+    try:
+        t0 = time.perf_counter()
+        _feed_queue(srv, payloads)
+        _drain(srv, uids)
+        srv.trigger_flush()
+        dt = time.perf_counter() - t0
+
+        flushed = {m.name: m.value for m in sink.flushed}
+        per_key = {k: sum(1 for i in range(uids) if i % keys == k)
+                   for k in range(keys)}
+        errs = []
+        for k in range(keys):
+            got = flushed.get(f"users.active.{k}")
+            if got is not None:
+                errs.append(abs(got - per_key[k]) / per_key[k])
+        return {
+            "config": 3, "name": "set_cardinality",
+            "samples_per_sec": round(uids / dt, 1),
+            "unique_ids": uids,
+            "estimate_err_mean": round(float(np.mean(errs)), 5),
+            "estimate_err_max": round(float(np.max(errs)), 5),
+            "wall_seconds": round(dt, 3),
+        }
+    finally:
+        srv.shutdown()
+
+
+# -- config 4: 64 local → 1 global gRPC merge --------------------------------
+
+def config4_global_merge(scale=1.0):
+    """64 local tiers forward mixed counters + digests to one global over
+    real loopback gRPC; global must merge exactly (counters) and within
+    the digest error budget (BASELINE #4)."""
+    from veneur_tpu.aggregation.host import BatchSpec
+    from veneur_tpu.aggregation.state import TableSpec
+    from veneur_tpu.forward.convert import export_metrics
+    from veneur_tpu.forward.rpc import ForwardClient
+    from veneur_tpu.samplers.parser import parse_metric
+    from veneur_tpu.server.aggregator import Aggregator
+    from veneur_tpu.sinks.debug import DebugMetricSink
+
+    n_locals = 64
+    counters = max(8, int(200 * scale))
+    histos = max(4, int(50 * scale))
+    histo_samples = 20
+    rng = np.random.default_rng(4)
+
+    spec = TableSpec(counter_capacity=1 << 10, gauge_capacity=64,
+                     status_capacity=16, set_capacity=16,
+                     histo_capacity=1 << 8)
+    bspec = BatchSpec(counter=2048, gauge=64, status=16, set=64, histo=2048)
+
+    all_histo_vals = {h: [] for h in range(histos)}
+    exports = []
+    for li in range(n_locals):
+        agg = Aggregator(spec, bspec)
+        for c in range(counters):
+            m = parse_metric(
+                b"merged.counter.%d:%d|c|#veneurglobalonly" % (c, li + c))
+            agg.process_metric(m)
+        for h in range(histos):
+            vals = rng.lognormal(2.0, 0.8, histo_samples)
+            all_histo_vals[h].extend(vals.tolist())
+            for v in vals:
+                agg.process_metric(
+                    parse_metric(b"merged.timer.%d:%.4f|ms" % (h, v)))
+        _, table, raw = agg.flush([0.5], want_raw=True)
+        exports.append(export_metrics(raw, table, compression=spec.compression,
+                                      hll_precision=spec.hll_precision))
+
+    sink = DebugMetricSink()
+    glob = _mk_server([sink], grpc_address="127.0.0.1:0",
+                      tpu_counter_capacity=1 << 12,
+                      tpu_histo_capacity=1 << 9)
+    try:
+        client = ForwardClient(f"127.0.0.1:{glob.grpc_port}")
+        n_metrics = sum(len(e) for e in exports)
+        t0 = time.perf_counter()
+        for e in exports:
+            client.send_metrics(e, timeout=30.0)
+        # imports ride the pipeline queue; drain then flush
+        t1 = time.time()
+        while glob.packet_queue.qsize() and time.time() - t1 < FLUSH_WAIT:
+            time.sleep(0.02)
+        glob.trigger_flush()
+        dt = time.perf_counter() - t0
+        client.close()
+
+        flushed = {m.name: m.value for m in sink.flushed}
+        counter_exact = all(
+            flushed.get(f"merged.counter.{c}") ==
+            sum(li + c for li in range(n_locals))
+            for c in range(counters))
+        p99_errs = []
+        for h in range(histos):
+            got = flushed.get(f"merged.timer.{h}.99percentile")
+            exact = midpoint_quantile(all_histo_vals[h], 0.99)
+            if got is not None and exact > 0:
+                p99_errs.append(abs(got - exact) / exact)
+        return {
+            "config": 4, "name": "global_merge_64to1",
+            "forwarded_metrics_per_sec": round(n_metrics / dt, 1),
+            "n_locals": n_locals, "metrics_forwarded": n_metrics,
+            "counters_exact": bool(counter_exact),
+            "merged_p99_err_mean": round(float(np.mean(p99_errs)), 5),
+            "merged_p99_err_max": round(float(np.max(p99_errs)), 5),
+            "wall_seconds": round(dt, 3),
+        }
+    finally:
+        glob.shutdown()
+
+
+# -- config 5: SSF span firehose → count-min ---------------------------------
+
+def config5_span_firehose(scale=1.0):
+    """High-cardinality tagged span stream: protobuf parse → span workers →
+    count-min heavy hitters + metric extraction (BASELINE #5)."""
+    from veneur_tpu.proto import ssf_pb2
+    from veneur_tpu.protocol.wire import parse_ssf
+    from veneur_tpu.sinks.debug import DebugMetricSink
+
+    spans = max(2000, int(100_000 * scale))
+    hot_tags = 20
+    tail_tags = max(1000, int(1_000_000 * scale))
+    rng = np.random.default_rng(5)
+
+    # 50% of spans carry one of `hot_tags`, the rest near-unique tags
+    payloads = []
+    true_counts = np.zeros(hot_tags, np.int64)
+    for i in range(spans):
+        span = ssf_pb2.SSFSpan(version=0, trace_id=i + 1, id=i + 2,
+                               service="svc", name="op",
+                               start_timestamp=1000 + i,
+                               end_timestamp=2000 + i)
+        if i % 2 == 0:
+            t = int(rng.integers(0, hot_tags))
+            true_counts[t] += 1
+            span.tags["customer"] = f"hot{t}"
+        else:
+            span.tags["customer"] = f"tail{int(rng.integers(0, tail_tags))}"
+        payloads.append(span.SerializeToString())
+
+    sink = DebugMetricSink()
+    srv = _mk_server([sink], tag_frequency_enabled=True,
+                     tag_frequency_top_k=hot_tags,
+                     tag_frequency_batch_size=8192)
+    try:
+        handle = srv.span_pipeline.handle_span
+        t0 = time.perf_counter()
+        dropped0 = srv.span_pipeline.spans_dropped
+        for p in payloads:
+            while not handle(parse_ssf(p)):   # retry on full channel
+                time.sleep(0.001)
+        t1 = time.time()
+        while srv.tag_frequency.spans_seen < spans and \
+                time.time() - t1 < FLUSH_WAIT:
+            time.sleep(0.05)
+        samples = srv.tag_frequency.flush()
+        dt = time.perf_counter() - t0
+
+        got = {s.tags["tag"]: s.value for s in samples
+               if s.name == "veneur.span.tag_frequency"}
+        true_top = {f"customer:hot{t}" for t in
+                    np.argsort(-true_counts)[:10]}
+        recall = len(true_top & set(got)) / len(true_top)
+        errs = []
+        for t in range(hot_tags):
+            est = got.get(f"customer:hot{t}")
+            if est is not None and true_counts[t] > 0:
+                errs.append((est - true_counts[t]) / true_counts[t])
+        return {
+            "config": 5, "name": "span_firehose_heavy_hitters",
+            "spans_per_sec": round(spans / dt, 1),
+            "spans": spans,
+            "top10_recall": round(recall, 3),
+            "overestimate_mean": round(float(np.mean(errs)), 5),
+            "wall_seconds": round(dt, 3),
+        }
+    finally:
+        srv.shutdown()
+
+
+CONFIGS = {1: config1_counter_replay, 2: config2_zipf_timers,
+           3: config3_set_cardinality, 4: config4_global_merge,
+           5: config5_span_firehose}
+
+
+def main(configs=None, scale=None):
+    import jax
+    if scale is None:
+        on_tpu = jax.devices()[0].platform != "cpu"
+        scale = 1.0 if on_tpu else 0.02
+    results = []
+    for n in sorted(configs or CONFIGS):
+        results.append(CONFIGS[n](scale))
+    return results
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, __file__.rsplit("/", 2)[0])
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", type=int, action="append",
+                    help="config number 1-5 (repeatable; default all)")
+    ap.add_argument("--scale", type=float, default=None)
+    args = ap.parse_args()
+    for r in main(args.config, args.scale):
+        print(json.dumps(r))

@@ -1,0 +1,105 @@
+"""Tag-frequency heavy hitters over the span pipeline (BASELINE config 5:
+high-cardinality span tag stream -> per-interval top-K via the device
+count-min sketch)."""
+
+import time
+
+import numpy as np
+
+from veneur_tpu.proto import ssf_pb2
+from veneur_tpu.server.server import Server
+from veneur_tpu.sinks.debug import DebugMetricSink
+from veneur_tpu.sinks.tagfreq import TagFrequencySink
+
+from tests.test_server import by_name, small_config
+
+
+def span_with_tags(tags, trace_id=1, span_id=2):
+    span = ssf_pb2.SSFSpan(version=0, trace_id=trace_id, id=span_id,
+                           service="svc", name="op",
+                           start_timestamp=1, end_timestamp=2)
+    for k, v in tags.items():
+        span.tags[k] = v
+    return span
+
+
+def zipf_members(n_spans, n_values, seed=0):
+    """Zipf-ish tag values: value i drawn with weight 1/(i+1)."""
+    rng = np.random.default_rng(seed)
+    ranks = np.arange(1, n_values + 1, dtype=np.float64)
+    p = (1.0 / ranks) / np.sum(1.0 / ranks)
+    return rng.choice(n_values, size=n_spans, p=p)
+
+
+def test_sink_surfaces_true_heavy_hitters():
+    sink = TagFrequencySink(top_k=10, batch_size=256)
+    draws = zipf_members(6000, 2000)
+    for d in draws:
+        sink.ingest(span_with_tags({"customer": f"c{d}"}))
+    samples = sink.flush()
+    got = {s.tags["tag"]: s.value for s in samples
+           if s.name == "veneur.span.tag_frequency"}
+    true_counts = {f"customer:c{i}": int(c)
+                   for i, c in enumerate(np.bincount(draws))}
+    true_top5 = sorted(true_counts, key=lambda k: -true_counts[k])[:5]
+    for k in true_top5:
+        assert k in got, f"true heavy hitter {k} missing from {list(got)[:8]}"
+        # CMS estimates are one-sided: estimate >= true
+        assert got[k] >= true_counts[k]
+        # and close at this width (error <= eps*N, eps = e/width << 1%)
+        assert got[k] <= true_counts[k] + 0.01 * len(draws)
+    # total tracked
+    totals = [s for s in samples
+              if s.name == "veneur.span.tag_frequency.total"]
+    assert totals and totals[0].value == len(draws)
+
+
+def test_tag_key_filter_and_reset():
+    sink = TagFrequencySink(top_k=5, tag_keys=["tracked"], batch_size=8)
+    for i in range(20):
+        sink.ingest(span_with_tags({"tracked": "yes", "ignored": f"x{i}"}))
+    samples = sink.flush()
+    got = {s.tags["tag"] for s in samples
+           if s.name == "veneur.span.tag_frequency"}
+    assert got == {"tracked:yes"}
+    # interval state resets on flush
+    assert sink.flush() == []
+
+
+def test_server_reports_top_tags_through_metric_pipeline():
+    """End-to-end: spans in -> count-min -> flush -> self-telemetry
+    loop-back -> metric sinks see veneur.span.tag_frequency."""
+    msink = DebugMetricSink()
+    cfg = small_config(tag_frequency_enabled=True,
+                       tag_frequency_top_k=5,
+                       tag_frequency_batch_size=64,
+                       span_channel_capacity=1024)
+    srv = Server(cfg, metric_sinks=[msink])
+    srv.start()
+    try:
+        for i in range(120):
+            # "hot" appears every span; filler values are near-unique
+            srv.span_pipeline.handle_span(span_with_tags(
+                {"customer": "hot" if i % 2 == 0 else f"cold{i}"},
+                trace_id=i + 1, span_id=i + 2))
+        deadline = time.time() + 10
+        while (srv.tag_frequency.spans_seen < 120
+               and time.time() < deadline):
+            time.sleep(0.05)
+        srv.trigger_flush()     # flushes span sinks, reports via loop-back
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            srv.trigger_flush()  # loop-back lands in a later interval
+            m = by_name(msink.flushed)
+            hits = [im for im in msink.flushed
+                    if im.name == "veneur.span.tag_frequency"
+                    and "tag:customer:hot" in im.tags]
+            if hits:
+                assert hits[0].value >= 60
+                return
+            time.sleep(0.1)
+        raise AssertionError(
+            "veneur.span.tag_frequency for the hot tag never flushed; saw "
+            f"{sorted({im.name for im in msink.flushed})[:10]}")
+    finally:
+        srv.shutdown()

@@ -240,6 +240,39 @@ class Batcher:
         self.nhs += 1
         self._maybe_emit(self.nhs, self.bspec.histo_stat)
 
+    # -- bulk staging (vectorized; the native engine's emit arrays are
+    # split per shard and copied in slices, not per-sample Python calls) --
+    def _bulk(self, dsts, srcs, n_attr: str, cap: int):
+        n = len(srcs[0])
+        i = 0
+        while i < n:
+            cur = getattr(self, n_attr)
+            take = min(cap - cur, n - i)
+            for dst, src in zip(dsts, srcs):
+                dst[cur:cur + take] = src[i:i + take]
+            setattr(self, n_attr, cur + take)
+            i += take
+            if getattr(self, n_attr) >= cap:
+                self.emit()
+
+    def add_counters_bulk(self, slots, incs):
+        """incs already rate-weighted (the native stager applies 1/rate)."""
+        self._bulk((self.c_slot, self.c_inc), (slots, incs), "nc",
+                   self.bspec.counter)
+
+    def add_gauges_bulk(self, slots, vals):
+        self._bulk((self.g_slot, self.g_val), (slots, vals), "ng",
+                   self.bspec.gauge)
+
+    def add_sets_bulk(self, slots, regs, rhos):
+        """(reg, rho) pre-hashed by the native engine."""
+        self._bulk((self.s_slot, self.s_reg, self.s_rho),
+                   (slots, regs, rhos), "ns", self.bspec.set)
+
+    def add_histos_bulk(self, slots, vals, wts):
+        self._bulk((self.h_slot, self.h_val, self.h_wt),
+                   (slots, vals, wts), "nh", self.bspec.histo)
+
     def pending(self) -> int:
         return (self.nc + self.ng + self.nst + self.ns + self.nh
                 + self.nhs)

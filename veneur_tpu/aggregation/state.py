@@ -46,11 +46,22 @@ class TableSpec:
     histo_capacity: int = 1 << 14
     compression: float = td.DEFAULT_COMPRESSION
     cells_per_k: int = td.DEFAULT_CELLS_PER_K
+    temp_cells: int = 128
     hll_precision: int = hll.DEFAULT_PRECISION
 
     @property
     def centroids(self) -> int:
         return td.centroid_capacity(self.compression, self.cells_per_k)
+
+    @property
+    def total_cells(self) -> int:
+        """Centroid columns per digest row: C canonical k-cells plus T raw
+        temp cells (the fixed-shape analogue of the reference digest's temp
+        buffer, merging_digest.go:105-111). A key's first T samples land
+        verbatim in temp cells — exact until compaction — so cold keys never
+        suffer estimate-based cell assignment while their digest is still
+        unformed."""
+        return self.centroids + self.temp_cells
 
     @property
     def registers(self) -> int:
@@ -75,9 +86,12 @@ class DeviceState(NamedTuple):
     status_stamp: jax.Array  # u8[Kst]
     # sets
     hll: jax.Array           # u8[Ks, R]
-    # histograms / timers: digest as (wm, w) + exact scalar aggregates
-    h_wm: jax.Array          # f32[Kh, C]  sum of weight*mean per k-cell
-    h_w: jax.Array           # f32[Kh, C]
+    # histograms / timers: digest as (wm, w) + exact scalar aggregates.
+    # Columns [0, C) are canonical k-cells; columns [C, C+T) are raw temp
+    # cells holding individual samples since the last compaction.
+    h_wm: jax.Array          # f32[Kh, C+T]  sum of weight*mean per cell
+    h_w: jax.Array           # f32[Kh, C+T]
+    h_temp_n: jax.Array      # i32[Kh] samples absorbed since last compact
     h_min: jax.Array         # f32[Kh]
     h_max: jax.Array         # f32[Kh]
     h_count_acc: jax.Array   # f32[Kh] + two-float, like counters
@@ -94,7 +108,7 @@ class DeviceState(NamedTuple):
 def empty_state(spec: TableSpec) -> DeviceState:
     f = jnp.float32
     kc, kg, kst = spec.counter_capacity, spec.gauge_capacity, spec.status_capacity
-    ks, kh, c = spec.set_capacity, spec.histo_capacity, spec.centroids
+    ks, kh, c = spec.set_capacity, spec.histo_capacity, spec.total_cells
     z = jnp.zeros
     return DeviceState(
         counter_acc=z((kc,), f), counter_hi=z((kc,), f), counter_lo=z((kc,), f),
@@ -102,6 +116,7 @@ def empty_state(spec: TableSpec) -> DeviceState:
         status=z((kst,), f), status_stamp=z((kst,), jnp.uint8),
         hll=jnp.zeros((ks, spec.registers), jnp.uint8),
         h_wm=z((kh, c), f), h_w=z((kh, c), f),
+        h_temp_n=z((kh,), jnp.int32),
         h_min=jnp.full((kh,), jnp.inf, f),
         h_max=jnp.full((kh,), -jnp.inf, f),
         h_count_acc=z((kh,), f), h_count_hi=z((kh,), f), h_count_lo=z((kh,), f),

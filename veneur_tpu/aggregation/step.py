@@ -75,6 +75,7 @@ def _last_per_slot_set(target, stamp, slot, val, capacity):
 
 def _histo_update(state: DeviceState, slot, val, wt, spec: TableSpec):
     c = spec.centroids
+    t = spec.temp_cells
     kh = spec.histo_capacity
     valid = (slot >= 0) & (slot < kh) & (wt > 0)
     slot = jnp.where(valid, slot, kh)
@@ -84,10 +85,28 @@ def _histo_update(state: DeviceState, slot, val, wt, spec: TableSpec):
     s = slot[order]
     v = jnp.where(valid[order], val[order], 0.0)
     w = jnp.where(valid[order], wt[order], 0.0)
+    ok = valid[order]
 
-    # mass of the current digest below each sample value
+    # segment bookkeeping: start flags, ids, within-segment rank
+    idx = jnp.arange(s.shape[0], dtype=jnp.int32)
+    seg_start = jnp.concatenate(
+        [jnp.ones((1,), bool), s[1:] != s[:-1]])
+    seg_id = jnp.cumsum(seg_start.astype(jnp.int32)) - 1
+    rank = idx - jax.lax.cummax(jnp.where(seg_start, idx, 0))
+
+    # A key's first T samples since its last compaction land verbatim in
+    # its temp cells (exact — no estimate involved), the fixed-shape
+    # analogue of the reference digest's temp buffer
+    # (merging_digest.go:105-140). Only once a key is hot enough to have
+    # overflowed temp does estimate-based k-cell assignment kick in — by
+    # then the compacted digest is well-formed and the estimates are good.
+    temp_idx = state.h_temp_n[jnp.minimum(s, kh - 1)] + rank
+    use_temp = ok & (temp_idx < t)
+
+    # mass of the current digest below each sample value (temp cells
+    # participate: their "means" are raw sample values)
     sc = jnp.minimum(s, kh - 1)
-    row_w = state.h_w[sc]                     # f32[B, C]
+    row_w = state.h_w[sc]                     # f32[B, C+T]
     row_wm = state.h_wm[sc]
     row_mean = row_wm / jnp.maximum(row_w, 1e-30)
     w_main = jnp.sum(row_w, axis=-1)
@@ -95,9 +114,6 @@ def _histo_update(state: DeviceState, slot, val, wt, spec: TableSpec):
              + 0.5 * jnp.sum(row_w * (row_mean == v[:, None]), axis=-1))
 
     # mass of earlier batch samples in the same segment
-    seg_start = jnp.concatenate(
-        [jnp.ones((1,), bool), s[1:] != s[:-1]])
-    seg_id = jnp.cumsum(seg_start.astype(jnp.int32)) - 1
     cum_excl = jnp.cumsum(w) - w
     base = jax.lax.cummax(jnp.where(seg_start, cum_excl, 0.0))
     cum_seg = cum_excl - base
@@ -109,9 +125,12 @@ def _histo_update(state: DeviceState, slot, val, wt, spec: TableSpec):
     cell = jnp.floor((td._k1(q_mid, spec.compression) - k0)
                      * spec.cells_per_k).astype(jnp.int32)
     cell = jnp.clip(cell, 0, c - 1)
+    cell = jnp.where(use_temp, c + jnp.minimum(temp_idx, t - 1), cell)
 
     h_w = state.h_w.at[s, cell].add(w, mode="drop")
     h_wm = state.h_wm.at[s, cell].add(w * v, mode="drop")
+    h_temp_n = state.h_temp_n.at[s].add(
+        jnp.where(ok, 1, 0).astype(jnp.int32), mode="drop")
     h_min = state.h_min.at[s].min(jnp.where(w > 0, v, jnp.inf), mode="drop")
     h_max = state.h_max.at[s].max(jnp.where(w > 0, v, -jnp.inf), mode="drop")
     h_count = state.h_count_acc.at[s].add(w, mode="drop")
@@ -120,7 +139,8 @@ def _histo_update(state: DeviceState, slot, val, wt, spec: TableSpec):
     # stream containing 0 is 0 downstream).
     h_recip = state.h_recip_acc.at[s].add(
         jnp.where(w > 0, w / v, 0.0), mode="drop")
-    return state._replace(h_w=h_w, h_wm=h_wm, h_min=h_min, h_max=h_max,
+    return state._replace(h_w=h_w, h_wm=h_wm, h_temp_n=h_temp_n,
+                          h_min=h_min, h_max=h_max,
                           h_count_acc=h_count, h_sum_acc=h_sum,
                           h_recip_acc=h_recip)
 
@@ -178,13 +198,18 @@ def fold_scalars(state: DeviceState) -> DeviceState:
 
 
 def compact_core(state: DeviceState, *, spec: TableSpec) -> DeviceState:
-    """Re-compress every digest row to canonical k-cells. Amortized analogue
-    of the reference's mergeAllTemps (merging_digest.go:140)."""
+    """Re-compress every digest row — canonical k-cells AND raw temp cells —
+    into canonical k-cells, emptying temp. Amortized analogue of the
+    reference's mergeAllTemps (merging_digest.go:140)."""
     mean = state.h_wm / jnp.maximum(state.h_w, 1e-30)
     m2, w2 = td.compress_rows(mean, state.h_w, compression=spec.compression,
                               cells_per_k=spec.cells_per_k,
                               out_c=spec.centroids)
-    return state._replace(h_wm=m2 * w2, h_w=w2)
+    pad = jnp.zeros(w2.shape[:-1] + (spec.temp_cells,), w2.dtype)
+    return state._replace(
+        h_wm=jnp.concatenate([m2 * w2, pad], axis=-1),
+        h_w=jnp.concatenate([w2, pad], axis=-1),
+        h_temp_n=jnp.zeros_like(state.h_temp_n))
 
 
 compact = partial(jax.jit, static_argnames=("spec",),

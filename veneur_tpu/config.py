@@ -180,6 +180,16 @@ class Config:
     objective_span_timer_name: str = ""
     ssf_buffer_size: int = 0
 
+    # tag-frequency heavy hitters over spans (this framework's addition:
+    # count-min sketch on device, BASELINE config 5)
+    tag_frequency_enabled: bool = False
+    tag_frequency_tag_keys: List[str] = dataclasses.field(
+        default_factory=list)   # empty = every tag key
+    tag_frequency_top_k: int = 100
+    tag_frequency_depth: int = 4
+    tag_frequency_width: int = 1 << 16
+    tag_frequency_batch_size: int = 4096
+
     # plugins
     aws_access_key_id: str = ""
     aws_secret_access_key: str = ""
@@ -206,7 +216,7 @@ class Config:
     tpu_batch_histo: int = 8192
     tpu_n_shards: int = 0      # 0 = one shard per local device
     tpu_n_replicas: int = 1
-    tpu_compact_every: int = 32
+    tpu_compact_every: int = 8
     tpu_fold_every: int = 64
 
     def parse_interval(self) -> float:

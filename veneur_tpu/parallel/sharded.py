@@ -167,6 +167,10 @@ def _merge_replica_block(state: DeviceState, spec: TableSpec):
     m2, w2 = td.compress_rows(mean, w, compression=spec.compression,
                               cells_per_k=spec.cells_per_k,
                               out_c=spec.centroids)
+    # back to the state's [C + temp] column layout, temp emptied
+    pad = jnp.zeros(w2.shape[:-1] + (spec.temp_cells,), w2.dtype)
+    w2 = jnp.concatenate([w2, pad], axis=-1)
+    wm2 = jnp.concatenate([m2 * w2[..., :spec.centroids], pad], axis=-1)
 
     h_min = jax.lax.pmin(state.h_min.min(axis=0), ax)
     h_max = jax.lax.pmax(state.h_max.max(axis=0), ax)
@@ -177,7 +181,9 @@ def _merge_replica_block(state: DeviceState, spec: TableSpec):
         gauge=gauge, gauge_stamp=gauge_stamp,
         status=status, status_stamp=status_stamp,
         hll=hll,
-        h_wm=m2 * w2, h_w=w2, h_min=h_min, h_max=h_max,
+        h_wm=wm2, h_w=w2,
+        h_temp_n=jnp.zeros(w2.shape[:-1], jnp.int32),
+        h_min=h_min, h_max=h_max,
         h_count_acc=z(h_count), h_count_hi=h_count, h_count_lo=z(h_count),
         h_sum_acc=z(h_sum), h_sum_hi=h_sum, h_sum_lo=z(h_sum),
         h_recip_acc=z(h_recip), h_recip_hi=h_recip, h_recip_lo=z(h_recip),

@@ -25,7 +25,7 @@ from veneur_tpu.samplers.parser import UDPMetric
 
 class Aggregator:
     def __init__(self, spec: TableSpec, bspec: BatchSpec = BatchSpec(),
-                 n_shards: int = 1, compact_every: int = 32,
+                 n_shards: int = 1, compact_every: int = 8,
                  fold_every: int = 64):
         self.spec = spec
         self.bspec = bspec

@@ -37,6 +37,7 @@ from veneur_tpu.aggregation.host import (
 from veneur_tpu.aggregation.state import TableSpec
 from veneur_tpu.native import NativeIngest
 from veneur_tpu.server.aggregator import Aggregator
+from veneur_tpu.server.sharded_aggregator import ShardedAggregator
 
 
 class NativeKeyTable:
@@ -112,7 +113,7 @@ class NativeKeyTable:
 
 class NativeAggregator(Aggregator):
     def __init__(self, spec: TableSpec, bspec: BatchSpec = BatchSpec(),
-                 n_shards: int = 1, compact_every: int = 32,
+                 n_shards: int = 1, compact_every: int = 8,
                  fold_every: int = 64):
         super().__init__(spec, bspec, n_shards, compact_every, fold_every)
         self.eng = NativeIngest(spec, bspec, n_shards)
@@ -209,6 +210,92 @@ class NativeAggregator(Aggregator):
         state, _ = super().swap()
         # super() replaced self.table with a fresh Python KeyTable; the
         # native engine keeps the slot space, so re-wrap it post-reset
+        self.eng.reset()
+        self.table = NativeKeyTable(self.spec, self.eng, self.n_shards)
+        return state, detached
+
+
+class NativeShardedAggregator(ShardedAggregator):
+    """Mesh-sharded backend fed by the C++ parse/key/stage engine.
+
+    The engine's slot space is shard-aware (dogstatsd.cpp KindTable:
+    slot = shard*per_shard + local, same rule as aggregation/host.py), so
+    its emitted global slots split into (shard, local) with two vectorized
+    numpy ops and bulk-copy into the per-shard staging batchers — the 30x
+    C++ host path and the multi-device mesh compose instead of excluding
+    each other."""
+
+    def __init__(self, spec: TableSpec, bspec: BatchSpec = BatchSpec(),
+                 n_shards: int = 2, compact_every: int = 8,
+                 fold_every: int = 64):
+        super().__init__(spec, bspec, n_shards, compact_every, fold_every)
+        self.eng = NativeIngest(spec, bspec, n_shards)
+        self.table = NativeKeyTable(spec, self.eng, n_shards)
+        self._py_processed = 0
+        self._py_dropped = 0
+        # reuse NativeAggregator's emit buffer layout
+        NativeAggregator._alloc_emit_buffers(self)
+
+    # engine-backed stats (same split as NativeAggregator)
+    extra_parse_errors = NativeAggregator.extra_parse_errors
+    processed = NativeAggregator.processed
+    dropped_capacity = NativeAggregator.dropped_capacity
+    feed = NativeAggregator.feed
+
+    def _emit_native(self):
+        spec = self.spec
+        self._c_slot.fill(spec.counter_capacity)
+        self._g_slot.fill(spec.gauge_capacity)
+        self._s_slot.fill(spec.set_capacity)
+        self._h_slot.fill(spec.histo_capacity)
+        self._h_wt.fill(0.0)
+        self._c_inc.fill(0.0)
+        nc, ng, ns, nh = self.eng.emit_into(
+            (self._c_slot, self._c_inc, self._g_slot, self._g_val,
+             self._s_slot, self._s_reg, self._s_rho, self._h_slot,
+             self._h_val, self._h_wt))
+        if nc + ng + ns + nh == 0:
+            return
+
+        def split(global_slots, per_shard):
+            return (global_slots // per_shard).astype(np.int32), \
+                   (global_slots % per_shard).astype(np.int32)
+
+        p = self.pspec
+        if nc:
+            sh, lo = split(self._c_slot[:nc], p.counter_capacity)
+            for i in range(self.n_shards):
+                m = sh == i
+                if m.any():
+                    self.batchers[i].add_counters_bulk(
+                        lo[m], self._c_inc[:nc][m])
+        if ng:
+            sh, lo = split(self._g_slot[:ng], p.gauge_capacity)
+            for i in range(self.n_shards):
+                m = sh == i
+                if m.any():
+                    self.batchers[i].add_gauges_bulk(
+                        lo[m], self._g_val[:ng][m])
+        if ns:
+            sh, lo = split(self._s_slot[:ns], p.set_capacity)
+            for i in range(self.n_shards):
+                m = sh == i
+                if m.any():
+                    self.batchers[i].add_sets_bulk(
+                        lo[m], self._s_reg[:ns][m], self._s_rho[:ns][m])
+        if nh:
+            sh, lo = split(self._h_slot[:nh], p.histo_capacity)
+            for i in range(self.n_shards):
+                m = sh == i
+                if m.any():
+                    self.batchers[i].add_histos_bulk(
+                        lo[m], self._h_val[:nh][m], self._h_wt[:nh][m])
+
+    def swap(self):
+        self._emit_native()
+        detached = self.table
+        detached.finalize()
+        state, _ = super().swap()
         self.eng.reset()
         self.table = NativeKeyTable(self.spec, self.eng, self.n_shards)
         return state, detached

@@ -133,6 +133,21 @@ class Server:
             self.process_span_metrics,
             indicator_timer_name=cfg.indicator_span_timer_name,
             objective_timer_name=cfg.objective_span_timer_name)
+        # tag-frequency heavy hitters (count-min over the span firehose);
+        # reports per-interval top-K through the self-telemetry loop-back
+        self.tag_frequency = None
+        if cfg.tag_frequency_enabled:
+            from veneur_tpu.sinks.tagfreq import TagFrequencySink
+            from veneur_tpu.trace.client import report_batch
+            self.tag_frequency = TagFrequencySink(
+                report=lambda samples: report_batch(self.trace_client,
+                                                    samples),
+                tag_keys=cfg.tag_frequency_tag_keys,
+                top_k=cfg.tag_frequency_top_k,
+                depth=cfg.tag_frequency_depth,
+                width=cfg.tag_frequency_width,
+                batch_size=cfg.tag_frequency_batch_size)
+            self.span_sinks.append(self.tag_frequency)
         # bare tags map to empty values (parser.go:694 ParseTagSliceToMap)
         common_tags = {t.split(":", 1)[0]: (t.split(":", 1)[1]
                                             if ":" in t else "")

@@ -48,7 +48,7 @@ def per_shard_spec(spec: TableSpec, n_shards: int) -> TableSpec:
 
 class ShardedAggregator(Aggregator):
     def __init__(self, spec: TableSpec, bspec: BatchSpec = BatchSpec(),
-                 n_shards: int = 2, compact_every: int = 32,
+                 n_shards: int = 2, compact_every: int = 8,
                  fold_every: int = 64):
         import jax
         from veneur_tpu.parallel import (

@@ -1,0 +1,99 @@
+"""Tag-frequency span sink: count-min heavy hitters over the span firehose.
+
+No reference counterpart — this is the sketch consumer BASELINE config 5
+calls for (10M-tag SSF span stream → per-interval top-K tag frequencies).
+A span sink (SURVEY §2.5 fan-out: every span visits every sink) that feeds
+`tag_key:value` strings into the device count-min sketch
+(veneur_tpu/ops/countmin.py) and, at flush, reports the interval's top-K
+as SSF samples through the server's own trace client — so the results ride
+the normal self-telemetry loop-back into the metric pipeline and out every
+metric sink, exactly like veneur.* counters.
+
+Batching: members are buffered per worker call and shipped to the device in
+fixed-size batches (amortizes dispatch; SURVEY §7 "hardest part #2" says
+≥64k samples/dispatch for the firehose — the default here is smaller so
+light spans traffic still flushes promptly, the batch size is config).
+Thread safety: span pipelines may run several workers; buffer + sketch
+updates are lock-guarded (the device update itself is jitted + functional).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, List, Optional, Sequence
+
+from veneur_tpu.ops.countmin import (
+    DEFAULT_DEPTH, DEFAULT_WIDTH, HeavyHitters)
+
+log = logging.getLogger("veneur_tpu.sinks.tagfreq")
+
+
+class TagFrequencySink:
+    """SpanSink tracking heavy-hitter tag values per flush interval."""
+
+    name = "tag_frequency"
+
+    def __init__(self, report: Optional[Callable[[List], None]] = None,
+                 tag_keys: Sequence[str] = (), top_k: int = 100,
+                 depth: int = DEFAULT_DEPTH, width: int = DEFAULT_WIDTH,
+                 batch_size: int = 4096,
+                 metric_name: str = "veneur.span.tag_frequency"):
+        self.report = report
+        self.tag_keys = set(tag_keys)
+        self.top_k = top_k
+        self.batch_size = batch_size
+        self.metric_name = metric_name
+        self.hh = HeavyHitters(top_k, depth, width)
+        self._buf: List[bytes] = []
+        self._lock = threading.Lock()
+        self.spans_seen = 0
+        self.members_seen = 0
+
+    def start(self):
+        pass
+
+    def ingest(self, span) -> None:
+        members = []
+        for k, v in span.tags.items():
+            if self.tag_keys and k not in self.tag_keys:
+                continue
+            members.append(f"{k}:{v}".encode())
+        if not members:
+            return
+        with self._lock:
+            self.spans_seen += 1
+            self.members_seen += len(members)
+            self._buf.extend(members)
+            if len(self._buf) >= self.batch_size:
+                self._drain_locked()
+
+    def _drain_locked(self):
+        if self._buf:
+            self.hh.update(self._buf)
+            self._buf = []
+
+    def flush(self) -> List:
+        """Report the interval's top-K and reset (flush-scoped state, like
+        every other sketch in the pipeline). Returns the samples for tests
+        and callers without a report callback."""
+        from veneur_tpu.samplers import ssf_samples
+        with self._lock:
+            self._drain_locked()
+            top = self.hh.top(self.top_k)
+            total = self.hh.total
+            self.hh.reset()
+        samples = []
+        for member, count in top:
+            kv = member.decode("utf-8", "replace")
+            samples.append(ssf_samples.gauge(
+                self.metric_name, float(count), {"tag": kv}))
+        if samples:
+            samples.append(ssf_samples.gauge(
+                self.metric_name + ".total", float(total)))
+        if self.report is not None and samples:
+            try:
+                self.report(samples)
+            except Exception as e:
+                log.warning("tag-frequency report failed: %s", e)
+        return samples
