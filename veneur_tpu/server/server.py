@@ -36,9 +36,37 @@ from veneur_tpu.server.flusher import generate_intermetrics
 
 log = logging.getLogger("veneur_tpu.server")
 
-_FLUSH = object()   # pipeline-queue sentinel: run a flush now
 _STOP = object()    # pipeline-queue sentinel: drain and exit
 MAX_UDP_SSF = 65536
+
+
+class FlushRequest:
+    """One flush command traveling pipeline thread → flush worker.
+
+    Waiters observe THIS request's completion — not "any flush", which
+    let a ticker flush satisfy a manual trigger's wait and return before
+    the caller's data reached the sinks (the round-2 bench failure mode).
+    `ok` is False when the flush was deferred under backpressure, failed,
+    or (for the waiter) timed out; `detail` says which."""
+
+    __slots__ = ("done", "ok", "detail")
+
+    def __init__(self):
+        self.done = threading.Event()
+        self.ok = False
+        self.detail = ""
+
+    def finish(self, ok: bool, detail: str = "") -> None:
+        self.ok = ok
+        self.detail = detail
+        self.done.set()
+
+    def wait(self, timeout: float) -> bool:
+        """True iff the flush completed successfully within `timeout`."""
+        if not self.done.wait(timeout):
+            self.detail = f"timed out after {timeout:.0f}s"
+            return False
+        return self.ok
 
 
 class _ImportBatch(list):
@@ -175,7 +203,7 @@ class Server:
         # device-state snapshot, so a backlogged flush worker must drop
         # intervals rather than grow without limit.
         self._flush_jobs: "queue.Queue" = queue.Queue(maxsize=4)
-        self.flush_intervals_dropped = 0
+        self.flush_intervals_deferred = 0
         self.last_flush = time.time()
         self.last_flush_done = time.time()
         self.flush_count = 0
@@ -185,8 +213,10 @@ class Server:
         self._shutdown = threading.Event()
         self._threads: List[threading.Thread] = []
         self._pipeline_thread: Optional[threading.Thread] = None
+        self._flush_thread: Optional[threading.Thread] = None
+        self._aux_threads: List[threading.Thread] = []
+        self._aux_lock = threading.Lock()
         self._sockets: List[socket.socket] = []
-        self._flush_done = threading.Condition()
         self._forward_client = None
         self._grpc_server = None
         self.grpc_port = None
@@ -245,45 +275,8 @@ class Server:
             item = self.packet_queue.get()
             if item is _STOP:
                 return
-            if item is _FLUSH:
-                # The pipeline thread does ONLY the state/table swap; all
-                # downstream flush work (device flush math, intermetric
-                # generation, sink fan-out, plugins) happens on the flush
-                # thread so ingest never stalls behind a slow sink
-                # (flusher.go:105-115 semantics).
-                now = time.time()
-                self.last_flush = now
-                try:
-                    state, table = self.aggregator.swap()
-                except Exception:
-                    log.exception("flush swap failed")
-                    with self._flush_done:
-                        self.flush_count += 1
-                        self._flush_done.notify_all()
-                    continue
-                # snapshot pipeline-owned counters here: the native engine's
-                # stats call isn't safe to interleave with feed()
-                stats = {
-                    "packets_received": self.packets_received,
-                    "parse_errors": self.parse_errors
-                    + self.aggregator.extra_parse_errors(),
-                    "processed": self.aggregator.processed + 0,
-                    "dropped": self.aggregator.dropped_capacity,
-                    "import_errors": self.import_errors,
-                    "spans_received": self.span_pipeline.spans_received,
-                    "intervals_dropped": self.flush_intervals_dropped,
-                }
-                try:
-                    self._flush_jobs.put_nowait((state, table, stats, now))
-                except queue.Full:
-                    # flush worker is badly behind (the watchdog tracks a
-                    # fully stuck one); dropping the interval bounds memory
-                    # — each job holds a full detached device state
-                    self.flush_intervals_dropped += 1
-                    log.error("flush worker backlogged; dropped interval")
-                    with self._flush_done:
-                        self.flush_count += 1
-                        self._flush_done.notify_all()
+            if isinstance(item, FlushRequest):
+                self._handle_flush_request(item)
                 continue
             if isinstance(item, _ImportBatch):
                 from veneur_tpu.forward.convert import import_into
@@ -303,6 +296,45 @@ class Server:
                     self.aggregator.process_metric(m)
                 continue
             self._process_packets(item)
+
+    def _handle_flush_request(self, req: FlushRequest) -> None:
+        """Pipeline-thread half of a flush: ONLY the state/table swap; all
+        downstream work (device flush math, intermetric generation, sink
+        fan-out, plugins) runs on the flush worker so ingest never stalls
+        behind a slow sink (flusher.go:105-115 semantics)."""
+        # Backpressure check BEFORE the swap: when the flush worker is
+        # backlogged the interval simply extends in device state — nothing
+        # is discarded (the reference never drops aggregated data short of
+        # a crash, flusher.go:28-131; the watchdog remains the backstop
+        # for a fully wedged worker). Only the pipeline thread puts jobs,
+        # so full() → put_nowait cannot race into queue.Full.
+        if self._flush_jobs.full():
+            self.flush_intervals_deferred += 1
+            log.warning("flush worker backlogged; interval deferred "
+                        "(state retained)")
+            req.finish(False, "deferred: flush worker backlogged")
+            return
+        now = time.time()
+        self.last_flush = now
+        try:
+            state, table = self.aggregator.swap()
+        except Exception as e:
+            log.exception("flush swap failed")
+            req.finish(False, f"swap failed: {e}")
+            return
+        # snapshot pipeline-owned counters here: the native engine's
+        # stats call isn't safe to interleave with feed()
+        stats = {
+            "packets_received": self.packets_received,
+            "parse_errors": self.parse_errors
+            + self.aggregator.extra_parse_errors(),
+            "processed": self.aggregator.processed + 0,
+            "dropped": self.aggregator.dropped_capacity,
+            "import_errors": self.import_errors,
+            "spans_received": self.span_pipeline.spans_received,
+            "intervals_deferred": self.flush_intervals_deferred,
+        }
+        self._flush_jobs.put_nowait((state, table, stats, now, req))
 
     # -- listeners ----------------------------------------------------------
     def _udp_reader(self, sock: socket.socket):
@@ -494,7 +526,7 @@ class Server:
         fw = threading.Thread(target=self._flush_worker, daemon=True,
                               name="flush-worker")
         fw.start()
-        self._threads.append(fw)
+        self._flush_thread = fw
 
         for addr in self.cfg.statsd_listen_addresses:
             kind, target = resolve_addr(addr)
@@ -635,20 +667,30 @@ class Server:
         while not self._shutdown.wait(self.interval):
             self.trigger_flush(wait=False)
 
-    def trigger_flush(self, wait: bool = True):
+    def trigger_flush(self, wait: bool = True,
+                      timeout: Optional[float] = None):
         """Enqueue a flush on the pipeline thread (the ticker of
-        server.go:853-890). With wait=True, blocks until it completed —
-        the reference tests' manual-flush idiom. The queue put happens
-        outside the condition lock so a full queue can never deadlock the
-        pipeline thread against the ticker."""
-        with self._flush_done:
-            gen = self.flush_count
-        self.packet_queue.put(_FLUSH)
-        if wait:
-            with self._flush_done:
-                self._flush_done.wait_for(
-                    lambda: self.flush_count > gen,
-                    timeout=max(self.interval, 30.0))
+        server.go:853-890).
+
+        With wait=True (the reference tests' manual-flush idiom), blocks
+        until THIS request's flush completed and returns True on success,
+        False on deferral/failure/timeout — never silently. The default
+        timeout is generous because the first flush on a real TPU compiles
+        the swap/flush programs (tens of seconds); callers that can't
+        tolerate that pass their own.
+
+        With wait=False returns the FlushRequest, so a caller can observe
+        this specific flush later (req.wait / req.ok / req.detail)."""
+        req = FlushRequest()
+        self.packet_queue.put(req)
+        if not wait:
+            return req
+        budget = timeout if timeout is not None else max(
+            2 * self.interval, 120.0)
+        ok = req.wait(budget)
+        if not ok:
+            log.warning("manual flush did not complete: %s", req.detail)
+        return ok
 
     def _flush_worker(self):
         """Dedicated flush thread: drains detached intervals and runs the
@@ -658,18 +700,19 @@ class Server:
             job = self._flush_jobs.get()
             if job is _STOP:
                 return
-            state, table, stats, swapped_at = job
+            state, table, stats, swapped_at, req = job
+            ok, detail = True, ""
             try:
                 self._do_flush(state, table, stats, swapped_at)
-            except Exception:
+            except Exception as e:
                 # a failed flush must never kill the flush thread; state
                 # was already swapped, next interval starts clean
+                ok, detail = False, f"{type(e).__name__}: {e}"
                 log.exception("flush failed")
             finally:
                 self.last_flush_done = time.time()
-                with self._flush_done:
-                    self.flush_count += 1
-                    self._flush_done.notify_all()
+                self.flush_count += 1
+                req.finish(ok, detail)
 
     def _do_flush(self, state, table, stats, swapped_at):
         flush_t0 = time.perf_counter()
@@ -682,8 +725,7 @@ class Server:
             # fire-and-forget, concurrent with sink flushes
             # (flusher.go:84-95); _forward logs and counts its own errors,
             # and the flush thread must never block on a slow global tier
-            threading.Thread(target=self._forward, args=(raw, table),
-                             daemon=True).start()
+            self._spawn_aux(self._forward, raw, table)
         else:
             flush_arrays, table = self.aggregator.compute_flush(
                 state, table, self.cfg.percentiles)
@@ -693,8 +735,7 @@ class Server:
             self._unique_ts = unique_timeseries(table, self.cfg.is_local)
 
         # span sinks flush concurrently (flusher.go:56 go flushTraces)
-        threading.Thread(target=self.span_pipeline.flush,
-                         daemon=True).start()
+        self._spawn_aux(self.span_pipeline.flush)
 
         with self._event_lock:
             samples, self.event_samples = self.event_samples, []
@@ -746,8 +787,8 @@ class Server:
                "veneur.worker.metrics_processed_total": stats["processed"],
                "veneur.worker.metrics_dropped_total": stats["dropped"],
                "veneur.import.errors_total": stats["import_errors"],
-               "veneur.flush.intervals_dropped_total":
-                   stats["intervals_dropped"],
+               "veneur.flush.intervals_deferred_total":
+                   stats["intervals_deferred"],
                "veneur.spans_received_total": stats["spans_received"]}
         samples = [ssf_samples.timing("veneur.flush.total_duration_ns",
                                       flush_seconds),
@@ -813,6 +854,18 @@ class Server:
         except Exception as e:
             log.warning("sink %s flush failed: %s", sink.name, e)
 
+    def _spawn_aux(self, target, *args) -> threading.Thread:
+        """Fire-and-forget helpers (forward, span-sink flush) are tracked
+        so shutdown can join them — an orphaned thread still inside JAX or
+        gRPC at interpreter teardown aborts the process (SIGABRT)."""
+        t = threading.Thread(target=target, args=args, daemon=True)
+        t.start()
+        with self._aux_lock:
+            self._aux_threads = [x for x in self._aux_threads
+                                 if x.is_alive()]
+            self._aux_threads.append(t)
+        return t
+
     def _watchdog(self):
         """reference server.go:900 FlushWatchdog: crash-only restart if
         flushes stall for N intervals. Two stall modes now that flush runs
@@ -828,8 +881,15 @@ class Server:
                     "aborting", missed)
                 os._exit(3)
 
-    def shutdown(self):
-        """reference server.go:1418 Shutdown (graceful)."""
+    def shutdown(self, device_timeout: float = 180.0):
+        """reference server.go:1418 Shutdown (graceful).
+
+        The joins on device-owning threads (pipeline, flush worker) use a
+        generous budget: on a real TPU the first compile of the swap/flush
+        program can take tens of seconds, and abandoning a thread inside a
+        JAX dispatch at interpreter teardown aborts the process
+        (`FATAL: exception not rethrown`, rc 134 — the round-2 bench
+        failure). Shutdown must leave NO thread inside the JAX runtime."""
         self._shutdown.set()
         for s in self._sockets:
             try:
@@ -842,6 +902,9 @@ class Server:
             path = "/tmp/veneur_tpu_profile.pstats"
             prof.dump_stats(path)
             log.info("CPU profile written to %s", path)
+        # stop the feeders of packet_queue before _STOP so nothing enqueues
+        # behind the sentinel: span pipeline (extraction loop-back), HTTP
+        # /import, gRPC import
         self.trace_client.close()
         self.span_pipeline.stop()
         if self._httpd is not None:
@@ -849,14 +912,48 @@ class Server:
             self._httpd.server_close()  # release the listening fd
         if self._grpc_server is not None:
             self._grpc_server.stop(grace=1.0)
-        if self._forward_client is not None:
-            self._forward_client.close()
         self.packet_queue.put(_STOP)
         # drain order matters: the pipeline thread may still enqueue a final
         # flush job; only after it exits is it safe to stop the flush worker
         # (a _STOP racing ahead of that job would strand the last interval)
         if self._pipeline_thread is not None:
-            self._pipeline_thread.join(timeout=5.0)
-        self._flush_jobs.put(_STOP)
+            self._pipeline_thread.join(timeout=device_timeout)
+            if self._pipeline_thread.is_alive():
+                log.error("pipeline thread did not exit within %.0fs",
+                          device_timeout)
+        # bounded put: with a full queue AND a wedged worker, a blocking
+        # put would hang shutdown forever (the watchdog is already
+        # disarmed); drop one stale job to make room instead
+        while True:
+            try:
+                self._flush_jobs.put_nowait(_STOP)
+                break
+            except queue.Full:
+                try:
+                    stale = self._flush_jobs.get_nowait()
+                    if stale is not _STOP:
+                        stale[-1].finish(False, "dropped at shutdown")
+                except queue.Empty:
+                    pass
+        if self._flush_thread is not None:
+            self._flush_thread.join(timeout=device_timeout)
+            if self._flush_thread.is_alive():
+                log.error("flush worker did not exit within %.0fs",
+                          device_timeout)
+        with self._aux_lock:
+            aux = list(self._aux_threads)
+        for t in aux:
+            t.join(timeout=30.0)
+        # forward client closes only after the aux forward threads using it
+        # have drained
+        if self._forward_client is not None:
+            self._forward_client.close()
         for t in self._threads:
             t.join(timeout=2.0)
+        # quiesce the device runtime: any computation the joined threads
+        # dispatched asynchronously must complete before teardown
+        try:
+            import jax
+            jax.block_until_ready(self.aggregator.state)
+        except Exception:
+            pass
