@@ -62,6 +62,101 @@ def test_sharded_flush_resets(sharded_server):
                 if not x.name.startswith("veneur.")]
 
 
+def test_native_sharded_backend_selected_and_parity():
+    """native_ingest + tpu_n_shards > 1 must compose (C++ staging feeding
+    the mesh backend), and its results must match the Python-staged
+    sharded backend exactly for counters/gauges and within sketch error
+    for timers/sets."""
+    from veneur_tpu import native
+    if not native.available():
+        pytest.skip("native engine not built")
+    from veneur_tpu.server.native_aggregator import NativeShardedAggregator
+
+    rng = np.random.default_rng(7)
+    vals = rng.uniform(1, 100, 64)
+    lines = ([b"ns.count.%d:2|c" % i for i in range(20)]
+             + [f"ns.timer:{v:.3f}|ms".encode() for v in vals]
+             + [b"ns.set:u%d|s" % i for i in range(32)]
+             + [b"ns.gauge:5.5|g"])
+
+    results = {}
+    for native_on in (False, True):
+        sink = DebugMetricSink()
+        srv = Server(sharded_config(native_ingest=native_on),
+                     metric_sinks=[sink])
+        if native_on:
+            assert isinstance(srv.aggregator, NativeShardedAggregator)
+        else:
+            assert not isinstance(srv.aggregator, NativeShardedAggregator)
+        srv.start()
+        try:
+            _send_udp(srv.local_addr(), lines[:60])
+            _send_udp(srv.local_addr(), lines[60:])
+            _wait_processed(srv, len(lines))
+            assert srv.trigger_flush()
+            results[native_on] = by_name(sink.flushed)
+        finally:
+            srv.shutdown()
+
+    py, nat = results[False], results[True]
+    for i in range(20):
+        assert nat[f"ns.count.{i}"].value == py[f"ns.count.{i}"].value == 2.0
+    assert nat["ns.gauge"].value == py["ns.gauge"].value == 5.5
+    assert nat["ns.timer.count"].value == py["ns.timer.count"].value == 64.0
+    assert nat["ns.set"].value == py["ns.set"].value
+    for q in ("50percentile", "99percentile"):
+        assert nat[f"ns.timer.{q}"].value == pytest.approx(
+            py[f"ns.timer.{q}"].value, rel=1e-6)
+
+
+def test_native_sharded_python_paths():
+    """Samples that bypass the C++ wire path — service checks and gRPC
+    imports — must land through ShardedAggregator's process/import
+    methods (regression: _local() used to read .tables off the
+    NativeKeyTable and raise AttributeError)."""
+    from veneur_tpu import native
+    if not native.available():
+        pytest.skip("native engine not built")
+    from veneur_tpu.server.native_aggregator import NativeShardedAggregator
+
+    gsink = DebugMetricSink()
+    glob = Server(sharded_config(native_ingest=True,
+                                 grpc_address="127.0.0.1:0"),
+                  metric_sinks=[gsink])
+    assert isinstance(glob.aggregator, NativeShardedAggregator)
+    glob.start()
+    local = Server(small_config(
+        forward_address=f"127.0.0.1:{glob.grpc_port}"),
+        metric_sinks=[DebugMetricSink()])
+    local.start()
+    try:
+        # service check rides the Python parser path into the native
+        # sharded backend's status table
+        _send_udp(glob.local_addr(),
+                  [b"_sc|nsp.check|1|m:all good"])
+        _wait_processed(glob, 1)
+
+        # imports: counter + timer sketches forwarded from a plain local
+        vals = list(range(1, 41))
+        _send_udp(local.local_addr(),
+                  [b"nsp.count:7|c|#veneurglobalonly"]
+                  + [f"nsp.timer:{v}|ms".encode() for v in vals])
+        _wait_processed(local, 41)
+        assert local.trigger_flush()
+        deadline = time.time() + 10
+        while time.time() < deadline and glob.aggregator.processed < 3:
+            time.sleep(0.05)
+        assert glob.trigger_flush()
+        g = by_name(gsink.flushed)
+        assert g["nsp.check"].value == 1.0
+        assert g["nsp.count"].value == 7.0
+        p50 = g["nsp.timer.50percentile"].value
+        assert abs(p50 - np.percentile(vals, 50)) / 40.0 < 0.05
+    finally:
+        local.shutdown()
+        glob.shutdown()
+
+
 def test_sharded_local_forwards_to_single_device_global():
     """sharded local tier -> plain global over gRPC: raw export from the
     sharded state serializes identically."""

@@ -242,6 +242,22 @@ class NativeShardedAggregator(ShardedAggregator):
     dropped_capacity = NativeAggregator.dropped_capacity
     feed = NativeAggregator.feed
 
+    _PER_SHARD_FIELD = {"counter": "counter_capacity",
+                        "gauge": "gauge_capacity",
+                        "status": "status_capacity",
+                        "set": "set_capacity",
+                        "histo": "histo_capacity"}
+
+    def _local(self, kind: str, slot: int):
+        """global slot -> (shard, local). ShardedAggregator reads per-shard
+        widths off its Python KeyTable; here the table is a NativeKeyTable
+        (no .tables), but the widths are statically the per-shard spec's
+        capacities — the C++ engine allocates with the identical
+        shard*per_shard+local rule (dogstatsd.cpp KindTable)."""
+        per = getattr(self.pspec,
+                      self._PER_SHARD_FIELD[KeyTable._table_name(kind)])
+        return slot // per, slot % per
+
     def _emit_native(self):
         spec = self.spec
         self._c_slot.fill(spec.counter_capacity)

@@ -137,11 +137,18 @@ class Server:
             if len(devices) > 1 and devices[0].platform != "cpu":
                 n_shards = len(devices)
         if n_shards > 1:
-            # device scale-out: sharded mesh backend (parallel/sharded.py)
-            from veneur_tpu.server.sharded_aggregator import (
-                ShardedAggregator)
+            # device scale-out: sharded mesh backend (parallel/sharded.py);
+            # C++ staging composes with the mesh when native_ingest is on
             agg_args["n_shards"] = n_shards
-            self.aggregator = ShardedAggregator(**agg_args)
+            if cfg.native_ingest and _native_available():
+                from veneur_tpu.server.native_aggregator import (
+                    NativeShardedAggregator)
+                self.aggregator = NativeShardedAggregator(**agg_args)
+                self._native = True
+            else:
+                from veneur_tpu.server.sharded_aggregator import (
+                    ShardedAggregator)
+                self.aggregator = ShardedAggregator(**agg_args)
         elif cfg.native_ingest and _native_available():
             from veneur_tpu.server.native_aggregator import NativeAggregator
             self.aggregator = NativeAggregator(**agg_args)
