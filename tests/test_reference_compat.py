@@ -1,0 +1,262 @@
+"""Parity against reference-GENERATED fixtures (SURVEY §4 rung 1.5).
+
+Every byte under tests/testdata/ was produced by the Go reference itself
+(checked in at /root/reference/testdata and tdigest/testdata), so these
+tests catch a misreading of the Go source that self-built fixtures would
+reproduce: the gob digest wire format (merging_digest.go:393 GobEncode,
+exercised via tdigest/testdata/oldgob.base64 with the exact expectations
+of tdigest/histo_test.go:139-157 TestGobDecodeOldGob), the HTTP /import
+JSON+gob body (testdata/import.uncompressed, http_test.go:126-136), and
+SSF protobuf wire compatibility back to 2017 payloads
+(testdata/protobuf/*, regression_test.go:89 TestOperation).
+"""
+
+import base64
+import gzip
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+import zlib
+
+import numpy as np
+import pytest
+
+TESTDATA = os.path.join(os.path.dirname(__file__), "testdata")
+
+
+def fixture(*parts) -> bytes:
+    with open(os.path.join(TESTDATA, *parts), "rb") as f:
+        return f.read()
+
+
+def centroid_quantile(means, weights, q):
+    """Midpoint-mass quantile over centroids (merging_digest.go:302)."""
+    order = np.argsort(means)
+    m, w = np.asarray(means)[order], np.asarray(weights)[order]
+    total = w.sum()
+    cum = np.cumsum(w) - w / 2.0
+    return float(np.interp(q * total, cum, m))
+
+
+# -- gob digest ---------------------------------------------------------------
+
+def test_oldgob_fixture_decodes_with_reference_expectations():
+    """tdigest/histo_test.go:149-156: count 1000, min ~0, max ~1000,
+    q50 ~500 (2%), Sum exactly 499500, ReciprocalSum exactly 0."""
+    from veneur_tpu.forward import gob
+    data = base64.b64decode(fixture("oldgob.base64"))
+    d = gob.decode_digest(data)
+    w = np.asarray(d["weights"])
+    m = np.asarray(d["means"])
+    assert w.sum() == pytest.approx(1000, rel=0.02)
+    assert abs(d["min"] - 0.01) < 0.02
+    assert d["max"] == pytest.approx(1000, rel=0.02)
+    assert float((m * w).sum()) == 499500.0
+    assert d["recip"] == 0.0
+    assert d["compression"] == 1000.0
+    assert centroid_quantile(m, w, 0.5) == pytest.approx(500, rel=0.02)
+
+
+def test_gob_encoder_is_byte_identical_to_reference():
+    """Re-encoding the decoded oldgob digest must reproduce the Go
+    encoder's bytes exactly — type definitions, framing, centroid values
+    — plus the trailing reciprocalSum message newer reference versions
+    append (merging_digest.go:410; the fixture predates it and the
+    decode path is EOF-tolerant, :433)."""
+    from veneur_tpu.forward import gob
+    data = base64.b64decode(fixture("oldgob.base64"))
+    d = gob.decode_digest(data)
+    enc = gob.encode_digest(d["means"], d["weights"], d["compression"],
+                            d["min"], d["max"], d["recip"])
+    assert enc[:len(data)] == data
+    # the tail is exactly one float message: reciprocalSum == 0.0
+    assert gob.Decoder(enc[len(data):]).decode_all() == [0.0]
+    # and the full stream round-trips
+    assert gob.decode_digest(enc) == d
+
+
+def test_gob_digest_truncation_is_loud():
+    from veneur_tpu.forward import gob
+    data = base64.b64decode(fixture("oldgob.base64"))
+    for cut in (1, 5, 40, len(data) // 2):
+        with pytest.raises(gob.GobError):
+            gob.decode_digest(data[:cut])
+
+
+def test_import_fixture_value_decodes():
+    """http_test.go's import body: one histogram 'a.b.c' whose digest the
+    reference encoded — exact centroid recovery."""
+    from veneur_tpu.forward import gob
+    jms = json.loads(fixture("import.uncompressed"))
+    assert jms[0]["name"] == "a.b.c" and jms[0]["type"] == "histogram"
+    d = gob.decode_digest(base64.b64decode(jms[0]["value"]))
+    assert d["means"] == [1.0, 2.0, 7.0, 8.0, 100.0]
+    assert d["weights"] == [1.0] * 5
+    assert d["compression"] == 100.0
+    assert d["min"] == 1.0 and d["max"] == 100.0
+
+
+def test_deflate_fixture_matches_uncompressed():
+    assert (zlib.decompress(fixture("import.deflate"))
+            == fixture("import.uncompressed"))
+
+
+# -- HTTP /import with the reference body -------------------------------------
+
+def _post(url, body, encoding=None):
+    headers = {"Content-Type": "application/json"}
+    if encoding is not None:
+        headers["Content-Encoding"] = encoding
+    req = urllib.request.Request(url, data=body, method="POST",
+                                 headers=headers)
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status
+    except urllib.error.HTTPError as e:
+        return e.code
+
+
+@pytest.fixture(scope="module")
+def http_server():
+    from tests.test_server import small_config
+    from veneur_tpu.server.server import Server
+    from veneur_tpu.sinks.debug import DebugMetricSink
+    sink = DebugMetricSink()
+    srv = Server(small_config(http_address="127.0.0.1:0"),
+                 metric_sinks=[sink])
+    srv.start()
+    yield srv, sink
+    srv.shutdown()
+
+
+def test_http_import_reference_body_end_to_end(http_server):
+    """A reference local's exact flushForward body lands in this global's
+    flush output (http_test.go:126-136 expects 202)."""
+    srv, sink = http_server
+    sink.flushed.clear()
+    url = f"http://127.0.0.1:{srv.http_port}/import"
+    assert _post(url, fixture("import.uncompressed")) == 202
+    assert _post(url, fixture("import.deflate"), "deflate") == 202
+    deadline = time.time() + 10
+    while time.time() < deadline and srv.aggregator.processed < 2:
+        time.sleep(0.05)
+    assert srv.trigger_flush()
+    by_name = {m.name: m.value for m in sink.flushed}
+    # two identical digests merged: count 10, p50 by midpoint convention
+    assert by_name["a.b.c.50percentile"] == pytest.approx(7.0, rel=0.1)
+    assert by_name["a.b.c.99percentile"] == pytest.approx(100.0, rel=0.01)
+
+
+def test_http_import_status_codes(http_server):
+    """Reference error semantics: gzip → 415 (http_test.go:138-164),
+    mislabeled deflate → 400 (:166-189), garbage JSON → 400, empty list
+    → 400 (handlers_global.go:167-173)."""
+    srv, _ = http_server
+    url = f"http://127.0.0.1:{srv.http_port}/import"
+    body = fixture("import.uncompressed")
+    assert _post(url, gzip.compress(body), "gzip") == 415
+    assert _post(url, body, "deflate") == 400
+    assert _post(url, b"[{nope", None) == 400
+    assert _post(url, b"[]", None) == 400
+    assert _post(url, b"[{}]", None) == 400
+
+
+def test_http_import_tolerates_leading_whitespace(http_server):
+    """Go's json.NewDecoder skips leading whitespace; the body sniff
+    must too (handlers_global.go:160)."""
+    srv, _ = http_server
+    url = f"http://127.0.0.1:{srv.http_port}/import"
+    assert _post(url, b"\n  " + fixture("import.uncompressed")) == 202
+
+
+def test_http_forward_json_gob_sketches_end_to_end():
+    """Our local HTTP-forwards the reference JSON+gob body (default
+    HTTPForwardClient): digests and HLLs must survive the gob/axiomhq
+    round-trip into a global and flush correct percentiles/estimates."""
+    from tests.test_server import (
+        by_name, small_config, _send_udp, _wait_processed)
+    from veneur_tpu.server.server import Server
+    from veneur_tpu.sinks.debug import DebugMetricSink
+
+    gsink = DebugMetricSink()
+    glob = Server(small_config(http_address="127.0.0.1:0"),
+                  metric_sinks=[gsink])
+    glob.start()
+    local = Server(small_config(
+        forward_address=f"http://127.0.0.1:{glob.http_port}"),
+        metric_sinks=[DebugMetricSink()])
+    local.start()
+    try:
+        vals = list(range(1, 101))
+        _send_udp(local.local_addr(),
+                  [f"jg.timer:{v}|ms".encode() for v in vals[:50]])
+        _send_udp(local.local_addr(),
+                  [f"jg.timer:{v}|ms".encode() for v in vals[50:]]
+                  + [b"jg.set:u%d|s" % i for i in range(40)]
+                  + [b"jg.count:9|c|#veneurglobalonly"])
+        _wait_processed(local, 141)
+        assert local.trigger_flush()
+        deadline = time.time() + 10
+        while time.time() < deadline and glob.aggregator.processed < 3:
+            time.sleep(0.05)
+        assert glob.trigger_flush()
+        g = by_name(gsink.flushed)
+        assert g["jg.count"].value == 9.0
+        assert g["jg.set"].value == pytest.approx(40, rel=0.1)
+        assert g["jg.timer.50percentile"].value == pytest.approx(
+            np.percentile(vals, 50), rel=0.05)
+        assert g["jg.timer.99percentile"].value == pytest.approx(
+            np.percentile(vals, 99), rel=0.05)
+    finally:
+        local.shutdown()
+        glob.shutdown()
+
+
+# -- SSF protobuf wire compatibility ------------------------------------------
+
+def test_span_with_operation_2017_fixture():
+    """regression_test.go:89 TestOperation: a June-2017 wire payload —
+    carrying the long-removed `operation` field 9 — must still parse
+    without error; surviving fields are stable and the unknown field is
+    ignored (the reference asserts parseability, not content)."""
+    from veneur_tpu.protocol.wire import parse_ssf
+    span = parse_ssf(fixture("protobuf", "span-with-operation-062017.pb"))
+    assert span.service == "testService"
+    assert dict(span.tags) == {"tag1": "value1"}
+    assert span.trace_id == 1 and span.id == 1
+    # field 9 was `operation` in 2017 and is dropped by the modern schema
+    assert span.name == ""
+
+
+def test_trace_fixtures_parse_and_match_sidecar_json():
+    """testdata/protobuf/trace*.pb with their recorded JSON translations
+    (server_sinks_test.go:28-40): ids and names must agree."""
+    from veneur_tpu.protocol.wire import parse_ssf
+    for name in ("trace", "trace_critical"):
+        span = parse_ssf(fixture("protobuf", f"{name}.pb"))
+        sidecar = json.loads(fixture("tracing_agent", f"{name}.pb.json"))
+        expected = sidecar[0][0]
+        assert span.trace_id == expected["trace_id"]
+        assert span.id == expected["span_id"]
+        assert span.parent_id == expected.get("parent_id", 0)
+        assert span.name == expected["name"]
+
+
+def test_name_tag_promotion_matches_regression_test():
+    """regression_test.go:26-44: tag 'name' promotes to span.name only
+    when name is unset, and is deleted afterwards."""
+    from veneur_tpu.proto import ssf_pb2
+    from veneur_tpu.protocol.wire import parse_ssf
+    s = ssf_pb2.SSFSpan(trace_id=1, id=1, start_timestamp=1,
+                        end_timestamp=10)
+    s.tags["name"] = "testName"
+    parsed = parse_ssf(s.SerializeToString())
+    assert parsed.name == "testName"
+    assert "name" not in parsed.tags
+
+    s.name = "realName"
+    parsed = parse_ssf(s.SerializeToString())
+    assert parsed.name == "realName"
+    assert parsed.tags["name"] == "testName"

@@ -1,0 +1,4 @@
+¦ç¬àĞÃ°ü(öÀÜ•¼à°İ[ †ãµ¨ßÃ§Ä
+(›¬Ãá‰‘ã0šÿ®Ãá‰‘ãZ*
+name"veneur.(*Server).flushEventsChecksZ
+resourceflush

@@ -1,0 +1,10 @@
+¯ŸþÆ½Žã°{¯ŸþÆ½Žã°{(ç…ø °© ã0ØŒø °© ã8Bveneur-testZ"
+
+error.typetype error interfaceZ#
+error.stackinsert
+lots
+of
+stuffZ*
+resourceRobert'); DROP TABLE students;Z
+nameveneur.trace.testZ
+	error.msgan error occurred!

@@ -41,26 +41,35 @@ class ForwardClient:
 
 class HTTPForwardClient:
     """HTTP-era forwarding (reference flusher.go:338 flushForward →
-    POST /import): the same MetricList protobuf body the gRPC path carries,
-    zlib-deflated, to the peer's /import endpoint (httpapi.py). The
-    reference's JSON+gob body is Go-specific; the protobuf body is this
-    framework's portable equivalent."""
+    POST /import): a zlib-deflated JSON array of JSONMetric objects whose
+    value bytes are the reference's own sampler encodings (gob digests,
+    LE scalars, axiomhq HLLs — veneur_tpu/forward/{jsonmetric,gob}.py),
+    so the peer may be a reference global or this framework's. Pass
+    json_body=False for the deflated-protobuf MetricList body instead
+    (this framework's compact v2-over-HTTP variant)."""
 
-    def __init__(self, address: str):
+    def __init__(self, address: str, json_body: bool = True):
         self.address = address.rstrip("/")
+        self.json_body = json_body
         if not self.address.startswith(("http://", "https://")):
             self.address = "http://" + self.address
 
     def send_metrics(self, metrics: List, timeout: float = 10.0) -> None:
+        import json
         import urllib.request
         import zlib
 
-        body = zlib.compress(
-            fpb.MetricList(metrics=metrics).SerializeToString())
+        if self.json_body:
+            from veneur_tpu.forward.jsonmetric import to_json_metrics
+            body = json.dumps(to_json_metrics(metrics)).encode()
+            ctype = "application/json"
+        else:
+            body = fpb.MetricList(metrics=metrics).SerializeToString()
+            ctype = "application/x-protobuf"
         req = urllib.request.Request(
-            f"{self.address}/import", data=body, method="POST",
-            headers={"Content-Type": "application/x-protobuf",
-                     "Content-Encoding": "deflate"})
+            f"{self.address}/import", data=zlib.compress(body),
+            method="POST",
+            headers={"Content-Type": ctype, "Content-Encoding": "deflate"})
         with urllib.request.urlopen(req, timeout=timeout) as resp:
             resp.read()
 

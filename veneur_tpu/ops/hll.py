@@ -173,6 +173,8 @@ def _deserialize_axiomhq(data: bytes):
         # exactly the sketch's own toNormal() conversion
         regs = np.zeros(m, np.uint8)
         (tssz,) = _be32(data, 4)
+        if 8 + 4 * tssz + 12 > len(data):
+            raise ValueError("truncated HLL sparse payload (tmpSet)")
         off = 8
         keys = []
         for _ in range(tssz):
@@ -181,6 +183,8 @@ def _deserialize_axiomhq(data: bytes):
         off += 8  # compressedList count + last (we re-derive from deltas)
         (sz,) = _be32(data, off)
         off += 4
+        if off + sz > len(data):
+            raise ValueError("truncated HLL sparse payload (list)")
         buf = data[off:off + sz]
         i, last = 0, 0
         while i < len(buf):
@@ -188,6 +192,8 @@ def _deserialize_axiomhq(data: bytes):
             while buf[j] & 0x80:
                 x |= (buf[j] & 0x7F) << ((j - i) * 7)
                 j += 1
+                if j >= len(buf):
+                    raise ValueError("truncated HLL sparse varint")
             x |= buf[j] << ((j - i) * 7)
             last += x
             keys.append(last)

@@ -3,11 +3,18 @@
 Endpoints: GET /healthcheck, GET /version, GET /builddate, POST /import,
 optional POST/GET /quitquitquit (gated on http_quit, server.go:80).
 
-/import accepts a protobuf forwardrpc.MetricList body (optionally
-zlib-deflated, matching the reference's deflate support,
-handlers_global.go:134-146). The reference's HTTP-era JSON+gob payload is
-Go-specific (encoding/gob) and is not portable; the protobuf body carries
-identical information through the same import path as gRPC.
+/import accepts BOTH body formats, optionally zlib-deflated
+(handlers_global.go:134-146):
+
+  - the reference's JSON array of JSONMetric with gob/LE/axiomhq value
+    bytes (handlers_global.go:115 unmarshalMetricsFromHTTP; decoded by
+    veneur_tpu/forward/{jsonmetric,gob}.py) — a reference *local* veneur
+    can HTTP-forward straight into this global;
+  - a protobuf forwardrpc.MetricList (this framework's compact variant,
+    same information as the gRPC path).
+
+Status codes mirror the reference: 202 on success, 400 for bad
+deflate/JSON/empty bodies, 415 for unknown Content-Encoding.
 """
 
 from __future__ import annotations
@@ -67,24 +74,67 @@ def start_http_server(server, address) -> "http.server.ThreadingHTTPServer":
             if self.path == "/import":
                 length = int(self.headers.get("Content-Length", "0"))
                 body = self.rfile.read(length)
-                if self.headers.get("Content-Encoding") == "deflate":
+                encoding = self.headers.get("Content-Encoding", "")
+                if encoding == "deflate":
                     try:
                         body = zlib.decompress(body)
                     except zlib.error:
                         self._reply(400, b"bad deflate body")
                         return
-                from veneur_tpu.proto import forwardrpc_pb2 as fpb
-                try:
-                    mlist = fpb.MetricList.FromString(body)
-                except Exception:
-                    self._reply(400, b"bad MetricList protobuf")
+                elif encoding not in ("", "identity"):
+                    # reference: unknown encodings are 415
+                    # (handlers_global.go:150-156)
+                    self._reply(415, encoding.encode())
                     return
-                server.import_metrics(list(mlist.metrics))
-                self._reply(200, b"imported")
+                # json.NewDecoder skips leading whitespace
+                # (handlers_global.go:160) — sniff past it
+                if body.lstrip()[:1] == b"[":
+                    self._import_json(body)
+                else:
+                    self._import_protobuf(body)
             elif self.path == "/quitquitquit" and server.cfg.http_quit:
                 self._quit()
             else:
                 self._reply(404, b"not found")
+
+        def _import_json(self, body: bytes) -> None:
+            """Reference JSONMetric array (handlers_global.go:115)."""
+            from veneur_tpu.forward.jsonmetric import from_json_metric
+            try:
+                jms = json.loads(body)
+            except ValueError:
+                self._reply(400, b"bad JSON body")
+                return
+            if not isinstance(jms, list) or not jms:
+                self._reply(400, b"Received empty /import request")
+                return
+            metrics = []
+            for jm in jms:
+                try:
+                    metrics.append(from_json_metric(jm))
+                except Exception as e:
+                    server.import_errors += 1
+                    log.warning("bad JSONMetric %s: %s",
+                                jm.get("name") if isinstance(jm, dict)
+                                else jm, e)
+            if not metrics:
+                # all-empty/improper: the reference 400s
+                # (handlers_global.go:176-186 nonEmpty)
+                self._reply(400, b"Received empty or improperly-formed "
+                                 b"metrics")
+                return
+            server.import_metrics(metrics)
+            self._reply(202, b"imported")
+
+        def _import_protobuf(self, body: bytes) -> None:
+            from veneur_tpu.proto import forwardrpc_pb2 as fpb
+            try:
+                mlist = fpb.MetricList.FromString(body)
+            except Exception:
+                self._reply(400, b"bad MetricList protobuf")
+                return
+            server.import_metrics(list(mlist.metrics))
+            self._reply(202, b"imported")
 
         def _quit(self):
             self._reply(200, b"bye")
