@@ -1,0 +1,90 @@
+"""Opt-in REAL-DEVICE smoke test (VENEUR_TPU_DEVICE_TESTS=1).
+
+The rest of the suite pins JAX_PLATFORMS=cpu (conftest.py), which is the
+right CI stance but means compile-latency and thread/teardown behavior on
+the actual accelerator is never exercised by tests — exactly the class of
+breakage that sank round 2's bench (first flush compile > silent wait;
+abort at interpreter teardown). This test runs the full server cycle —
+start → UDP ingest → manual flush → sink assert → clean shutdown → exit
+code 0 — in a SUBPROCESS with the platform pin removed, so the session's
+real device (TPU via the axon tunnel here; any default JAX platform
+elsewhere) takes the traffic.
+
+Run:  VENEUR_TPU_DEVICE_TESTS=1 python -m pytest tests/test_device_smoke.py -q
+Budget: first compile of ingest+swap+flush can take minutes cold.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("VENEUR_TPU_DEVICE_TESTS") != "1",
+    reason="set VENEUR_TPU_DEVICE_TESTS=1 to run against the real device")
+
+_SCRIPT = r"""
+import json, socket, sys, time
+
+sys.path.insert(0, "@REPO@")
+import jax
+dev = jax.devices()[0]
+
+from veneur_tpu.config import Config
+from veneur_tpu.server.server import Server
+from veneur_tpu.sinks.debug import DebugMetricSink
+
+sink = DebugMetricSink()
+srv = Server(Config(
+    interval="600s", hostname="devsmoke",
+    statsd_listen_addresses=["udp://127.0.0.1:0"],
+    percentiles=[0.5, 0.99], aggregates=["min", "max", "count"],
+    tpu_counter_capacity=256, tpu_gauge_capacity=64,
+    tpu_status_capacity=16, tpu_set_capacity=16, tpu_histo_capacity=64,
+), metric_sinks=[sink])
+srv.start()
+
+sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+lines = ([b"smoke.count:3|c"] * 4
+         + [b"smoke.timer:%d|ms" % v for v in range(1, 21)]
+         + [b"smoke.gauge:7.5|g"])
+for ln in lines:
+    sock.sendto(ln, srv.local_addr())
+sock.close()
+
+deadline = time.time() + 120
+while time.time() < deadline and srv.aggregator.processed < len(lines):
+    time.sleep(0.05)
+assert srv.aggregator.processed >= len(lines), (
+    f"ingest stalled: {srv.aggregator.processed}/{len(lines)}")
+
+# first flush compiles the swap+flush programs on the real device
+ok = srv.trigger_flush(timeout=600.0)
+assert ok, "flush did not complete on the device"
+
+m = {x.name: x.value for x in sink.flushed}
+assert m["smoke.count"] == 12.0, m.get("smoke.count")
+assert m["smoke.gauge"] == 7.5
+assert m["smoke.timer.count"] == 20.0
+assert abs(m["smoke.timer.50percentile"] - 10.5) <= 1.0
+
+# an in-flight flush must not break teardown (round-2 rc 134 regression)
+req = srv.trigger_flush(wait=False)
+srv.shutdown()
+print(json.dumps({"platform": dev.platform,
+                  "flushed": len(m), "ok": True}))
+"""
+
+
+def test_device_server_cycle():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    proc = subprocess.run(
+        [sys.executable, "-c", _SCRIPT.replace("@REPO@", repo)],
+        capture_output=True, text=True, timeout=900, env=env)
+    assert proc.returncode == 0, (
+        f"device smoke failed rc={proc.returncode}\n"
+        f"stdout: {proc.stdout[-2000:]}\nstderr: {proc.stderr[-2000:]}")
+    assert '"ok": true' in proc.stdout

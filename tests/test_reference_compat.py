@@ -163,6 +163,29 @@ def test_http_import_status_codes(http_server):
     assert _post(url, b"[{}]", None) == 400
 
 
+def test_http_import_rejects_empty_body_and_routes_on_content_type(
+        http_server):
+    """Empty bodies are 400 (handlers_global.go:167-173); a protobuf body
+    that happens to start 0x0a 0x5b ('\\n[') must still reach the
+    protobuf parser when Content-Type says so."""
+    from veneur_tpu.proto import forwardrpc_pb2 as fpb
+    from veneur_tpu.proto import metricpb_pb2 as mpb
+    srv, _ = http_server
+    url = f"http://127.0.0.1:{srv.http_port}/import"
+    assert _post(url, b"", None) == 400
+    assert _post(url, b"  \n ", None) == 400
+    # first submessage exactly 0x5b bytes -> wire bytes b'\n[...'
+    m = mpb.Metric(name="x" * 83, type=mpb.Counter, scope=mpb.Global)
+    m.counter.value = 1
+    body = fpb.MetricList(metrics=[m]).SerializeToString()
+    assert body[:2] == b"\n["
+    req = urllib.request.Request(
+        url, data=body, method="POST",
+        headers={"Content-Type": "application/x-protobuf"})
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        assert resp.status == 202
+
+
 def test_http_import_tolerates_leading_whitespace(http_server):
     """Go's json.NewDecoder skips leading whitespace; the body sniff
     must too (handlers_global.go:160)."""

@@ -160,6 +160,31 @@ def test_local_mode_suppresses_percentiles_and_sets():
         srv.shutdown()
 
 
+def test_default_config_udp_listener_is_not_lossy():
+    """Regression: a directly-constructed Config leaves
+    read_buffer_size_bytes at 0 (the YAML path applies the 2MiB default);
+    setsockopt(SO_RCVBUF, 0) clamps the kernel buffer to ~2KB and a burst
+    of a few dozen loopback datagrams silently drops all but 2-3. The
+    server must leave the kernel default alone when unconfigured."""
+    srv = Server(Config(statsd_listen_addresses=["udp://127.0.0.1:0"],
+                        interval="600s", hostname="t",
+                        tpu_counter_capacity=64, tpu_gauge_capacity=16,
+                        tpu_status_capacity=8, tpu_set_capacity=8,
+                        tpu_histo_capacity=16),
+                 metric_sinks=[DebugMetricSink()])
+    srv.start()
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        n = 200
+        for i in range(n):
+            s.sendto(b"burst.count:1|c", srv.local_addr())
+        s.close()
+        _wait_processed(srv, n)
+        assert srv.packets_received == n
+    finally:
+        srv.shutdown()
+
+
 def test_tcp_listener():
     sink = DebugMetricSink()
     srv = Server(small_config(

@@ -86,9 +86,20 @@ def start_http_server(server, address) -> "http.server.ThreadingHTTPServer":
                     # (handlers_global.go:150-156)
                     self._reply(415, encoding.encode())
                     return
-                # json.NewDecoder skips leading whitespace
-                # (handlers_global.go:160) — sniff past it
-                if body.lstrip()[:1] == b"[":
+                if not body.strip():
+                    self._reply(400, b"Received empty /import request")
+                    return
+                # route on the declared Content-Type; fall back to a
+                # body sniff (json.NewDecoder skips leading whitespace,
+                # handlers_global.go:160 — and a protobuf body can
+                # legitimately begin 0x0a 0x5b, which lstrip+'[' would
+                # misread as JSON)
+                ctype = self.headers.get("Content-Type", "")
+                if "json" in ctype:
+                    self._import_json(body)
+                elif "protobuf" in ctype:
+                    self._import_protobuf(body)
+                elif body.lstrip()[:1] == b"[":
                     self._import_json(body)
                 else:
                     self._import_protobuf(body)

@@ -544,8 +544,14 @@ class Server:
                             socket, "SO_REUSEPORT"):
                         sock.setsockopt(socket.SOL_SOCKET,
                                         socket.SO_REUSEPORT, 1)
-                    sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF,
-                                    self.cfg.read_buffer_size_bytes)
+                    if self.cfg.read_buffer_size_bytes > 0:
+                        sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF,
+                                        self.cfg.read_buffer_size_bytes)
+                    # else: keep the kernel default — SO_RCVBUF=0 clamps
+                    # to the ~2KB minimum and a loopback burst of a few
+                    # dozen datagrams already overruns it (read_config
+                    # applies the 2MiB default; direct Config() users
+                    # must not get a lossy listener)
                     sock.bind(target)
                     self._sockets.append(sock)
                     rt = threading.Thread(target=self._udp_reader,
