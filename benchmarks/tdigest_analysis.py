@@ -1,0 +1,123 @@
+"""Offline t-digest accuracy sweep (reference tdigest/analysis/main.go).
+
+Sweeps distribution × compression × batch-size, measuring quantile error
+vs the exact sample CDF and the centroid-count/size envelope, and writes
+one CSV (plus a JSON summary to stdout). The reference harness does the
+same for the Go MergingDigest — this is the parity instrument for the
+fixed-shape k-cell device digest (veneur_tpu/ops/tdigest.py), answering:
+how does error move with compression, distribution shape, and how many
+uncompacted batches the production cadence lets accumulate?
+
+Run:  python -m benchmarks.tdigest_analysis [--out digest_sweep.csv]
+                                            [--samples N] [--seed S]
+CPU-friendly (JAX_PLATFORMS=cpu works; shapes are small).
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import json
+import sys
+
+import numpy as np
+
+QUANTILES = [0.01, 0.1, 0.25, 0.5, 0.75, 0.9, 0.99, 0.999]
+COMPRESSIONS = [50.0, 100.0, 200.0, 500.0]
+
+
+def distributions(rng, n):
+    """The reference sweep's shapes (analysis/main.go): smooth, heavy
+    tail, discrete-ish clusters, adversarial order."""
+    return {
+        "uniform": rng.uniform(0.0, 1.0, n),
+        "normal": rng.normal(100.0, 15.0, n),
+        "lognormal": rng.lognormal(3.0, 0.9, n),
+        "exponential": rng.exponential(10.0, n),
+        "bimodal": np.concatenate([rng.normal(10, 1, n // 2),
+                                   rng.normal(100, 5, n - n // 2)]),
+        "sorted_asc": np.sort(rng.lognormal(3.0, 0.9, n)),
+    }
+
+
+def midpoint_quantile(sorted_vals, q):
+    n = len(sorted_vals)
+    mids = np.arange(n) + 0.5
+    xs = np.concatenate([[0.0], mids, [float(n)]])
+    ys = np.concatenate([[sorted_vals[0]], sorted_vals, [sorted_vals[-1]]])
+    return float(np.interp(q * n, xs, ys))
+
+
+def sweep(samples=50_000, seed=0, batch=1024):
+    from veneur_tpu.ops import tdigest
+
+    rng = np.random.default_rng(seed)
+    rows = []
+    for dist_name, vals in distributions(rng, samples).items():
+        vals = vals.astype(np.float32)
+        spread = float(np.percentile(vals, 99.5)) or 1.0
+        for compression in COMPRESSIONS:
+            t = tdigest.empty_table((), compression=compression)
+            for i in range(0, len(vals), batch):
+                chunk = vals[i:i + batch]
+                pad = batch - len(chunk)
+                t = tdigest.add_batch_single(
+                    t, np.pad(chunk, (0, pad)),
+                    np.pad(np.ones(len(chunk), np.float32), (0, pad)),
+                    compression=compression)
+            qs = np.asarray(QUANTILES, np.float32)
+            got = np.asarray(tdigest.quantiles(t, qs))
+            sv = np.sort(vals.astype(np.float64))
+            live = int(np.sum(np.asarray(t.weight) > 0))
+            for q, g in zip(QUANTILES, got):
+                exact = midpoint_quantile(sv, q)
+                rows.append({
+                    "distribution": dist_name,
+                    "compression": compression,
+                    "samples": len(vals),
+                    "centroids": live,
+                    "q": q,
+                    "exact": round(exact, 6),
+                    "estimate": round(float(g), 6),
+                    # error normalized by the distribution spread: the
+                    # reference's CSVs report absolute + relative; rel
+                    # blows up near q→0 for distributions crossing 0
+                    "abs_err": round(abs(float(g) - exact), 6),
+                    "spread_err": round(abs(float(g) - exact) / spread, 6),
+                })
+    return rows
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="digest_sweep.csv")
+    ap.add_argument("--samples", type=int, default=50_000)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    rows = sweep(samples=args.samples, seed=args.seed)
+    with open(args.out, "w", newline="") as f:
+        w = csv.DictWriter(f, fieldnames=list(rows[0].keys()))
+        w.writeheader()
+        w.writerows(rows)
+
+    # summary: worst + mean p99-family error per compression
+    summary = {}
+    for c in COMPRESSIONS:
+        tail = [r["spread_err"] for r in rows
+                if r["compression"] == c and r["q"] >= 0.99]
+        mid = [r["spread_err"] for r in rows
+               if r["compression"] == c and r["q"] == 0.5]
+        summary[str(int(c))] = {
+            "p99_spread_err_mean": round(float(np.mean(tail)), 6),
+            "p99_spread_err_max": round(float(np.max(tail)), 6),
+            "p50_spread_err_mean": round(float(np.mean(mid)), 6),
+        }
+    print(json.dumps({"rows": len(rows), "csv": args.out,
+                      "by_compression": summary}))
+    return summary
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, __file__.rsplit("/", 2)[0])
+    main()

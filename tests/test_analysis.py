@@ -1,0 +1,31 @@
+"""The offline digest sweep harness stays runnable and within budget
+(reference tdigest/analysis/main.go parity instrument)."""
+
+import numpy as np
+
+
+def test_digest_sweep_p99_budget(tmp_path):
+    from benchmarks.tdigest_analysis import sweep
+
+    rows = sweep(samples=8000, seed=1)
+    assert rows, "sweep produced no rows"
+    # production compression (samplers.go:502): q=0.99 within 1% of
+    # spread on every distribution including adversarial sorted input
+    p99 = [r for r in rows if r["compression"] == 100.0 and r["q"] == 0.99]
+    assert len(p99) == 6
+    assert max(r["spread_err"] for r in p99) < 0.01
+    # centroid count respects the fixed-shape bound
+    from veneur_tpu.ops.tdigest import centroid_capacity
+    assert all(r["centroids"] <= centroid_capacity(r["compression"])
+               for r in rows)
+
+
+def test_digest_sweep_csv_output(tmp_path):
+    from benchmarks.tdigest_analysis import main
+
+    out = tmp_path / "sweep.csv"
+    summary = main(["--out", str(out), "--samples", "2000"])
+    assert out.exists()
+    assert "100" in summary
+    header = out.read_text().splitlines()[0]
+    assert header.startswith("distribution,compression")

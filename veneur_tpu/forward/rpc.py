@@ -32,7 +32,11 @@ class ForwardClient:
             request_serializer=fpb.MetricList.SerializeToString,
             response_deserializer=empty_pb2.Empty.FromString)
 
-    def send_metrics(self, metrics: List, timeout: float = 10.0) -> None:
+    def send_metrics(self, metrics: List, timeout: float = 10.0,
+                     parent_span=None) -> None:
+        # parent_span accepted for interface parity with the HTTP client;
+        # the reference's gRPC forward doesn't propagate trace headers
+        # either (flusher.go:474 forwardGRPC has no Inject)
         self._send(fpb.MetricList(metrics=metrics), timeout=timeout)
 
     def close(self):
@@ -54,7 +58,8 @@ class HTTPForwardClient:
         if not self.address.startswith(("http://", "https://")):
             self.address = "http://" + self.address
 
-    def send_metrics(self, metrics: List, timeout: float = 10.0) -> None:
+    def send_metrics(self, metrics: List, timeout: float = 10.0,
+                     parent_span=None) -> None:
         import json
         import urllib.request
         import zlib
@@ -66,10 +71,16 @@ class HTTPForwardClient:
         else:
             body = fpb.MetricList(metrics=metrics).SerializeToString()
             ctype = "application/x-protobuf"
+        headers = {"Content-Type": ctype, "Content-Encoding": "deflate"}
+        if parent_span is not None:
+            # propagate the caller's flush trace like the reference's
+            # instrumented PostHelper (http/http.go InjectRequest): the
+            # global's /import child spans join the local's flush tree
+            from veneur_tpu.trace.opentracing import GLOBAL_TRACER
+            GLOBAL_TRACER.inject_header(parent_span, headers)
         req = urllib.request.Request(
             f"{self.address}/import", data=zlib.compress(body),
-            method="POST",
-            headers={"Content-Type": ctype, "Content-Encoding": "deflate"})
+            method="POST", headers=headers)
         with urllib.request.urlopen(req, timeout=timeout) as resp:
             resp.read()
 

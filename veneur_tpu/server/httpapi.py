@@ -72,41 +72,58 @@ def start_http_server(server, address) -> "http.server.ThreadingHTTPServer":
 
         def do_POST(self):
             if self.path == "/import":
-                length = int(self.headers.get("Content-Length", "0"))
-                body = self.rfile.read(length)
-                encoding = self.headers.get("Content-Encoding", "")
-                if encoding == "deflate":
-                    try:
-                        body = zlib.decompress(body)
-                    except zlib.error:
-                        self._reply(400, b"bad deflate body")
-                        return
-                elif encoding not in ("", "identity"):
-                    # reference: unknown encodings are 415
-                    # (handlers_global.go:150-156)
-                    self._reply(415, encoding.encode())
-                    return
-                if not body.strip():
-                    self._reply(400, b"Received empty /import request")
-                    return
-                # route on the declared Content-Type; fall back to a
-                # body sniff (json.NewDecoder skips leading whitespace,
-                # handlers_global.go:160 — and a protobuf body can
-                # legitimately begin 0x0a 0x5b, which lstrip+'[' would
-                # misread as JSON)
-                ctype = self.headers.get("Content-Type", "")
-                if "json" in ctype:
-                    self._import_json(body)
-                elif "protobuf" in ctype:
-                    self._import_protobuf(body)
-                elif body.lstrip()[:1] == b"[":
-                    self._import_json(body)
-                else:
-                    self._import_protobuf(body)
+                # continue the forwarder's trace as a child span
+                # (handlers_global.go:126 ExtractRequestChild; falls back
+                # to a fresh span when no trace headers arrive)
+                from veneur_tpu.trace.opentracing import GLOBAL_TRACER
+                from veneur_tpu.trace.tracer import Span
+                req_span = GLOBAL_TRACER.extract_request_child(
+                    "/import", dict(self.headers.items()),
+                    "veneur.opentracing.import")
+                if req_span is None:
+                    req_span = Span("veneur.opentracing.import",
+                                    service="veneur")
+                try:
+                    self._handle_import()
+                finally:
+                    req_span.client_finish(server.trace_client)
             elif self.path == "/quitquitquit" and server.cfg.http_quit:
                 self._quit()
             else:
                 self._reply(404, b"not found")
+
+        def _handle_import(self):
+            length = int(self.headers.get("Content-Length", "0"))
+            body = self.rfile.read(length)
+            encoding = self.headers.get("Content-Encoding", "")
+            if encoding == "deflate":
+                try:
+                    body = zlib.decompress(body)
+                except zlib.error:
+                    self._reply(400, b"bad deflate body")
+                    return
+            elif encoding not in ("", "identity"):
+                # reference: unknown encodings are 415
+                # (handlers_global.go:150-156)
+                self._reply(415, encoding.encode())
+                return
+            if not body.strip():
+                self._reply(400, b"Received empty /import request")
+                return
+            # route on the declared Content-Type; fall back to a body
+            # sniff (json.NewDecoder skips leading whitespace,
+            # handlers_global.go:160 — and a protobuf body can
+            # legitimately begin 0x0a 0x5b, which lstrip+'[' would
+            # misread as JSON)
+            ctype = self.headers.get("Content-Type", "")
+            if "json" in ctype:
+                self._import_json(body)
+            elif "protobuf" in ctype:
+                self._import_protobuf(body)
+            elif body.lstrip()[:1] == b"[":
+                self._import_json(body)
+            else:
+                self._import_protobuf(body)
 
         def _import_json(self, body: bytes) -> None:
             """Reference JSONMetric array (handlers_global.go:115)."""

@@ -732,16 +732,30 @@ class Server:
         # stamp with the interval's swap time, not the job's run time — a
         # queued interval must not shift into the next time bucket
         ts = int(swapped_at)
+        # every flush stage is wrapped in a self-span reported through the
+        # channel trace client, so the span tree re-enters our own span
+        # pipeline and is visible to span sinks (flusher.go:29
+        # tracer.StartSpan("flush") + StartSpanFromContext per stage)
+        from veneur_tpu.trace.tracer import Span
+        root = Span("flush", service="veneur")
+
+        def stage(name):
+            return root.child(f"flush.{name}")
+
+        sp = stage("compute")
         if self._forward_client is not None:
             flush_arrays, table, raw = self.aggregator.compute_flush(
                 state, table, self.cfg.percentiles, want_raw=True)
+            sp.client_finish(self.trace_client)
             # fire-and-forget, concurrent with sink flushes
             # (flusher.go:84-95); _forward logs and counts its own errors,
             # and the flush thread must never block on a slow global tier
-            self._spawn_aux(self._forward, raw, table)
+            fsp = stage("forward")
+            self._spawn_aux(self._forward_traced, fsp, raw, table)
         else:
             flush_arrays, table = self.aggregator.compute_flush(
                 state, table, self.cfg.percentiles)
+            sp.client_finish(self.trace_client)
 
         if self.cfg.count_unique_timeseries:
             from veneur_tpu.server.flusher import unique_timeseries
@@ -766,25 +780,39 @@ class Server:
             timestamp=ts, hostname=self.hostname)
         if final:
             # parallel sink flushes + barrier (flusher.go:105-115)
+            sinks_span = stage("sinks")
+            sinks_span.set_tag("metrics", str(len(final)))
             threads = [threading.Thread(target=self._flush_sink,
-                                        args=(s, final))
+                                        args=(s, final, sinks_span))
                        for s in self.metric_sinks]
             for t in threads:
                 t.start()
             for t in threads:
                 t.join(timeout=self.interval)
+            sinks_span.client_finish(self.trace_client)
             # plugins run post-flush (flusher.go:117-131)
+            psp = stage("plugins") if self.plugins else None
             for p in self.plugins:
                 try:
                     p.flush(final)
                 except Exception as e:
+                    psp.error = True
                     log.warning("plugin %s flush failed: %s", p.name, e)
+            if psp is not None:
+                psp.client_finish(self.trace_client)
         # Self-telemetry is reported even for an empty interval — the
         # reference always tallies flush totals (flusher.go:300-336), and an
         # idle server must still bootstrap veneur.flush.* / packet counters
         # into its own pipeline.
         self._report_self_metrics(len(final), time.perf_counter() - flush_t0,
                                   stats)
+        root.client_finish(self.trace_client)
+
+    def _forward_traced(self, span, raw, table):
+        try:
+            self._forward(raw, table, span=span)
+        finally:
+            span.client_finish(self.trace_client)
 
     def _report_self_metrics(self, n_flushed: int, flush_seconds: float,
                              stats: dict):
@@ -844,10 +872,12 @@ class Server:
             for k, v in extra:
                 s.tags[k] = v
 
-    def _forward(self, raw, table):
+    def _forward(self, raw, table, span=None):
         """Serialize and ship forwardable sketch state
         (flusher.go:474 forwardGRPC). Errors are counted, never fatal
-        (flusher.go:512-524)."""
+        (flusher.go:512-524). `span` is the flush.forward stage span,
+        propagated to the peer over HTTP so its /import spans join this
+        flush's trace."""
         from veneur_tpu.forward.convert import export_metrics
         try:
             metrics = export_metrics(
@@ -855,17 +885,23 @@ class Server:
                 hll_precision=self.aggregator.spec.hll_precision)
             if metrics:
                 self._forward_client.send_metrics(
-                    metrics, timeout=self.interval)
+                    metrics, timeout=self.interval, parent_span=span)
         except Exception as e:
             self.forward_errors = getattr(self, "forward_errors", 0) + 1
             log.warning("forward failed: %s", e)
 
-    @staticmethod
-    def _flush_sink(sink, metrics: List[InterMetric]):
+    def _flush_sink(self, sink, metrics: List[InterMetric],
+                    parent=None):
+        span = parent.child(f"flush.sink.{sink.name}") if parent else None
         try:
             sink.flush(metrics)
         except Exception as e:
+            if span is not None:
+                span.error = True
             log.warning("sink %s flush failed: %s", sink.name, e)
+        finally:
+            if span is not None:
+                span.client_finish(self.trace_client)
 
     def _spawn_aux(self, target, *args) -> threading.Thread:
         """Fire-and-forget helpers (forward, span-sink flush) are tracked

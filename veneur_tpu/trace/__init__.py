@@ -5,3 +5,9 @@ from veneur_tpu.trace.client import (  # noqa: F401
     StreamBackend,
 )
 from veneur_tpu.trace.tracer import Span, Tracer  # noqa: F401
+from veneur_tpu.trace.opentracing import (  # noqa: F401
+    GLOBAL_TRACER,
+    HEADER_FORMATS,
+    OpenTracingTracer,
+    SpanContext,
+)

@@ -38,9 +38,29 @@ class Span:
         self.start_ns = int(time.time() * 1e9)
         self.end_ns = 0
         self.samples = []
+        self.log_lines = []   # LogFields/LogKV records (stored, unsent —
+        #                       matching opentracing.go:312 "ignored")
 
-    def set_tag(self, k: str, v: str):
-        self.tags[k] = str(v)
+    def set_tag(self, k: str, v) -> "Span":
+        self.tags[k] = v if isinstance(v, str) else repr(v)
+        return self
+
+    def set_operation_name(self, name: str) -> "Span":
+        """OpenTracing SetOperationName -> the resource tag
+        (opentracing.go:278 sets Trace.Resource)."""
+        self.tags["resource"] = name
+        return self
+
+    def log_fields(self, **fields) -> None:
+        self.log_lines.append(dict(fields))
+
+    def log_kv(self, *alternating) -> None:
+        self.log_fields(**{str(alternating[i]): alternating[i + 1]
+                           for i in range(0, len(alternating) - 1, 2)})
+
+    def context(self):
+        from veneur_tpu.trace.opentracing import SpanContext
+        return SpanContext.from_span(self)
 
     def add(self, *samples):
         """Attach SSF metric samples to ride along with the span
@@ -51,8 +71,8 @@ class Span:
         return Span(name, service=self.service, trace_id=self.trace_id,
                     parent_id=self.id, **kw)
 
-    def finish(self) -> ssf_pb2.SSFSpan:
-        self.end_ns = int(time.time() * 1e9)
+    def finish(self, finish_time_ns: Optional[int] = None) -> ssf_pb2.SSFSpan:
+        self.end_ns = finish_time_ns or int(time.time() * 1e9)
         return self.to_ssf()
 
     def to_ssf(self) -> ssf_pb2.SSFSpan:
