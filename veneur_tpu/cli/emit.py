@@ -46,19 +46,21 @@ def build_service_check_packet(name, status, tags=(), message=""):
     return body.encode()
 
 
-def open_sink(hostport: str, ssf: bool = False):
-    """ssf=True opens unix:// as a stream (the server's SSF unix listener
-    is SOCK_STREAM with framed spans); statsd unix:// is datagram."""
-    from veneur_tpu.server.server import resolve_addr
+def open_sink(hostport: str):
+    """unix:// is SOCK_STREAM on both the statsd (newline framing) and
+    SSF (length framing) listeners; unixgram:// is a datagram socket.
+    '@name' targets the Linux abstract namespace."""
+    from veneur_tpu.server.server import resolve_addr, unix_bind_address
     kind, target = resolve_addr(hostport)
+    if isinstance(target, str):
+        target = unix_bind_address(target)
     if kind == "udp":
         sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
         sock.connect(target)
-    elif kind == "tcp":
-        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        sock.connect(target)
-    elif kind == "unix" and ssf:
-        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    elif kind in ("tcp", "unix"):
+        sock = socket.socket(
+            socket.AF_INET if kind == "tcp" else socket.AF_UNIX,
+            socket.SOCK_STREAM)
         sock.connect(target)
     else:
         sock = socket.socket(socket.AF_UNIX, socket.SOCK_DGRAM)
@@ -101,8 +103,9 @@ def main(argv=None):
               "rates, or -replay (reference veneur-emit rejects these too)",
               file=sys.stderr)
         return 2
-    kind, sock = open_sink(args.hostport, ssf=args.ssf)
-    nl = b"\n" if kind == "tcp" else b""
+    kind, sock = open_sink(args.hostport)
+    # stream transports need the newline frame delimiter
+    nl = b"\n" if kind in ("tcp", "unix") and not args.ssf else b""
     packets = []
 
     if args.ssf:

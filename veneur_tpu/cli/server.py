@@ -51,6 +51,13 @@ def main(argv=None):
 
     signal.signal(signal.SIGINT, _sig)
     signal.signal(signal.SIGTERM, _sig)
+    # einhorn-style graceful handoff (reference server.go:1357-1360: goji
+    # graceful treats SIGUSR2/SIGHUP as "drain and exit so the supervisor
+    # can hand the socket to a replacement")
+    signal.signal(signal.SIGUSR2, _sig)
+    # respect nohup/supervisors that ignore hangups
+    if signal.getsignal(signal.SIGHUP) is not signal.SIG_IGN:
+        signal.signal(signal.SIGHUP, _sig)
     stop.wait()
     server.shutdown()
     return 0

@@ -84,14 +84,24 @@ def resolve_addr(addr: str):
     schemes udp/tcp/unix(gram)."""
     from urllib.parse import urlparse
     u = urlparse(addr)
-    port = u.port if u.port is not None else 8126
-    if u.scheme in ("udp", "udp4", "udp6"):
-        return ("udp", (u.hostname or "127.0.0.1", port))
-    if u.scheme in ("tcp", "tcp4", "tcp6"):
-        return ("tcp", (u.hostname or "127.0.0.1", port))
+    if u.scheme in ("udp", "udp4", "udp6", "tcp", "tcp4", "tcp6"):
+        # u.port is only touched here: an abstract unix name like
+        # '@veneur:ssf' parses as netloc with a non-numeric "port" and
+        # would raise
+        port = u.port if u.port is not None else 8126
+        kind = "udp" if u.scheme.startswith("udp") else "tcp"
+        return (kind, (u.hostname or "127.0.0.1", port))
     if u.scheme in ("unix", "unixgram"):
-        return (u.scheme, u.path)
+        # netloc survives for abstract-namespace paths ('@name' parses as
+        # URL userinfo) and the schemeless-path form 'unixgram:path'
+        return (u.scheme, u.netloc + u.path)
     raise ValueError(f"unsupported listener scheme in {addr!r}")
+
+
+def unix_bind_address(path: str) -> str:
+    """'@name' -> Linux abstract-namespace address; shared by the server
+    bind and the emit client so both mangle identically."""
+    return "\0" + path[1:] if path.startswith("@") else path
 
 
 def _native_available() -> bool:
@@ -218,6 +228,7 @@ class Server:
         self.import_errors = 0
         self.packets_received = 0
         self._shutdown = threading.Event()
+        self._unix_locks: List[tuple] = []   # (lock_fd, lock_path, sock_path)
         self._threads: List[threading.Thread] = []
         self._pipeline_thread: Optional[threading.Thread] = None
         self._flush_thread: Optional[threading.Thread] = None
@@ -344,6 +355,49 @@ class Server:
         self._flush_jobs.put_nowait((state, table, stats, now, req))
 
     # -- listeners ----------------------------------------------------------
+    def _bind_unix(self, sock: socket.socket, path: str) -> None:
+        """Bind a unix socket with the reference's ownership semantics
+        (networking.go:286-302 acquireLockForSocket + :304 abstract):
+        '@name' is the Linux abstract namespace — no filesystem presence,
+        no lock; pathname sockets take an exclusive flock on
+        '<path>.lock' (two veneurs must never share a socket file),
+        clear any stale socket, and are chmod'd 0666 so any local
+        process can emit."""
+        if path.startswith("@"):
+            sock.bind(unix_bind_address(path))
+            return
+        import fcntl
+        lock_path = path + ".lock"
+        lock_fd = os.open(lock_path, os.O_CREAT | os.O_RDWR, 0o644)
+        try:
+            fcntl.flock(lock_fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            os.close(lock_fd)
+            raise RuntimeError(
+                f"lock file {lock_path!r} for socket {path!r} is held by "
+                "another process already")
+        self._unix_locks.append((lock_fd, lock_path, path))
+        if os.path.exists(path):
+            os.unlink(path)
+        sock.bind(path)
+        os.chmod(path, 0o666)
+
+    def _release_unix_locks(self) -> None:
+        for lock_fd, lock_path, sock_path in self._unix_locks:
+            try:
+                os.unlink(sock_path)
+            except OSError:
+                pass
+            # the .lock file itself is deliberately NOT unlinked: flock
+            # mutual exclusion only holds if every contender locks the
+            # same inode; unlinking would let a starting server create a
+            # fresh inode while another holds the old one — two owners
+            try:
+                os.close(lock_fd)   # closing releases the flock
+            except OSError:
+                pass
+        self._unix_locks = []
+
     def _udp_reader(self, sock: socket.socket):
         bufsize = max(self.cfg.metric_max_length, 65536)
         sock.settimeout(0.5)  # lets readers observe shutdown and release fd
@@ -569,16 +623,30 @@ class Server:
                                       daemon=True)
                 lt.start()
                 self._threads.append(lt)
-            elif kind in ("unix", "unixgram"):
+            elif kind == "unixgram":
+                # datagram statsd (networking.go:145 startStatsdUnix:
+                # ListenUnixgram)
                 sock = socket.socket(socket.AF_UNIX, socket.SOCK_DGRAM)
-                if os.path.exists(target):
-                    os.unlink(target)
-                sock.bind(target)
+                self._bind_unix(sock, target)
                 self._sockets.append(sock)
                 rt = threading.Thread(target=self._udp_reader, args=(sock,),
                                       daemon=True)
                 rt.start()
                 self._threads.append(rt)
+            elif kind == "unix":
+                # stream statsd: newline-delimited metrics over
+                # SOCK_STREAM, same read loop as TCP minus TLS (the
+                # reference supports only unixgram statsd and panics on
+                # unix:// — networking.go:29; accepting the stream form
+                # here is a strict superset)
+                sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                self._bind_unix(sock, target)
+                sock.listen(128)
+                self._sockets.append(sock)
+                lt = threading.Thread(target=self._tcp_listener,
+                                      args=(sock, None), daemon=True)
+                lt.start()
+                self._threads.append(lt)
 
         # SSF span listeners (networking.go:198 StartSSF)
         self.span_pipeline.start()
@@ -587,11 +655,10 @@ class Server:
             if kind in ("udp", "unixgram"):
                 if kind == "udp":
                     sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+                    sock.bind(target)
                 else:
                     sock = socket.socket(socket.AF_UNIX, socket.SOCK_DGRAM)
-                    if os.path.exists(target):
-                        os.unlink(target)
-                sock.bind(target)
+                    self._bind_unix(sock, target)
                 self._sockets.append(sock)
                 rt = threading.Thread(target=self._ssf_udp_reader,
                                       args=(sock,), daemon=True)
@@ -600,13 +667,12 @@ class Server:
             elif kind in ("unix", "tcp"):
                 if kind == "unix":
                     sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-                    if os.path.exists(target):
-                        os.unlink(target)
+                    self._bind_unix(sock, target)
                 else:
                     sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
                     sock.setsockopt(socket.SOL_SOCKET,
                                     socket.SO_REUSEADDR, 1)
-                sock.bind(target)
+                    sock.bind(target)
                 sock.listen(64)
                 self._sockets.append(sock)
                 lt = threading.Thread(target=self._ssf_stream_listener,
@@ -945,6 +1011,7 @@ class Server:
                 s.close()
             except OSError:
                 pass
+        self._release_unix_locks()
         prof = getattr(self, "_profiler", None)
         if prof is not None:
             prof.disable()
