@@ -115,10 +115,10 @@ def main():
     per_step = sum(b.values())
 
     # production cadence (server/aggregator.py _on_batch): compact the
-    # digest temp lanes every `compact_every` steps, fold the f32
-    # accumulator pairs every `fold_every` — the timed loop must pay for
-    # both, or the headline is a fantasy number the pipeline never sees
-    compact_every, fold_every = 8, 64
+    # digest temp lanes every `compact_every` steps — the timed loop must
+    # pay for it, or the headline is a fantasy number the pipeline never
+    # sees. (Accumulator folds are fused INTO the ingest program.)
+    compact_every = 8
     uses = [0] * n_batches
 
     def run(state, i):
@@ -126,8 +126,6 @@ def main():
         uses[i % n_batches] += 1
         if (i + 1) % compact_every == 0:
             state = compact(state, spec=spec)
-        if (i + 1) % fold_every == 0:
-            state = fold_scalars(state)
         return state
 
     state = jax.device_put(empty_state(spec), dev)

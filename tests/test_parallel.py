@@ -19,6 +19,11 @@ SPEC = TableSpec(counter_capacity=32, gauge_capacity=16, status_capacity=8,
                  set_capacity=8, histo_capacity=16)
 
 
+def _flush_full(state, qs, *, spec):
+    from veneur_tpu.aggregation.step import finish_flush
+    return finish_flush(flush_compute(state, qs, spec=spec))
+
+
 def _rand_batch(rng, spec, b=64):
     """A random padded batch touching all tables."""
     def slots(cap, n):
@@ -78,7 +83,8 @@ def test_merged_flush_replica_collectives():
 
     qs = jnp.asarray([0.5, 0.99], jnp.float32)
     flush = make_merged_flush(mesh, SPEC)
-    out = jax.tree.map(np.asarray, flush(state, qs))
+    from veneur_tpu.aggregation.step import finish_flush
+    out = finish_flush(flush(state, qs))
 
     for si in range(s):
         # counters: sum across replicas
@@ -87,7 +93,8 @@ def test_merged_flush_replica_collectives():
         for ri in range(r):
             st = ingest_step(empty_state(SPEC), batches[ri][si], spec=SPEC)
             tiles.append(st)
-            per_rep.append(np.asarray(st.counter_acc))
+            per_rep.append(np.asarray(st.counter_hi, np.float64)
+                           + np.asarray(st.counter_lo))
         np.testing.assert_allclose(out["counter"][si], np.sum(per_rep, axis=0),
                                    rtol=1e-5, atol=1e-5)
         # HLL: union = register max, estimate must match single-table flush
@@ -95,7 +102,7 @@ def test_merged_flush_replica_collectives():
         hll_merged = np.maximum(*[np.asarray(t.hll) for t in tiles])
         ref_state = empty_state(SPEC)._replace(hll=jnp.asarray(hll_merged))
         ref_state = fold_scalars(ref_state)
-        ref = flush_compute(compact(ref_state, spec=SPEC), qs, spec=SPEC)
+        ref = _flush_full(compact(ref_state, spec=SPEC), qs, spec=SPEC)
         np.testing.assert_allclose(out["set_estimate"][si],
                                    np.asarray(ref["set_estimate"]), rtol=1e-5)
         # gauge: replica 1 wrote wins wherever it wrote, else replica 0
@@ -104,7 +111,8 @@ def test_merged_flush_replica_collectives():
                         np.asarray(tiles[0].gauge))
         np.testing.assert_allclose(out["gauge"][si], want, rtol=1e-6)
         # histogram count/sum: psum of per-replica totals
-        want_count = sum(np.asarray(t.h_count_acc) for t in tiles)
+        want_count = sum(np.asarray(t.h_count_hi, np.float64)
+                         + np.asarray(t.h_count_lo) for t in tiles)
         np.testing.assert_allclose(out["histo_count"][si], want_count,
                                    rtol=1e-5, atol=1e-5)
         # min/max across replicas

@@ -12,7 +12,7 @@ Numeric representation notes:
 - Counters (reference samplers/samplers.go:129: int64) are kept as a
   two-float f32 accumulator (utils/numerics.py) plus a plain f32 scatter
   target ``counter_acc`` that absorbs the per-batch scatter-adds; the host
-  folds acc into (hi, lo) every ``fold_every`` steps and at flush, bounding
+  folds acc into (hi, lo) inside every ingest step, bounding
   rounding error to ~1e-6 relative while keeping the hot path a single
   scatter-add.
 - Histogram digests are stored as (weight*mean, weight) rather than

@@ -174,17 +174,21 @@ def ingest_core(state: DeviceState, batch: Batch, *, spec: TableSpec) -> DeviceS
             h_max=state.h_max.at[s].max(batch.histo_stat_max, mode="drop"),
             h_recip_acc=state.h_recip_acc.at[s].add(batch.histo_stat_recip,
                                                     mode="drop"))
-    return state
+    # Fold the batch's scatter accumulators into the two-float pairs
+    # INSIDE the ingest program: XLA fuses the elementwise fold into the
+    # scatter dispatch (no extra launch), the f32 accumulator never
+    # carries more than one batch, and the pair absorbs each batch via
+    # error-free TwoSum — so counters match the reference's int64 for
+    # any realistic interval (e.g. a lone :1|c arriving after 2^32 no
+    # longer rounds away, which a 64-batch fold cadence allowed).
+    return _fold_core(state)
 
 
 ingest_step = partial(jax.jit, static_argnames=("spec",),
                       donate_argnames=("state",))(ingest_core)
 
 
-@jax.jit
-def fold_scalars(state: DeviceState) -> DeviceState:
-    """Fold the f32 scatter accumulators into their two-float pairs
-    (called by the host every fold_every steps and before flush)."""
+def _fold_core(state: DeviceState) -> DeviceState:
     ch, cl = twofloat_add(state.counter_hi, state.counter_lo, state.counter_acc)
     hch, hcl = twofloat_add(state.h_count_hi, state.h_count_lo, state.h_count_acc)
     hsh, hsl = twofloat_add(state.h_sum_hi, state.h_sum_lo, state.h_sum_acc)
@@ -195,6 +199,12 @@ def fold_scalars(state: DeviceState) -> DeviceState:
         h_count_acc=z(state.h_count_acc), h_count_hi=hch, h_count_lo=hcl,
         h_sum_acc=z(state.h_sum_acc), h_sum_hi=hsh, h_sum_lo=hsl,
         h_recip_acc=z(state.h_recip_acc), h_recip_hi=hrh, h_recip_lo=hrl)
+
+
+# Standalone fold kept for flush-time finalization (a last partial batch
+# staged through non-ingest paths) and the host fold cadence, which is now
+# a harmless no-op on already-folded state.
+fold_scalars = jax.jit(_fold_core)
 
 
 def compact_core(state: DeviceState, *, spec: TableSpec) -> DeviceState:
@@ -227,11 +237,15 @@ def flush_core(state: DeviceState, qs: jax.Array, *, spec: TableSpec):
         count_hi=state.h_count_hi, count_lo=state.h_count_lo,
         sum_hi=state.h_sum_hi, sum_lo=state.h_sum_lo,
         recip_hi=state.h_recip_hi, recip_lo=state.h_recip_lo)
-    count = state.h_count_hi + state.h_count_lo
-    total = state.h_sum_hi + state.h_sum_lo
-    recip = state.h_recip_hi + state.h_recip_lo
+    # Scalar totals leave the device as UNCOLLAPSED two-float pairs:
+    # hi + lo in f32 would round the ~48-bit accumulator back to 24 bits
+    # at the very boundary the pair exists to protect (a 2^32+1 counter
+    # interval would flush as 2^32). The host combines them in float64
+    # (combine_flush_scalars) — device f64 is unavailable without
+    # jax_enable_x64.
     return {
-        "counter": state.counter_hi + state.counter_lo,
+        "counter_hi": state.counter_hi,
+        "counter_lo": state.counter_lo,
         "gauge": state.gauge,
         "status": state.status,
         "set_estimate": hll_ops.estimate(state.hll,
@@ -239,12 +253,48 @@ def flush_core(state: DeviceState, qs: jax.Array, *, spec: TableSpec):
         "histo_quantiles": td.quantiles(table, qs),
         "histo_min": state.h_min,
         "histo_max": state.h_max,
-        "histo_count": count,
-        "histo_sum": total,
-        "histo_avg": total / jnp.maximum(count, 1e-30),
+        "histo_count_hi": state.h_count_hi,
+        "histo_count_lo": state.h_count_lo,
+        "histo_sum_hi": state.h_sum_hi,
+        "histo_sum_lo": state.h_sum_lo,
+        "histo_recip_hi": state.h_recip_hi,
+        "histo_recip_lo": state.h_recip_lo,
         "histo_median": td.quantiles(table, jnp.asarray([0.5], jnp.float32))[..., 0],
-        "histo_hmean": count / jnp.maximum(recip, 1e-30),
     }
 
 
 flush_compute = partial(jax.jit, static_argnames=("spec",))(flush_core)
+
+
+def combine_flush_scalars(result: dict) -> dict:
+    """Host-side finish of flush_core's output: collapse each two-float
+    pair in FLOAT64 (exact for the pair's ~48 significand bits — the
+    reference's int64 counters and float64 histo scalars,
+    samplers/samplers.go:131,477-481, stay exact through here) and derive
+    count/sum/avg/hmean. Works on any leading batch shape; the input dict
+    is left untouched."""
+    import numpy as np
+
+    def f64(key):
+        return (np.asarray(result[key + "_hi"], np.float64)
+                + np.asarray(result[key + "_lo"], np.float64))
+
+    out = {k: v for k, v in result.items()
+           if not (k.endswith("_hi") or k.endswith("_lo"))}
+    out["counter"] = f64("counter")
+    count = f64("histo_count")
+    total = f64("histo_sum")
+    recip = f64("histo_recip")
+    out["histo_count"] = count
+    out["histo_sum"] = total
+    out["histo_avg"] = total / np.maximum(count, 1e-30)
+    out["histo_hmean"] = count / np.maximum(recip, 1e-30)
+    return out
+
+
+def finish_flush(out) -> dict:
+    """Device flush output -> host numpy dict with pairs combined; the
+    one boundary every flush consumer (server aggregators, tests, the
+    multichip dryrun) goes through."""
+    import numpy as np
+    return combine_flush_scalars({k: np.asarray(v) for k, v in out.items()})

@@ -217,7 +217,6 @@ class Config:
     tpu_n_shards: int = 0      # 0 = one shard per local device
     tpu_n_replicas: int = 1
     tpu_compact_every: int = 8
-    tpu_fold_every: int = 64
 
     def parse_interval(self) -> float:
         return parse_duration(self.interval)

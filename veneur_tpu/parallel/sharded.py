@@ -121,14 +121,33 @@ def _merge_replica_block(state: DeviceState, spec: TableSpec):
     the replica dims reduced away — one merged table per shard tile."""
     ax = REPLICA_AXIS
 
-    def total(hi, lo, acc):
-        t = (hi + lo + acc).sum(axis=0)
-        return jax.lax.psum(t, ax)
+    def pair_total(hi, lo, acc):
+        """Sum two-float pairs across ALL replicas without collapsing to
+        f32 (a plain psum of hi+lo rounds the ~48-bit pairs back to 24
+        bits — the same boundary bug combine_flush_scalars fixes on the
+        host). Gather every replica's pair and fold sequentially with
+        error-free TwoSum merges; the global counter merge then matches
+        the reference's exact int64 adds (importsrv -> Counter.Merge)."""
+        from veneur_tpu.utils.numerics import twofloat_add, twofloat_merge
+        hi, lo = twofloat_add(hi, lo, acc)   # absorb any unfolded acc
+        hs = jax.lax.all_gather(hi, ax)      # [Rg, r_local, s, K]
+        ls = jax.lax.all_gather(lo, ax)
+        hs = hs.reshape((-1,) + hs.shape[2:])
+        ls = ls.reshape((-1,) + ls.shape[2:])
 
-    counters = total(state.counter_hi, state.counter_lo, state.counter_acc)
-    h_count = total(state.h_count_hi, state.h_count_lo, state.h_count_acc)
-    h_sum = total(state.h_sum_hi, state.h_sum_lo, state.h_sum_acc)
-    h_recip = total(state.h_recip_hi, state.h_recip_lo, state.h_recip_acc)
+        def body(carry, x):
+            return twofloat_merge(carry[0], carry[1], x[0], x[1]), None
+
+        (h, l), _ = jax.lax.scan(body, (hs[0], ls[0]), (hs[1:], ls[1:]))
+        return h, l
+
+    counters = pair_total(state.counter_hi, state.counter_lo,
+                          state.counter_acc)
+    h_count = pair_total(state.h_count_hi, state.h_count_lo,
+                         state.h_count_acc)
+    h_sum = pair_total(state.h_sum_hi, state.h_sum_lo, state.h_sum_acc)
+    h_recip = pair_total(state.h_recip_hi, state.h_recip_lo,
+                         state.h_recip_acc)
 
     # HLL: register-wise max (reference Set.Merge = HLL union,
     # samplers/samplers.go:461)
@@ -177,29 +196,21 @@ def _merge_replica_block(state: DeviceState, spec: TableSpec):
 
     z = jnp.zeros_like
     merged = DeviceState(
-        counter_acc=z(counters), counter_hi=counters, counter_lo=z(counters),
+        counter_acc=z(counters[0]), counter_hi=counters[0],
+        counter_lo=counters[1],
         gauge=gauge, gauge_stamp=gauge_stamp,
         status=status, status_stamp=status_stamp,
         hll=hll,
         h_wm=wm2, h_w=w2,
         h_temp_n=jnp.zeros(w2.shape[:-1], jnp.int32),
         h_min=h_min, h_max=h_max,
-        h_count_acc=z(h_count), h_count_hi=h_count, h_count_lo=z(h_count),
-        h_sum_acc=z(h_sum), h_sum_hi=h_sum, h_sum_lo=z(h_sum),
-        h_recip_acc=z(h_recip), h_recip_hi=h_recip, h_recip_lo=z(h_recip),
+        h_count_acc=z(h_count[0]), h_count_hi=h_count[0],
+        h_count_lo=h_count[1],
+        h_sum_acc=z(h_sum[0]), h_sum_hi=h_sum[0], h_sum_lo=h_sum[1],
+        h_recip_acc=z(h_recip[0]), h_recip_hi=h_recip[0],
+        h_recip_lo=h_recip[1],
     )
     return merged
-
-
-def make_sharded_fold(mesh: Mesh):
-    """Per-tile fold_scalars over the mesh (bounds f32 accumulator error
-    exactly like the single-device fold_every cadence)."""
-    from veneur_tpu.aggregation.step import fold_scalars
-    vv = jax.vmap(jax.vmap(fold_scalars))
-    fn = jax.shard_map(vv, mesh=mesh,
-                       in_specs=P(REPLICA_AXIS, SHARD_AXIS),
-                       out_specs=P(REPLICA_AXIS, SHARD_AXIS))
-    return jax.jit(fn, donate_argnums=(0,))
 
 
 def make_sharded_compact(mesh: Mesh, spec: TableSpec):

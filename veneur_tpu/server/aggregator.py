@@ -25,13 +25,11 @@ from veneur_tpu.samplers.parser import UDPMetric
 
 class Aggregator:
     def __init__(self, spec: TableSpec, bspec: BatchSpec = BatchSpec(),
-                 n_shards: int = 1, compact_every: int = 8,
-                 fold_every: int = 64):
+                 n_shards: int = 1, compact_every: int = 8):
         self.spec = spec
         self.bspec = bspec
         self.n_shards = n_shards
         self.compact_every = compact_every
-        self.fold_every = fold_every
         self.table = KeyTable(spec, n_shards)
         self.batcher = Batcher(spec, bspec, on_batch=self._on_batch)
         self.state = empty_state(spec)
@@ -53,8 +51,6 @@ class Aggregator:
         self._steps += 1
         if self._steps % self.compact_every == 0:
             self.state = compact(self.state, spec=self.spec)
-        if self._steps % self.fold_every == 0:
-            self.state = fold_scalars(self.state)
 
     def process_metric(self, m: UDPMetric) -> None:
         """reference worker.go:344 ProcessMetric: switch on type+scope,
@@ -181,8 +177,8 @@ class Aggregator:
         state = fold_scalars(state)
         state = compact(state, spec=self.spec)
         qs = jnp.asarray(percentiles or [0.5], jnp.float32)
-        out = flush_compute(state, qs, spec=self.spec)
-        result = {k: np.asarray(v) for k, v in out.items()}
+        from veneur_tpu.aggregation.step import finish_flush
+        result = finish_flush(flush_compute(state, qs, spec=self.spec))
         if want_raw:
             w = np.asarray(state.h_w)
             wm = np.asarray(state.h_wm)
@@ -194,8 +190,8 @@ class Aggregator:
                 "h_weight": w,
                 "h_min": np.asarray(state.h_min),
                 "h_max": np.asarray(state.h_max),
-                "h_recip": np.asarray(state.h_recip_hi)
-                + np.asarray(state.h_recip_lo),
+                "h_recip": np.asarray(state.h_recip_hi, np.float64)
+                + np.asarray(state.h_recip_lo, np.float64),
             }
             return result, table, raw
         return result, table

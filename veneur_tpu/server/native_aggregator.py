@@ -113,9 +113,8 @@ class NativeKeyTable:
 
 class NativeAggregator(Aggregator):
     def __init__(self, spec: TableSpec, bspec: BatchSpec = BatchSpec(),
-                 n_shards: int = 1, compact_every: int = 8,
-                 fold_every: int = 64):
-        super().__init__(spec, bspec, n_shards, compact_every, fold_every)
+                 n_shards: int = 1, compact_every: int = 8):
+        super().__init__(spec, bspec, n_shards, compact_every)
         self.eng = NativeIngest(spec, bspec, n_shards)
         self.table = NativeKeyTable(spec, self.eng, n_shards)
         self._alloc_emit_buffers()
@@ -226,9 +225,8 @@ class NativeShardedAggregator(ShardedAggregator):
     each other."""
 
     def __init__(self, spec: TableSpec, bspec: BatchSpec = BatchSpec(),
-                 n_shards: int = 2, compact_every: int = 8,
-                 fold_every: int = 64):
-        super().__init__(spec, bspec, n_shards, compact_every, fold_every)
+                 n_shards: int = 2, compact_every: int = 8):
+        super().__init__(spec, bspec, n_shards, compact_every)
         self.eng = NativeIngest(spec, bspec, n_shards)
         self.table = NativeKeyTable(spec, self.eng, n_shards)
         self._py_processed = 0

@@ -134,8 +134,7 @@ class Server:
                             set=cfg.tpu_batch_set,
                             histo=cfg.tpu_batch_histo),
             n_shards=max(1, cfg.tpu_n_shards) if cfg.tpu_n_shards else 1,
-            compact_every=cfg.tpu_compact_every,
-            fold_every=cfg.tpu_fold_every)
+            compact_every=cfg.tpu_compact_every)
         self._native = False
         n_shards = agg_args["n_shards"]
         if cfg.tpu_n_shards == 0:

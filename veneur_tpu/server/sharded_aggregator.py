@@ -48,8 +48,7 @@ def per_shard_spec(spec: TableSpec, n_shards: int) -> TableSpec:
 
 class ShardedAggregator(Aggregator):
     def __init__(self, spec: TableSpec, bspec: BatchSpec = BatchSpec(),
-                 n_shards: int = 2, compact_every: int = 8,
-                 fold_every: int = 64):
+                 n_shards: int = 2, compact_every: int = 8):
         import jax
         from veneur_tpu.parallel import (
             make_mesh, make_merged_flush, make_sharded_ingest,
@@ -60,14 +59,11 @@ class ShardedAggregator(Aggregator):
         self.bspec = bspec
         self.n_shards = n_shards
         self.compact_every = compact_every
-        self.fold_every = fold_every
 
         self.mesh = make_mesh(1, n_shards)
         self._ingest = make_sharded_ingest(self.mesh, self.pspec)
         self._flush = make_merged_flush(self.mesh, self.pspec)
-        from veneur_tpu.parallel import (
-            make_sharded_compact, make_sharded_fold)
-        self._fold = make_sharded_fold(self.mesh)
+        from veneur_tpu.parallel import make_sharded_compact
         self._compact = make_sharded_compact(self.mesh, self.pspec)
         self._empty = partial(sharded_empty_state, self.pspec, 1, n_shards,
                               self.mesh)
@@ -171,8 +167,6 @@ class ShardedAggregator(Aggregator):
         # (Aggregator._on_batch): compact digests / fold f32 accumulators
         if self._steps % self.compact_every == 0:
             self.state = self._compact(self.state)
-        if self._steps % self.fold_every == 0:
-            self.state = self._fold(self.state)
 
     def _emit_all(self):
         from veneur_tpu.parallel import stack_batches
@@ -214,11 +208,12 @@ class ShardedAggregator(Aggregator):
                       want_raw: bool = False):
         import jax.numpy as jnp
 
+        from veneur_tpu.aggregation.step import finish_flush
+
         qs = jnp.asarray(percentiles or [0.5], jnp.float32)
-        out = self._flush(state, qs)
         # flatten [S, K_per] -> [S*K_per]: matches KeyTable's global slots
-        result = {k: np.asarray(v).reshape((-1,) + np.asarray(v).shape[2:])
-                  for k, v in out.items()}
+        result = {k: v.reshape((-1,) + v.shape[2:])
+                  for k, v in finish_flush(self._flush(state, qs)).items()}
         if want_raw:
             def flat(x, extra=()):
                 a = np.asarray(x)
@@ -235,8 +230,8 @@ class ShardedAggregator(Aggregator):
                 "h_weight": w,
                 "h_min": flat(state.h_min),
                 "h_max": flat(state.h_max),
-                "h_recip": flat(state.h_recip_hi) + flat(state.h_recip_lo)
-                + flat(state.h_recip_acc),
+                "h_recip": flat(state.h_recip_hi).astype(np.float64)
+                + flat(state.h_recip_lo) + flat(state.h_recip_acc),
             }
             return result, table, raw
         return result, table

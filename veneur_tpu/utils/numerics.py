@@ -5,8 +5,29 @@ histogram scalar aggregates as float64 (reference samplers/samplers.go:131,
 477-481). To preserve the same effective precision over a flush interval we
 store running sums as an unevaluated pair (hi, lo) of float32 — "two-float"
 (double-single) arithmetic. Error-free transformation via Knuth's TwoSum,
-so each accumulated addition is exact to ~48 bits of significand, well above
-what a 10s flush interval of increments needs.
+so each accumulated addition is exact to ~48 bits of significand.
+
+The exactness envelope vs the reference's int64 (the documented deviation;
+tested in tests/test_aggregation.py::test_counter_exactness_envelope*):
+
+- Each batch's scatter-adds land in a plain-f32 `*_acc` array that is
+  folded into the pair INSIDE the same ingest program (step.py
+  ingest_core), so the f32 accumulator never spans more than one batch
+  and the pair absorbs every batch total via error-free TwoSum.
+- A batch is exact while each (slot, batch) duplicate-sum stays within
+  f32's exact range for its granularity (unit increments: < 2^24 hits
+  on one slot in one batch). Past that, the rounding happens inside the
+  XLA scatter itself; summed over an interval the relative error is
+  bounded by 2^-25 (each batch contributes <= ulp(batch_slot_total)/2
+  and the pair carries batch totals exactly).
+- The pair carries ~48 significand bits; unit-increment interval totals
+  through ~2.8e14 stay exact — the reference's int64 overflows later
+  (2^63) but a 10s interval approaches neither bound.
+- The pair must leave the device UNCOLLAPSED: hi + lo in f32 rounds back
+  to 24 bits. flush_core ships (hi, lo) and the host combines in float64
+  (aggregation/step.py combine_flush_scalars); the cross-replica merge
+  folds pairs with compensated merges (parallel/sharded.py pair_total)
+  instead of a plain f32 psum.
 """
 
 from __future__ import annotations
