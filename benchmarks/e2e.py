@@ -158,17 +158,22 @@ def config1_counter_replay(scale=1.0):
                      tpu_counter_capacity=1 << 14)
     try:
         addr = srv.local_addr()
-        # warm the compiled path so the timed region is steady-state
+        # warm the compiled path so the timed region is steady-state;
+        # the untimed first cycle compiles the live-slot flush at the
+        # run's true cardinality bucket (reference benchmarks loop b.N
+        # times for the same reason)
         _warm(srv, [b"replay.counter.0:1|c"])
-        base = srv.aggregator.processed
-
         sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-        t0 = time.perf_counter()
-        for p in payloads:
-            sock.sendto(p, addr)
-        done = _drain(srv, base + total) - base
-        _flush_checked(srv)          # full interval incl. flush math
-        dt = time.perf_counter() - t0
+        for cycle in range(2):
+            base = srv.aggregator.processed
+            t0 = time.perf_counter()
+            for p in payloads:
+                sock.sendto(p, addr)
+            done = _drain(srv, base + total) - base
+            # cycle 0 pays the size-bucket flush compile
+            _flush_checked(srv, timeout=WARM_TIMEOUT if cycle == 0
+                           else FLUSH_WAIT)
+            dt = time.perf_counter() - t0
         sock.close()
 
         processed = srv.aggregator.processed - base
@@ -216,12 +221,15 @@ def config2_zipf_timers(scale=1.0):
                      tpu_batch_histo=1 << 14)
     try:
         _warm(srv, [b"warm.t:1.0|ms"], sinks=[sink])
-        base = srv.aggregator.processed
-        t0 = time.perf_counter()
-        _feed_queue(srv, payloads)
-        _drain(srv, base + samples)
-        _flush_checked(srv)
-        dt = time.perf_counter() - t0
+        for cycle in range(2):   # first cycle compiles the size bucket
+            sink.flushed.clear()
+            base = srv.aggregator.processed
+            t0 = time.perf_counter()
+            _feed_queue(srv, payloads)
+            _drain(srv, base + samples)
+            _flush_checked(srv, timeout=WARM_TIMEOUT if cycle == 0
+                           else FLUSH_WAIT)
+            dt = time.perf_counter() - t0
 
         flushed = {m.name: m.value for m in sink.flushed}
         errs = {0.5: [], 0.9: [], 0.99: []}
@@ -277,12 +285,15 @@ def config3_set_cardinality(scale=1.0):
     srv = _mk_server([sink], tpu_set_capacity=16, tpu_batch_set=1 << 13)
     try:
         _warm(srv, [b"warm.s:uid-w|s"], sinks=[sink])
-        base = srv.aggregator.processed
-        t0 = time.perf_counter()
-        _feed_queue(srv, payloads)
-        _drain(srv, base + uids)
-        _flush_checked(srv)
-        dt = time.perf_counter() - t0
+        for cycle in range(2):   # first cycle compiles the size bucket
+            sink.flushed.clear()
+            base = srv.aggregator.processed
+            t0 = time.perf_counter()
+            _feed_queue(srv, payloads)
+            _drain(srv, base + uids)
+            _flush_checked(srv, timeout=WARM_TIMEOUT if cycle == 0
+                           else FLUSH_WAIT)
+            dt = time.perf_counter() - t0
 
         flushed = {m.name: m.value for m in sink.flushed}
         per_key = {k: sum(1 for i in range(uids) if i % keys == k)
@@ -357,15 +368,19 @@ def config4_global_merge(scale=1.0):
         _warm(glob, [b"warm.c:1|c", b"warm.t:1.0|ms"], sinks=[sink])
         client = ForwardClient(f"127.0.0.1:{glob.grpc_port}")
         n_metrics = sum(len(e) for e in exports)
-        t0 = time.perf_counter()
-        for e in exports:
-            client.send_metrics(e, timeout=30.0)
-        # imports ride the pipeline queue; drain then flush
-        t1 = time.time()
-        while glob.packet_queue.qsize() and time.time() - t1 < FLUSH_WAIT:
-            time.sleep(0.02)
-        _flush_checked(glob)
-        dt = time.perf_counter() - t0
+        for cycle in range(2):   # first cycle compiles the size bucket
+            sink.flushed.clear()
+            t0 = time.perf_counter()
+            for e in exports:
+                client.send_metrics(e, timeout=30.0)
+            # imports ride the pipeline queue; drain then flush
+            t1 = time.time()
+            while glob.packet_queue.qsize() and \
+                    time.time() - t1 < FLUSH_WAIT:
+                time.sleep(0.02)
+            _flush_checked(glob, timeout=WARM_TIMEOUT if cycle == 0
+                           else FLUSH_WAIT)
+            dt = time.perf_counter() - t0
         client.close()
 
         flushed = {m.name: m.value for m in sink.flushed}

@@ -49,36 +49,38 @@ def export_metrics(raw: Dict[str, np.ndarray], table: KeyTable,
     """Build the forwardable MetricList from a flush's raw state."""
     out: List[mpb.Metric] = []
 
-    for slot, meta in table.get_meta("counter"):
+    # raw arrays are COMPACT: row i pairs with get_meta(kind)[i]
+    # (aggregator.compute_flush want_raw gathers live rows on device)
+    for i, (_slot, meta) in enumerate(table.get_meta("counter")):
         if meta.scope != SCOPE_GLOBAL:
             continue  # only global counters forward (worker.go:186-193)
         m = mpb.Metric(name=meta.name, tags=list(meta.tags),
                        type=mpb.Counter, scope=mpb.Global)
-        m.counter.value = int(round(float(raw["counter"][slot])))
+        m.counter.value = int(round(float(raw["counter"][i])))
         out.append(m)
 
-    for slot, meta in table.get_meta("gauge"):
+    for i, (_slot, meta) in enumerate(table.get_meta("gauge")):
         if meta.scope != SCOPE_GLOBAL:
             continue
         m = mpb.Metric(name=meta.name, tags=list(meta.tags),
                        type=mpb.Gauge, scope=mpb.Global)
-        m.gauge.value = float(raw["gauge"][slot])
+        m.gauge.value = float(raw["gauge"][i])
         out.append(m)
 
-    for slot, meta in table.get_meta("set"):
+    for i, (_slot, meta) in enumerate(table.get_meta("set")):
         if meta.scope == SCOPE_LOCAL:
             continue  # local-only sets flush locally, never forward
         m = mpb.Metric(name=meta.name, tags=list(meta.tags), type=mpb.Set,
                        scope=mpb.Global if meta.scope == SCOPE_GLOBAL
                        else mpb.Mixed)
-        m.set.hyper_log_log = hll_ops.serialize(raw["hll"][slot],
+        m.set.hyper_log_log = hll_ops.serialize(raw["hll"][i],
                                                 hll_precision)
         out.append(m)
 
-    for slot, meta in table.get_meta("histogram"):
+    for i, (_slot, meta) in enumerate(table.get_meta("histogram")):
         if meta.scope == SCOPE_LOCAL:
             continue
-        w = raw["h_weight"][slot]
+        w = raw["h_weight"][i]
         live = w > 0
         if not live.any():
             continue
@@ -88,10 +90,10 @@ def export_metrics(raw: Dict[str, np.ndarray], table: KeyTable,
                        else mpb.Mixed)
         td = m.histogram.t_digest
         td.compression = compression
-        td.min = float(raw["h_min"][slot])
-        td.max = float(raw["h_max"][slot])
-        td.reciprocalSum = float(raw["h_recip"][slot])
-        means = raw["h_mean"][slot][live]
+        td.min = float(raw["h_min"][i])
+        td.max = float(raw["h_max"][i])
+        td.reciprocalSum = float(raw["h_recip"][i])
+        means = raw["h_mean"][i][live]
         weights = w[live]
         for mean, wt in zip(means, weights):
             td.main_centroids.add(mean=float(mean), weight=float(wt))

@@ -169,29 +169,48 @@ class Aggregator:
                       want_raw: bool = False
                       ) -> Tuple[Dict[str, np.ndarray], KeyTable]:
         """Flush math on a detached interval (safe off the pipeline thread:
-        JAX arrays are immutable and dispatch is thread-safe). With
-        want_raw, also returns the folded sketch state (numpy) for
-        forwarding serialization."""
+        JAX arrays are immutable and dispatch is thread-safe). Output
+        arrays are COMPACT: row i pairs with table.get_meta(kind)[i]
+        (flush_live gathers live rows on device, so only O(live) bytes
+        cross the host boundary). With want_raw, also returns the live
+        rows' mergeable sketch state (numpy) for forwarding."""
         import jax.numpy as jnp
+        from veneur_tpu.aggregation.step import (
+            combine_flush_scalars, flush_live_packed, flush_live_shapes,
+            live_indices, unpack_flush)
 
-        state = fold_scalars(state)
-        state = compact(state, spec=self.spec)
-        qs = jnp.asarray(percentiles or [0.5], jnp.float32)
-        from veneur_tpu.aggregation.step import finish_flush
-        result = finish_flush(flush_compute(state, qs, spec=self.spec))
+        # No fold/compact pass here: ingest folds accumulators in-program
+        # (step.py ingest_core), and the quantile kernel argsorts cells
+        # per row (ops/tdigest.py _quantiles_one), so unmerged temp cells
+        # are just extra exact centroids — compacting the FULL table
+        # before flush cost ~2s of device time per interval at 2^17
+        # capacity for no accuracy gain (temps unmerged are strictly more
+        # precise; forwarding re-adds centroids either way).
+        perc = percentiles or [0.5]
+        qs = jnp.asarray(perc, jnp.float32)
+        spec = self.spec
+        idx = [live_indices(table, "counter", spec.counter_capacity),
+               live_indices(table, "gauge", spec.gauge_capacity),
+               live_indices(table, "status", spec.status_capacity),
+               live_indices(table, "set", spec.set_capacity),
+               live_indices(table, "histogram", spec.histo_capacity)]
+        packed = np.asarray(flush_live_packed(
+            state, qs, *[jnp.asarray(i) for i in idx],
+            spec=spec, want_raw=want_raw))   # ONE device->host transfer
+        out = unpack_flush(packed, flush_live_shapes(
+            spec, *[len(i) for i in idx], len(perc), want_raw=want_raw))
+        result = combine_flush_scalars(out)
         if want_raw:
-            w = np.asarray(state.h_w)
-            wm = np.asarray(state.h_wm)
             raw = {
                 "counter": result["counter"],
                 "gauge": result["gauge"],
-                "hll": np.asarray(state.hll),
-                "h_mean": np.where(w > 0, wm / np.maximum(w, 1e-30), 0.0),
-                "h_weight": w,
-                "h_min": np.asarray(state.h_min),
-                "h_max": np.asarray(state.h_max),
-                "h_recip": np.asarray(state.h_recip_hi, np.float64)
-                + np.asarray(state.h_recip_lo, np.float64),
+                "hll": result.pop("raw_hll"),
+                "h_mean": result.pop("raw_h_mean"),
+                "h_weight": result.pop("raw_h_weight"),
+                "h_min": result["histo_min"],
+                "h_max": result["histo_max"],
+                "h_recip": np.asarray(out["histo_recip_hi"], np.float64)
+                + np.asarray(out["histo_recip_lo"], np.float64),
             }
             return result, table, raw
         return result, table

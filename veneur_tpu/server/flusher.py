@@ -81,39 +81,41 @@ def generate_intermetrics(flush: Dict[str, np.ndarray], table: KeyTable,
             hostname=meta.hostname or hostname,
             sinks=route_info(meta.tags)))
 
+    # flush arrays are COMPACT: row i pairs with get_meta(kind)[i]
+    # (aggregator.compute_flush gathers live rows on device)
     counters = flush["counter"]
-    for slot, meta in table.get_meta("counter"):
+    for i, (_slot, meta) in enumerate(table.get_meta("counter")):
         if is_local and meta.scope == SCOPE_GLOBAL:
             continue  # forwarded, not flushed (flusher.go:274-287)
-        emit(meta, meta.name, counters[slot], COUNTER)
+        emit(meta, meta.name, counters[i], COUNTER)
 
     gauges = flush["gauge"]
-    for slot, meta in table.get_meta("gauge"):
+    for i, (_slot, meta) in enumerate(table.get_meta("gauge")):
         if is_local and meta.scope == SCOPE_GLOBAL:
             continue
-        emit(meta, meta.name, gauges[slot], GAUGE)
+        emit(meta, meta.name, gauges[i], GAUGE)
 
     status = flush["status"]
-    for slot, meta in table.get_meta("status"):
-        emit(meta, meta.name, status[slot], STATUS, message=meta.message)
+    for i, (_slot, meta) in enumerate(table.get_meta("status")):
+        emit(meta, meta.name, status[i], STATUS, message=meta.message)
 
     sets = flush["set_estimate"]
-    for slot, meta in table.get_meta("set"):
+    for i, (_slot, meta) in enumerate(table.get_meta("set")):
         # sets have no local part (flusher.go:277-280): local instances
         # forward the HLL and emit nothing unless the set is local-only
         if is_local and meta.scope != SCOPE_LOCAL:
             continue
-        emit(meta, meta.name, sets[slot], GAUGE)
+        emit(meta, meta.name, sets[i], GAUGE)
 
     hq = flush["histo_quantiles"]
     hcount = flush["histo_count"]
     agg_arrays = {a: flush[AGGREGATE_FIELDS[a][0]] for a in aggregates
                   if a in AGGREGATE_FIELDS}
-    for slot, meta in table.get_meta("histogram"):
+    for i, (_slot, meta) in enumerate(table.get_meta("histogram")):
         if is_local and meta.scope == SCOPE_GLOBAL:
             continue
         global_flush = meta.scope == SCOPE_GLOBAL and not is_local
-        has_mass = hcount[slot] > 0
+        has_mass = hcount[i] > 0
         # imported-only MIXED histos on a global tier emit percentiles only:
         # their aggregates already flushed on the local instances
         # (flusher.go:61-77 "avoid double counting"); global-scoped ones
@@ -121,7 +123,7 @@ def generate_intermetrics(flush: Dict[str, np.ndarray], table: KeyTable,
         emit_aggs = has_mass and (not meta.imported_only or global_flush)
         if emit_aggs:
             for agg, arr in agg_arrays.items():
-                v = arr[slot]
+                v = arr[i]
                 if agg in ("min", "max") and not math.isfinite(v):
                     continue
                 emit(meta, f"{meta.name}.{agg}", v,
@@ -129,7 +131,7 @@ def generate_intermetrics(flush: Dict[str, np.ndarray], table: KeyTable,
         # percentiles: only where they are globally accurate — everywhere on
         # a global/standalone instance, local-only keys on a local one
         if perc and (not is_local or meta.scope == SCOPE_LOCAL) and has_mass:
-            for i, p in enumerate(perc):
+            for pi, p in enumerate(perc):
                 emit(meta, f"{meta.name}.{percentile_name(p)}",
-                     hq[slot, i], GAUGE)
+                     hq[i, pi], GAUGE)
     return out

@@ -131,9 +131,19 @@ class NativeAggregator(Aggregator):
         self._h_slot = np.empty(b.histo, np.int32)
         self._h_val = np.zeros(b.histo, np.float32)
         self._h_wt = np.zeros(b.histo, np.float32)
-        # status never rides the native path; constant empty lanes
+        # status / imported-digest stats never ride the native path;
+        # constant empty lanes keep the Batch pytree STRUCTURALLY
+        # identical to the Python Batcher's (host.py emit), so one
+        # compiled ingest program serves both — a native-only batch
+        # shape would force a second multi-second XLA compile the first
+        # time a Python-path sample (self-telemetry, import, service
+        # check) flushes
         self._st_slot = np.full(b.status, spec.status_capacity, np.int32)
         self._st_val = np.zeros(b.status, np.float32)
+        self._hs_slot = np.full(b.histo_stat, spec.histo_capacity, np.int32)
+        self._hs_min = np.full(b.histo_stat, np.inf, np.float32)
+        self._hs_max = np.full(b.histo_stat, -np.inf, np.float32)
+        self._hs_recip = np.zeros(b.histo_stat, np.float32)
 
     # -- wire path -----------------------------------------------------------
     def feed(self, data: bytes) -> List[bytes]:
@@ -171,6 +181,8 @@ class NativeAggregator(Aggregator):
             set_rho=self._s_rho.copy(),
             histo_slot=self._h_slot.copy(), histo_val=self._h_val.copy(),
             histo_wt=self._h_wt.copy(),
+            histo_stat_slot=self._hs_slot, histo_stat_min=self._hs_min,
+            histo_stat_max=self._hs_max, histo_stat_recip=self._hs_recip,
         )
         self._on_batch(batch)
 

@@ -46,6 +46,68 @@ def per_shard_spec(spec: TableSpec, n_shards: int) -> TableSpec:
         histo_capacity=spec.histo_capacity // n_shards)
 
 
+def _gather_sharded_impl(out, cidx, gidx, stidx, setidx, hidx):
+    """Live-row gather over the merged flush's [S, K_per] dense arrays
+    (global KeyTable slots are flat indices by construction), packed into
+    one flat f32 array — one device->host transfer per flush, same as
+    the single-device flush_live_packed."""
+    import jax.numpy as jnp
+    which = {"counter_hi": cidx, "counter_lo": cidx, "gauge": gidx,
+             "status": stidx, "set_estimate": setidx}
+
+    def take(key, a):
+        flat = a.reshape((-1,) + a.shape[2:])
+        return jnp.take(flat, which.get(key, hidx), axis=0, mode="clip")
+
+    return jnp.concatenate([take(k, out[k]).reshape(-1).astype(jnp.float32)
+                            for k in sorted(out)])
+
+
+def _gather_sharded_raw_impl(st, setidx, hidx):
+    """Raw sketch state of live rows, packed like the flush gather (one
+    transfer; uint8 HLL rows ride as bitcast f32 words)."""
+    import jax
+    import jax.numpy as jnp
+
+    def take(x, i):
+        flat = x.reshape((-1,) + x.shape[3:])   # drop [R=1, S]
+        return jnp.take(flat, i, axis=0, mode="clip")
+
+    w = take(st.h_w, hidx)
+    out = {
+        "hll": take(st.hll, setidx),
+        "h_weight": w,
+        "h_mean": take(st.h_wm, hidx) / jnp.maximum(w, 1e-30),
+        "h_min": take(st.h_min, hidx),
+        "h_max": take(st.h_max, hidx),
+        "recip_hi": take(st.h_recip_hi, hidx),
+        "recip_lo": take(st.h_recip_lo, hidx) + take(st.h_recip_acc, hidx),
+    }
+    parts = []
+    for k in sorted(out):
+        a = out[k]
+        if a.dtype == jnp.uint8:
+            a = jax.lax.bitcast_convert_type(a.reshape((-1, 4)),
+                                             jnp.float32)
+        parts.append(a.reshape(-1).astype(jnp.float32))
+    return jnp.concatenate(parts)
+
+
+def _sharded_raw_shapes(pspec, n_set, n_h):
+    cells = pspec.centroids + pspec.temp_cells
+    f32 = "float32"
+    return {"hll": ((n_set, pspec.registers), "uint8"),
+            "h_weight": ((n_h, cells), f32), "h_mean": ((n_h, cells), f32),
+            "h_min": ((n_h,), f32), "h_max": ((n_h,), f32),
+            "recip_hi": ((n_h,), f32), "recip_lo": ((n_h,), f32)}
+
+
+import jax as _jax
+
+_gather_sharded = _jax.jit(_gather_sharded_impl)
+_gather_sharded_raw = _jax.jit(_gather_sharded_raw_impl)
+
+
 class ShardedAggregator(Aggregator):
     def __init__(self, spec: TableSpec, bspec: BatchSpec = BatchSpec(),
                  n_shards: int = 2, compact_every: int = 8):
@@ -208,30 +270,45 @@ class ShardedAggregator(Aggregator):
                       want_raw: bool = False):
         import jax.numpy as jnp
 
-        from veneur_tpu.aggregation.step import finish_flush
+        from veneur_tpu.aggregation.step import (
+            combine_flush_scalars, flush_live_shapes, live_indices,
+            unpack_flush)
 
         qs = jnp.asarray(percentiles or [0.5], jnp.float32)
-        # flatten [S, K_per] -> [S*K_per]: matches KeyTable's global slots
-        result = {k: v.reshape((-1,) + v.shape[2:])
-                  for k, v in finish_flush(self._flush(state, qs)).items()}
-        if want_raw:
-            def flat(x, extra=()):
-                a = np.asarray(x)
-                return a.reshape((-1,) + a.shape[3:])  # drop [R=1, S]
+        # live-slot gather AFTER the merged flush (same O(live) host
+        # boundary as the single-device flush_live): the KeyTable's
+        # global slot numbers ARE flat indices into the [S, K_per]
+        # reshape by construction (slot = shard * per_shard + local)
+        idx = {kind: jnp.asarray(live_indices(table, kind, cap))
+               for kind, cap in (("counter", self.spec.counter_capacity),
+                                 ("gauge", self.spec.gauge_capacity),
+                                 ("status", self.spec.status_capacity),
+                                 ("set", self.spec.set_capacity),
+                                 ("histogram", self.spec.histo_capacity))}
 
-            w = flat(state.h_w)
-            wm = flat(state.h_wm)
+        packed = np.asarray(_gather_sharded(
+            self._flush(state, qs), idx["counter"], idx["gauge"],
+            idx["status"], idx["set"], idx["histogram"]))
+        out = unpack_flush(packed, flush_live_shapes(
+            self.pspec, len(idx["counter"]), len(idx["gauge"]),
+            len(idx["status"]), len(idx["set"]), len(idx["histogram"]),
+            len(qs)))
+        result = combine_flush_scalars(out)
+        if want_raw:
+            from veneur_tpu.aggregation.step import unpack_flush as _unpack
+            r = _unpack(np.asarray(_gather_sharded_raw(
+                state, idx["set"], idx["histogram"])),
+                _sharded_raw_shapes(self.pspec, len(idx["set"]),
+                                    len(idx["histogram"])))
             raw = {
                 "counter": result["counter"],
                 "gauge": result["gauge"],
-                "hll": np.asarray(state.hll).reshape(
-                    (-1, self.pspec.registers)),
-                "h_mean": np.where(w > 0, wm / np.maximum(w, 1e-30), 0.0),
-                "h_weight": w,
-                "h_min": flat(state.h_min),
-                "h_max": flat(state.h_max),
-                "h_recip": flat(state.h_recip_hi).astype(np.float64)
-                + flat(state.h_recip_lo) + flat(state.h_recip_acc),
+                "hll": r["hll"],
+                "h_mean": r["h_mean"],
+                "h_weight": r["h_weight"],
+                "h_min": r["h_min"],
+                "h_max": r["h_max"],
+                "h_recip": r["recip_hi"].astype(np.float64) + r["recip_lo"],
             }
             return result, table, raw
         return result, table
