@@ -57,3 +57,19 @@ def test_is_local():
 def test_omit_empty_hostname():
     cfg = read_config(io.StringIO("omit_empty_hostname: true\n"))
     assert cfg.hostname == ""
+
+
+def test_example_yaml_is_strictly_valid():
+    """example.yaml is the canonical config documentation (the reference
+    keeps example.yaml at the repo root the same way) — it must parse
+    with zero unknown keys so it can't drift from the Config surface."""
+    import os
+    from veneur_tpu.config import read_config
+    from veneur_tpu.config_proxy import read_proxy_config
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cfg = read_config(os.path.join(root, "example.yaml"), env={})
+    assert cfg.unknown_keys == []
+    assert cfg.parse_interval() == 10.0
+    pcfg = read_proxy_config(os.path.join(root, "example_proxy.yaml"),
+                             env={})
+    assert pcfg.unknown_keys == []
