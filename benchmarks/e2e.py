@@ -154,8 +154,9 @@ def config1_counter_replay(scale=1.0):
             b"replay.counter.%d:1|c" % n for n in ns))
     total = datagrams * lines_per
 
+    n_senders = 4
     srv = _mk_server([BlackholeMetricSink()], udp=True,
-                     tpu_counter_capacity=1 << 14)
+                     tpu_counter_capacity=1 << 14, num_readers=n_senders)
     try:
         addr = srv.local_addr()
         # warm the compiled path so the timed region is steady-state;
@@ -163,18 +164,31 @@ def config1_counter_replay(scale=1.0):
         # run's true cardinality bucket (reference benchmarks loop b.N
         # times for the same reason)
         _warm(srv, [b"replay.counter.0:1|c"])
-        sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+
+        # many-clients traffic model (the reference's veneur-emit replay
+        # fleet): each sender thread has its own socket, so distinct
+        # 4-tuples hash across the SO_REUSEPORT reader group
+        def send_slice(chunk):
+            s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            for p in chunk:
+                s.sendto(p, addr)
+            s.close()
+
         for cycle in range(2):
             base = srv.aggregator.processed
             t0 = time.perf_counter()
-            for p in payloads:
-                sock.sendto(p, addr)
+            threads = [threading.Thread(
+                target=send_slice, args=(payloads[i::n_senders],))
+                for i in range(n_senders)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
             done = _drain(srv, base + total) - base
             # cycle 0 pays the size-bucket flush compile
             _flush_checked(srv, timeout=WARM_TIMEOUT if cycle == 0
                            else FLUSH_WAIT)
             dt = time.perf_counter() - t0
-        sock.close()
 
         processed = srv.aggregator.processed - base
         return {

@@ -207,6 +207,14 @@ def test_hash_ring_stability_and_keep_last_good():
     assert p._ring.destinations == ["a:1", "b:1"]
 
 
+class _FakeResp(__import__("io").BytesIO):
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
 def test_consul_discoverer_parses_health_json():
     import io
     import json
@@ -217,21 +225,59 @@ def test_consul_discoverer_parses_health_json():
          "Service": {"Address": "", "Port": 8128}},
     ]
 
-    class FakeResp(io.BytesIO):
-        def __enter__(self):
-            return self
-
-        def __exit__(self, *a):
-            return False
-
     from veneur_tpu.forward.discovery import ConsulDiscoverer
     seen = {}
 
     def opener(url, timeout=0):
         seen["url"] = url
-        return FakeResp(json.dumps(payload).encode())
+        return _FakeResp(json.dumps(payload).encode())
 
     d = ConsulDiscoverer("http://consul:8500", opener=opener)
     dests = d.get_destinations_for_service("veneur-global")
     assert dests == ["10.1.1.1:8128", "10.0.0.2:8128"]
     assert "health/service/veneur-global?passing" in seen["url"]
+
+
+def test_consul_discoverer_reference_fixtures():
+    """The reference's recorded Consul health responses
+    (testdata/consul/health_service_{one,two,zero}.json, used by its
+    consul_discovery_test.go ring-refresh tests) parse to the same
+    destinations, including the zero-instance case that triggers
+    keep-last-good."""
+    import io
+    import os
+
+    from veneur_tpu.forward.discovery import ConsulDiscoverer
+    from veneur_tpu.forward.proxysrv import ProxyServer
+
+    here = os.path.join(os.path.dirname(__file__), "testdata", "consul")
+
+    responses = {}
+
+    def opener(url, timeout=0):
+        return _FakeResp(open(os.path.join(
+            here, responses["next"] + ".json"), "rb").read())
+
+    d = ConsulDiscoverer("http://consul:8500", opener=opener)
+    responses["next"] = "health_service_one"
+    assert d.get_destinations_for_service("veneur-global") == [
+        "10.1.10.12:8000"]
+    responses["next"] = "health_service_two"
+    assert d.get_destinations_for_service("veneur-global") == [
+        "10.1.10.12:8000", "10.1.10.13:8000"]
+    responses["next"] = "health_service_zero"
+    assert d.get_destinations_for_service("veneur-global") == []
+
+    # ring refresh across the recorded sequence: grow, then keep-last-good
+    # on the zero response (reference proxy.go:498-508)
+    p = ProxyServer(d)
+    responses["next"] = "health_service_one"
+    p.refresh()
+    assert p._ring.get(b"anything") == "10.1.10.12:8000"
+    responses["next"] = "health_service_two"
+    p.refresh()
+    assert set(p._ring.get(b"k%d" % i) for i in range(64)) == {
+        "10.1.10.12:8000", "10.1.10.13:8000"}
+    responses["next"] = "health_service_zero"
+    p.refresh()
+    assert p._ring.get(b"anything") is not None  # last good kept

@@ -148,3 +148,21 @@ def test_ssf_unixgram_and_stream(tmp_path):
         assert {s_.name for s_ in ssink.spans} >= {"op1", "op2"}
     finally:
         srv.shutdown()
+
+
+def test_reuseport_reader_group_shares_one_port():
+    """num_readers > 1 with a :0 address must bind ONE concrete port for
+    the whole SO_REUSEPORT group (regression: re-binding port 0 per
+    reader gave N distinct ephemeral ports and zero kernel sharding;
+    reference networking.go:44-55 resolves the address once)."""
+    srv = Server(small_config(num_readers=4), metric_sinks=[DebugMetricSink()])
+    srv.start()
+    try:
+        udp_ports = {s.getsockname()[1] for s in srv._sockets
+                     if s.type == socket.SOCK_DGRAM}
+        assert len(udp_ports) == 1
+        n_udp = sum(1 for s in srv._sockets
+                    if s.type == socket.SOCK_DGRAM)
+        assert n_udp == 4
+    finally:
+        srv.shutdown()

@@ -591,12 +591,19 @@ class Server:
         for addr in self.cfg.statsd_listen_addresses:
             kind, target = resolve_addr(addr)
             if kind == "udp":
-                for _ in range(max(1, self.cfg.num_readers)):
+                for reader_i in range(max(1, self.cfg.num_readers)):
                     sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
                     if self.cfg.num_readers > 1 and hasattr(
                             socket, "SO_REUSEPORT"):
                         sock.setsockopt(socket.SOL_SOCKET,
                                         socket.SO_REUSEPORT, 1)
+                        # a :0 address must resolve ONCE: re-binding port
+                        # 0 per reader yields N distinct ephemeral ports
+                        # and no kernel sharding (reference
+                        # networking.go:44-55 reuses the first socket's
+                        # concrete address for the rest of the group)
+                        if reader_i == 1 and target[1] == 0:
+                            target = self._sockets[-1].getsockname()
                     if self.cfg.read_buffer_size_bytes > 0:
                         sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF,
                                         self.cfg.read_buffer_size_bytes)
