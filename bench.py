@@ -61,6 +61,22 @@ def digest_accuracy(jnp, state, spec, batches, uses, flush_compute):
 
 def main():
     steps = int(os.environ.get("BENCH_STEPS", "100"))
+    # A wedged accelerator tunnel hangs backend init forever; fail fast
+    # with a diagnostic line instead of hanging the driver.
+    import threading
+    init_budget = float(os.environ.get("BENCH_INIT_TIMEOUT", "600"))
+
+    def _init_watchdog():
+        print(json.dumps({
+            "metric": "aggregation_samples_per_sec_per_chip_1M_keys",
+            "value": 0, "unit": "samples/sec", "vs_baseline": 0,
+            "error": f"device backend init exceeded {init_budget:.0f}s "
+                     "(accelerator tunnel down?)"}), flush=True)
+        os._exit(2)
+
+    timer = threading.Timer(init_budget, _init_watchdog)
+    timer.daemon = True
+    timer.start()
     import jax
     import jax.numpy as jnp
     from veneur_tpu.aggregation.state import TableSpec, empty_state
@@ -68,6 +84,7 @@ def main():
         Batch, compact, flush_compute, fold_scalars, ingest_step)
 
     dev = jax.devices()[0]
+    timer.cancel()   # backend is up; the run itself is bounded by steps
     on_tpu = dev.platform != "cpu"
     if not on_tpu:
         # CPU smoke-mode: tiny shapes so the harness stays runnable anywhere

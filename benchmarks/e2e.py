@@ -168,11 +168,17 @@ def config1_counter_replay(scale=1.0):
         # many-clients traffic model (the reference's veneur-emit replay
         # fleet): each sender thread has its own socket, so distinct
         # 4-tuples hash across the SO_REUSEPORT reader group
+        send_errors = []
+
         def send_slice(chunk):
             s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-            for p in chunk:
-                s.sendto(p, addr)
-            s.close()
+            try:
+                for p in chunk:
+                    s.sendto(p, addr)
+            except OSError as e:
+                send_errors.append(e)
+            finally:
+                s.close()
 
         for cycle in range(2):
             base = srv.aggregator.processed
@@ -184,6 +190,8 @@ def config1_counter_replay(scale=1.0):
                 t.start()
             for t in threads:
                 t.join()
+            if send_errors:
+                raise RuntimeError(f"sender failed: {send_errors[0]}")
             done = _drain(srv, base + total) - base
             # cycle 0 pays the size-bucket flush compile
             _flush_checked(srv, timeout=WARM_TIMEOUT if cycle == 0

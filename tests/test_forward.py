@@ -255,8 +255,9 @@ def test_consul_discoverer_reference_fixtures():
     responses = {}
 
     def opener(url, timeout=0):
-        return _FakeResp(open(os.path.join(
-            here, responses["next"] + ".json"), "rb").read())
+        with open(os.path.join(here, responses["next"] + ".json"),
+                  "rb") as f:
+            return _FakeResp(f.read())
 
     d = ConsulDiscoverer("http://consul:8500", opener=opener)
     responses["next"] = "health_service_one"

@@ -144,3 +144,28 @@ def test_self_telemetry_loop():
         assert "veneur.worker.metrics_processed_total" in names
     finally:
         srv.shutdown()
+
+
+def test_debug_pprof_endpoints(http_server):
+    """The reference always mounts pprof on the HTTP mux (http.go:51-56);
+    the Python analogues are a thread dump and a sampling profile."""
+    srv, _ = http_server
+    code, body = _get(srv, "/debug/pprof/threads")
+    assert code == 200
+    assert b"--- thread" in body
+    code, body = _get(srv, "/debug/pprof/profile?seconds=0.3")
+    assert code == 200
+    assert b"samples over" in body
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(srv, "/debug/pprof/profile?seconds=abc")
+    assert e.value.code == 400
+
+
+def test_debug_pprof_profile_rejects_bad_paths_and_nan(http_server):
+    srv, _ = http_server
+    for path in ("/debug/pprof/profilez", "/debug/pprof/profile/cpu",
+                 "/debug/pprof/profile?seconds=nan",
+                 "/debug/pprof/profile?seconds=-1"):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(srv, path)
+        assert e.value.code in (400, 404), path

@@ -32,6 +32,48 @@ log = logging.getLogger("veneur_tpu.server.http")
 BUILD_DATE = "dev"
 
 
+def _thread_dump() -> bytes:
+    """Stacks of every live thread (the operational half of the
+    reference's always-mounted pprof endpoints, http.go:51-56)."""
+    import sys
+    import threading
+    import traceback
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = []
+    for ident, frame in sys._current_frames().items():
+        out.append(f"--- thread {names.get(ident, '?')} ({ident}) ---")
+        out.extend(line.rstrip()
+                   for line in traceback.format_stack(frame))
+    return ("\n".join(out) + "\n").encode()
+
+
+def _sample_profile(seconds: float, hz: float = 97.0) -> bytes:
+    """Statistical CPU profile: sample every thread's innermost frames at
+    ~hz for `seconds`, report hottest (function, file:line) sites — the
+    Python analogue of `GET /debug/pprof/profile?seconds=N`."""
+    import sys
+    import time as _time
+    from collections import Counter
+    counts: Counter = Counter()
+    samples = 0
+    deadline = _time.monotonic() + seconds
+    period = 1.0 / hz
+    me = __import__("threading").get_ident()
+    while _time.monotonic() < deadline:
+        for ident, frame in sys._current_frames().items():
+            if ident == me:
+                continue
+            co = frame.f_code
+            counts[(co.co_name, f"{co.co_filename}:{frame.f_lineno}")] += 1
+        samples += 1
+        _time.sleep(period)
+    lines = [f"{samples} samples over {seconds:.1f}s "
+             f"({hz:.0f}Hz, innermost frame per thread)"]
+    for (fn, loc), n in counts.most_common(40):
+        lines.append(f"{n / max(samples, 1) * 100:6.1f}%  {fn}  {loc}")
+    return ("\n".join(lines) + "\n").encode()
+
+
 def start_http_server(server, address) -> "http.server.ThreadingHTTPServer":
     """Mount the API for a veneur_tpu.server.Server; returns the running
     ThreadingHTTPServer (its .server_address has the bound port)."""
@@ -65,6 +107,25 @@ def start_http_server(server, address) -> "http.server.ThreadingHTTPServer":
                     "spans_dropped": server.span_pipeline.spans_dropped,
                 }).encode()
                 self._reply(200, body, "application/json")
+            elif self.path == "/debug/pprof/threads":
+                self._reply(200, _thread_dump(), "text/plain")
+            elif self.path.startswith("/debug/pprof/profile"):
+                import math
+                from urllib.parse import parse_qs, urlparse
+                parsed = urlparse(self.path)
+                if parsed.path != "/debug/pprof/profile":
+                    self._reply(404, b"not found")
+                    return
+                q = parse_qs(parsed.query)
+                try:
+                    seconds = float(q.get("seconds", ["5"])[0])
+                except ValueError:
+                    seconds = float("nan")
+                if not math.isfinite(seconds) or seconds <= 0:
+                    self._reply(400, b"bad seconds")
+                    return
+                self._reply(200, _sample_profile(min(seconds, 60.0)),
+                            "text/plain")
             elif self.path == "/quitquitquit" and server.cfg.http_quit:
                 self._quit()
             else:
