@@ -406,3 +406,50 @@ def test_shutdown_with_inflight_flush_is_clean(server):
     import threading
     for t in [srv._pipeline_thread, srv._flush_thread] + srv._threads:
         assert not t.is_alive(), f"thread {t.name} survived shutdown"
+
+
+def test_stats_address_mirrors_self_metrics():
+    """stats_address sends self-metrics to an external statsd daemon as
+    DogStatsD lines (server.go:297 statsd.New(conf.StatsAddress))."""
+    ext = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    ext.bind(("127.0.0.1", 0))
+    ext.settimeout(5.0)
+    srv = Server(small_config(
+        stats_address=f"127.0.0.1:{ext.getsockname()[1]}"),
+        metric_sinks=[DebugMetricSink()])
+    srv.start()
+    try:
+        _send_udp(srv.local_addr(), [b"sa.count:1|c"])
+        _wait_processed(srv, 1)
+        assert srv.trigger_flush()
+        got = b""
+        deadline = time.time() + 5
+        while time.time() < deadline and b"veneur." not in got:
+            try:
+                got += ext.recv(65536) + b"\n"
+            except socket.timeout:
+                break
+        assert b"veneur.worker.metrics_processed_total" in got
+        assert b"|c" in got
+    finally:
+        srv.shutdown()
+        ext.close()
+
+
+def test_synchronized_ticker_aligns_first_flush():
+    """synchronize_with_interval delays the first tick to a wall-clock
+    multiple of the interval (server.go:866-870 CalculateTickDelay)."""
+    srv = Server(small_config(interval="1s",
+                              synchronize_with_interval=True),
+                 metric_sinks=[DebugMetricSink()])
+    srv.start()
+    try:
+        deadline = time.time() + 5
+        while time.time() < deadline and srv.flush_count == 0:
+            time.sleep(0.02)
+        assert srv.flush_count > 0
+        # the tick fired within ~150ms of a whole-second boundary
+        frac = srv.last_flush % 1.0
+        assert frac < 0.25 or frac > 0.75, frac
+    finally:
+        srv.shutdown()

@@ -78,6 +78,9 @@ class Config:
     omit_empty_hostname: bool = False
     tags: List[str] = dataclasses.field(default_factory=list)
     tags_exclude: List[str] = dataclasses.field(default_factory=list)
+    # Go-runtime profiling knobs (server.go:331-344): accepted so
+    # reference YAML loads cleanly, but they have no Python equivalent —
+    # use /debug/pprof/profile (sampling) instead
     mutex_profile_fraction: int = 0
     block_profile_rate: int = 0
     sentry_dsn: str = ""

@@ -80,6 +80,7 @@ def new_from_config(cfg: Config, extra_metric_sinks=(), extra_span_sinks=(),
         from veneur_tpu.sinks.splunk import SplunkSpanSink
         span_sinks.append(SplunkSpanSink(
             hec_address=cfg.splunk_hec_address,
+            tls_validate_hostname=cfg.splunk_hec_tls_validate_hostname,
             token=cfg.splunk_hec_token,
             hostname=cfg.hostname,
             batch_size=cfg.splunk_hec_batch_size,

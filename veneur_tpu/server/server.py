@@ -227,6 +227,8 @@ class Server:
         self.import_errors = 0
         self.packets_received = 0
         self._shutdown = threading.Event()
+        self._stats_sock: Optional[socket.socket] = None
+        self._stats_dest = None
         self._unix_locks: List[tuple] = []   # (lock_fd, lock_path, sock_path)
         self._threads: List[threading.Thread] = []
         self._pipeline_thread: Optional[threading.Thread] = None
@@ -417,10 +419,11 @@ class Server:
         """One SSF span protobuf per datagram (server.go:1125
         ReadSSFPacketSocket -> HandleTracePacket)."""
         from veneur_tpu.protocol.wire import parse_ssf
+        bufsize = self.cfg.trace_max_length_bytes or MAX_UDP_SSF
         sock.settimeout(0.5)
         while not self._shutdown.is_set():
             try:
-                data = sock.recv(MAX_UDP_SSF)
+                data = sock.recv(bufsize)
             except socket.timeout:
                 continue
             except OSError:
@@ -749,6 +752,14 @@ class Server:
 
     # -- flush orchestration ------------------------------------------------
     def _flush_ticker(self):
+        if self.cfg.synchronize_with_interval:
+            # align the first tick to a wall-clock multiple of the
+            # interval for downstream bucketing convenience
+            # (server.go:866-870 CalculateTickDelay)
+            delay = self.interval - (time.time() % self.interval)
+            if self._shutdown.wait(delay):
+                return
+            self.trigger_flush(wait=False)
         while not self._shutdown.wait(self.interval):
             self.trigger_flush(wait=False)
 
@@ -919,6 +930,44 @@ class Server:
                 samples.append(ssf_samples.count(name, delta))
         self._normalize_self_samples(samples)
         report_batch(self.trace_client, samples)
+        self._emit_stats_address(samples)
+
+    def _emit_stats_address(self, samples) -> None:
+        """Mirror self-metrics to an external statsd daemon when
+        stats_address is configured (reference server.go:297 statsd.New +
+        scopedstatsd — operators often point this at a plain DogStatsD
+        agent, separate from the in-pipeline loop-back)."""
+        if not self.cfg.stats_address:
+            return
+        from veneur_tpu.proto import ssf_pb2
+        type_ch = {ssf_pb2.SSFSample.COUNTER: b"c",
+                   ssf_pb2.SSFSample.GAUGE: b"g",
+                   ssf_pb2.SSFSample.HISTOGRAM: b"h"}
+        try:
+            if self._stats_sock is None:
+                # resolve + create once (reference dials its statsd
+                # client at construction, server.go:297)
+                host, _, port = self.cfg.stats_address.rpartition(":")
+                self._stats_dest = (host or "127.0.0.1", int(port))
+                self._stats_sock = socket.socket(socket.AF_INET,
+                                                 socket.SOCK_DGRAM)
+            lines = []
+            for s in samples:
+                ch = type_ch.get(s.metric)
+                if ch is None:
+                    continue
+                tags = ",".join(f"{k}:{v}" if v else k
+                                for k, v in sorted(s.tags.items()))
+                line = b"%s:%s|%s" % (s.name.encode(),
+                                      repr(float(s.value)).encode(), ch)
+                if tags:
+                    line += b"|#" + tags.encode()
+                lines.append(line)
+            for i in range(0, len(lines), 25):
+                self._stats_sock.sendto(b"\n".join(lines[i:i + 25]),
+                                        self._stats_dest)
+        except (OSError, ValueError) as e:
+            log.warning("stats_address emit failed: %s", e)
 
     def _normalize_self_samples(self, samples):
         """veneur_metrics_scopes / veneur_metrics_additional_tags applied

@@ -24,9 +24,15 @@ class SplunkSpanSink(SpanSink):
 
     def __init__(self, hec_address: str, token: str, hostname: str,
                  batch_size: int = 100, sample_rate: int = 1,
-                 send_timeout: float = 10.0):
+                 send_timeout: float = 10.0,
+                 tls_validate_hostname: str = ""):
         self.url = hec_address.rstrip("/") + "/services/collector/event"
         self.token = token
+        # splunk_hec_tls_validate_hostname (splunk.go): HEC endpoints
+        # commonly present certs for a name other than the URL host; the
+        # TLS handshake validates the chain AND the certificate against
+        # this pinned name (never verification-off)
+        self._pinned_hostname = tls_validate_hostname or None
         self.hostname = hostname
         self.batch_size = batch_size
         # keep 1-in-N traces (splunk.go splunk_span_sample_rate)
@@ -79,14 +85,45 @@ class SplunkSpanSink(SpanSink):
     def _submit(self, batch: List[dict]):
         # HEC wants newline-delimited event JSON objects
         body = "\n".join(json.dumps(e) for e in batch).encode()
-        req = urllib.request.Request(
-            self.url, data=body, method="POST",
-            headers={"Authorization": f"Splunk {self.token}",
-                     "Content-Type": "application/json"})
+        headers = {"Authorization": f"Splunk {self.token}",
+                   "Content-Type": "application/json"}
         try:
-            with urllib.request.urlopen(req,
-                                        timeout=self.send_timeout) as resp:
-                resp.read()
+            if self._pinned_hostname:
+                self._post_pinned(body, headers)
+            else:
+                req = urllib.request.Request(
+                    self.url, data=body, method="POST", headers=headers)
+                with urllib.request.urlopen(
+                        req, timeout=self.send_timeout) as resp:
+                    resp.read()
             self.submitted += len(batch)
         except Exception as e:
             log.error("splunk HEC submit failed: %s", e)
+
+    def _post_pinned(self, body: bytes, headers: dict) -> None:
+        """POST over TLS validated against the pinned hostname: the
+        handshake uses the pin as server_hostname, so the standard
+        verification path (chain + name match) enforces it."""
+        import http.client
+        import socket
+        import ssl
+        from urllib.parse import urlparse
+        u = urlparse(self.url)
+        ctx = ssl.create_default_context()
+        raw = socket.create_connection(
+            (u.hostname, u.port or 443), timeout=self.send_timeout)
+        try:
+            tls = ctx.wrap_socket(raw,
+                                  server_hostname=self._pinned_hostname)
+        except BaseException:
+            raw.close()
+            raise
+        conn = http.client.HTTPConnection(u.hostname, u.port or 443,
+                                          timeout=self.send_timeout)
+        conn.sock = tls
+        try:
+            path = u.path or "/"
+            conn.request("POST", path, body, headers)
+            conn.getresponse().read()
+        finally:
+            conn.close()
