@@ -93,12 +93,14 @@ def test_udp_ingest_to_flush(server):
     assert "a.timer.50percentile" in m
     assert m["a.set"].value == pytest.approx(2.0, abs=0.1)
     assert _total_parse_errors(srv) == 1
-    # flush resets the interval state (self-telemetry veneur.* metrics may
-    # ride later intervals; only app metrics must be gone)
+    # flush resets the interval state (self-telemetry veneur.* / ssf.*
+    # metrics may ride later intervals — flush-stage spans loop back through
+    # the span pipeline; only app metrics must be gone)
     sink.flushed.clear()
     srv.trigger_flush()
     assert not [m for m in sink.flushed
-                if not m.name.startswith("veneur.")]
+                if not (m.name.startswith("veneur.")
+                        or m.name == "ssf.names_unique")]
 
 
 def test_sample_rate_and_magic_tags(server):
