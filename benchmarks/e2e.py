@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import socket
 import sys
 import threading
@@ -99,18 +100,17 @@ def _feed_queue(srv, payloads):
 
 
 def _warm(srv, lines, sinks=()):
-    """Compile everything the timed region will run — ingest step, state
-    swap, flush math — before t0. Shapes are set by the table/batch
-    capacities (static per config), so one sample per metric type compiles
-    the same programs the real load uses. Clears sink capture buffers so
-    warm-up artifacts don't pollute accuracy checks."""
+    """Prove the pipeline is live before t0. Deliberately does NOT flush:
+    a warm-up flush at near-empty live counts would compile a flush
+    program for a smaller size bucket than the real load's, and a third
+    resident executable drops the tunneled backend into its slow
+    per-dispatch mode (see step.py ingest_step_packed). Each config's
+    cycle 0 is untimed-in-spirit and absorbs every compile at the TRUE
+    buckets; cycle 1 is the steady state."""
     base = srv.aggregator.processed
     for ln in lines:
         srv.packet_queue.put(ln)
     _drain(srv, base + len(lines), timeout=WARM_TIMEOUT)
-    ok = srv.trigger_flush(timeout=WARM_TIMEOUT)
-    if not ok:
-        raise RuntimeError("warm-up flush did not complete (compile stall?)")
     for s in sinks:
         s.flushed.clear()
 
@@ -155,8 +155,12 @@ def config1_counter_replay(scale=1.0):
     total = datagrams * lines_per
 
     n_senders = 4
+    # big staging lanes: dispatch count is the scarce resource on a
+    # tunneled chip (each dispatch pays an RTT), and large batches are
+    # the grain the device wants anyway
     srv = _mk_server([BlackholeMetricSink()], udp=True,
-                     tpu_counter_capacity=1 << 14, num_readers=n_senders)
+                     tpu_counter_capacity=1 << 14, num_readers=n_senders,
+                     tpu_batch_counter=1 << 16)
     try:
         addr = srv.local_addr()
         # warm the compiled path so the timed region is steady-state;
@@ -240,7 +244,7 @@ def config2_zipf_timers(scale=1.0):
 
     sink = DebugMetricSink()
     srv = _mk_server([sink], tpu_histo_capacity=1 << 17,
-                     tpu_batch_histo=1 << 14)
+                     tpu_batch_histo=1 << 16, tpu_compact_every=2)
     try:
         _warm(srv, [b"warm.t:1.0|ms"], sinks=[sink])
         for cycle in range(2):   # first cycle compiles the size bucket
@@ -304,7 +308,7 @@ def config3_set_cardinality(scale=1.0):
                 for i in range(0, len(lines), per)]
 
     sink = DebugMetricSink()
-    srv = _mk_server([sink], tpu_set_capacity=16, tpu_batch_set=1 << 13)
+    srv = _mk_server([sink], tpu_set_capacity=16, tpu_batch_set=1 << 15)
     try:
         _warm(srv, [b"warm.s:uid-w|s"], sinks=[sink])
         for cycle in range(2):   # first cycle compiles the size bucket
@@ -386,7 +390,9 @@ def config4_global_merge(scale=1.0):
                       tpu_counter_capacity=1 << 12,
                       tpu_histo_capacity=1 << 9)
     try:
-        # warm the global's ingest+flush compile with throwaway keys
+        # prove the global's pipeline is live; cycle 0 absorbs the
+        # ingest+flush compiles at the true size buckets (_warm no longer
+        # flushes -- see its docstring)
         _warm(glob, [b"warm.c:1|c", b"warm.t:1.0|ms"], sinks=[sink])
         client = ForwardClient(f"127.0.0.1:{glob.grpc_port}")
         n_metrics = sum(len(e) for e in exports)
@@ -519,15 +525,92 @@ CONFIGS = {1: config1_counter_replay, 2: config2_zipf_timers,
            3: config3_set_cardinality, 4: config4_global_merge,
            5: config5_span_firehose}
 
+# Per-config subprocess budget: backend init + first XLA compiles of the
+# config's size buckets (~tens of seconds each on the tunneled chip) +
+# the run itself.
+SUBPROC_TIMEOUT = float(os.environ.get("E2E_CONFIG_TIMEOUT", "1500"))
+# Backend-init budget inside each child (mirrors bench.py's kernel-stage
+# watchdog): a wedged accelerator tunnel hangs client creation forever;
+# fail fast with a diagnostic instead of burning SUBPROC_TIMEOUT x 5.
+INIT_TIMEOUT = float(os.environ.get("BENCH_INIT_TIMEOUT", "600"))
 
-def main(configs=None, scale=None):
-    import jax
-    if scale is None:
+
+def parse_last_json_line(stdout: str):
+    """Last '{'-prefixed stdout line as a dict, or None (shared by this
+    orchestrator and bench.py so truncation handling can't diverge)."""
+    for line in reversed(stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except ValueError:
+                return None  # truncated tail from a killed child
+    return None
+
+
+def _arm_init_watchdog(diag: dict):
+    """os._exit(2) with one JSON diagnostic line if the backend doesn't
+    come up inside INIT_TIMEOUT. Returns the timer to cancel on success."""
+    import threading
+
+    def _fire():
+        print(json.dumps(dict(diag, error=(
+            f"device backend init exceeded {INIT_TIMEOUT:.0f}s "
+            "(accelerator tunnel down?)"))), flush=True)
+        os._exit(2)
+
+    t = threading.Timer(INIT_TIMEOUT, _fire)
+    t.daemon = True
+    t.start()
+    return t
+
+
+def _run_config_subprocess(n, scale):
+    """One config per subprocess. Two reasons: (a) the reference's own
+    perf story is per-benchmark processes (`go test -bench` spawns a
+    fresh process per package), and (b) the tunneled single-chip backend
+    permanently degrades to a slow per-dispatch mode once a process has
+    run more than two distinct executables — five configs with five
+    distinct table specs in one process measure the degraded mode, not
+    the pipeline."""
+    import subprocess
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cmd = [sys.executable, "-m", "benchmarks.e2e",
+           "--config", str(n), "--in-process"]
+    if scale is not None:
+        cmd += ["--scale", str(scale)]
+    # scale=None is resolved by the CHILD (where jax.devices() is safe);
+    # resolving it here would initialize the backend in the parent and
+    # block every child from acquiring the single tunneled chip
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              cwd=repo, timeout=SUBPROC_TIMEOUT)
+    except subprocess.TimeoutExpired:
+        return {"config": n, "error": f"timeout after {SUBPROC_TIMEOUT:.0f}s"}
+    parsed = parse_last_json_line(proc.stdout)
+    if parsed is not None:
+        return parsed
+    return {"config": n, "error":
+            f"rc={proc.returncode}: {proc.stderr.strip()[-400:]}"}
+
+
+def main(configs=None, scale=None, in_process=False):
+    if in_process:
+        # only the in-process (child) path may touch the backend; the
+        # subprocess orchestrator must stay off the chip entirely
+        watchdog = _arm_init_watchdog(
+            {"config": sorted(configs or CONFIGS)[0]})
+        import jax
         on_tpu = jax.devices()[0].platform != "cpu"
-        scale = 1.0 if on_tpu else 0.02
+        watchdog.cancel()
+        if scale is None:
+            scale = 1.0 if on_tpu else 0.02
     results = []
     for n in sorted(configs or CONFIGS):
-        results.append(CONFIGS[n](scale))
+        if in_process:
+            results.append(CONFIGS[n](scale))
+        else:
+            results.append(_run_config_subprocess(n, scale))
     return results
 
 
@@ -537,6 +620,9 @@ if __name__ == "__main__":
     ap.add_argument("--config", type=int, action="append",
                     help="config number 1-5 (repeatable; default all)")
     ap.add_argument("--scale", type=float, default=None)
+    ap.add_argument("--in-process", action="store_true",
+                    help="run configs in this process instead of one "
+                         "subprocess per config")
     args = ap.parse_args()
-    for r in main(args.config, args.scale):
+    for r in main(args.config, args.scale, in_process=args.in_process):
         print(json.dumps(r))

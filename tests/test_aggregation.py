@@ -328,3 +328,80 @@ def test_counter_error_bound_documented_envelope():
     out = _flush_full(state, np.array([0.5], np.float32), spec=SPEC)
     got = float(out["counter"][0])
     assert abs(got - exact) / exact < 2.0 ** -22
+
+
+def test_packed_batch_roundtrip_and_ingest_parity():
+    """pack_batch -> ingest_step_packed must equal ingest_step on the
+    same batch — the packed i32 carrier is bit-exact for every lane
+    (f32 values incl. inf sentinels, i32 slots, u8 rhos)."""
+    import jax
+    from veneur_tpu.aggregation.step import (
+        batch_sizes, ingest_step_packed, pack_batch, unpack_batch)
+
+    rng = np.random.RandomState(3)
+    b = _empty_batch(SPEC, BSPEC)
+    b.counter_slot[:50] = rng.randint(0, 256, 50)
+    b.counter_inc[:50] = rng.uniform(0, 10, 50).astype(np.float32)
+    b.gauge_slot[:20] = rng.randint(0, 64, 20)
+    b.gauge_val[:20] = rng.uniform(-5, 5, 20).astype(np.float32)
+    b.status_slot[:4] = rng.randint(0, 16, 4)
+    b.status_val[:4] = [0, 1, 2, 1]
+    b.set_slot[:30] = rng.randint(0, 16, 30)
+    b.set_reg[:30] = rng.randint(0, 1 << 12, 30)
+    b.set_rho[:30] = rng.randint(1, 50, 30)
+    b.histo_slot[:100] = rng.randint(0, 64, 100)
+    b.histo_val[:100] = rng.lognormal(1, 1, 100).astype(np.float32)
+    b.histo_wt[:100] = 1.0
+    b = b._replace(
+        histo_stat_slot=np.full(BSPEC.histo_stat, SPEC.histo_capacity,
+                                np.int32),
+        histo_stat_min=np.full(BSPEC.histo_stat, np.inf, np.float32),
+        histo_stat_max=np.full(BSPEC.histo_stat, -np.inf, np.float32),
+        histo_stat_recip=np.zeros(BSPEC.histo_stat, np.float32))
+
+    # lane-level roundtrip (host pack -> device unpack, jitted identity;
+    # flat[0] is the in-band compact control word)
+    sizes = batch_sizes(b)
+    flat = pack_batch(b)
+    assert flat[0] == 0 and pack_batch(b, do_compact=True)[0] == 1
+    back = jax.jit(lambda f: unpack_batch(f[1:], sizes))(flat)
+    for name, orig, got in zip(Batch._fields, b, back):
+        np.testing.assert_array_equal(
+            np.asarray(got), np.asarray(orig), err_msg=name)
+
+    # full ingest parity, with and without the fused compact branch
+    ref = fold_scalars(ingest_step(empty_state(SPEC), b, spec=SPEC))
+    packed = ingest_step_packed(empty_state(SPEC), pack_batch(b),
+                                spec=SPEC, sizes=sizes)
+    for name, a, c in zip(ref._fields, ref, packed):
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(c), err_msg=name)
+    ref_c = compact(fold_scalars(ingest_step(empty_state(SPEC), b,
+                                             spec=SPEC)), spec=SPEC)
+    packed_c = ingest_step_packed(empty_state(SPEC),
+                                  pack_batch(b, do_compact=True),
+                                  spec=SPEC, sizes=sizes)
+    for name, a, c in zip(ref_c._fields, ref_c, packed_c):
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(c), err_msg=name)
+
+
+def test_packed_batch_none_stat_lanes():
+    """A default-constructed Batch (histo_stat_* = None, the pure-ingest
+    common case) must pack, unpack back to None, and ingest identically
+    to the unpacked path."""
+    from veneur_tpu.aggregation.step import (
+        batch_sizes, ingest_step_packed, pack_batch)
+
+    b = _empty_batch(SPEC, BSPEC)           # stat lanes default to None
+    b.histo_slot[:10] = np.arange(10)
+    b.histo_val[:10] = np.linspace(1, 10, 10).astype(np.float32)
+    b.histo_wt[:10] = 1.0
+    sizes = batch_sizes(b)
+    assert sizes[-4:] == (0, 0, 0, 0)
+    ref = fold_scalars(ingest_step(empty_state(SPEC), b, spec=SPEC))
+    packed = ingest_step_packed(empty_state(SPEC), pack_batch(b),
+                                spec=SPEC, sizes=sizes)
+    for name, a, c in zip(ref._fields, ref, packed):
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(c), err_msg=name)

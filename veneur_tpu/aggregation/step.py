@@ -188,6 +188,91 @@ ingest_step = partial(jax.jit, static_argnames=("spec",),
                       donate_argnames=("state",))(ingest_core)
 
 
+# -- packed batch transfer ---------------------------------------------------
+# On a tunneled TPU every host->device array transfer pays a full sync RTT;
+# a 16-lane Batch cost 16 RTTs per step and throttled real ingest to ~32k
+# samples/s while the compute itself ran at >100M samples/s (measured).
+# The fix mirrors the flush direction (flush_live_in_packed): ship the whole
+# batch as ONE flat i32 buffer and rebuild the lanes with static slices +
+# bitcasts inside the compiled program. i32 is the carrier because integer
+# transfers are bit-exact (an f32 carrier could canonicalize NaN payloads
+# in i32 lanes).
+
+_U8_LANES = frozenset({"set_rho"})
+_F32_LANES = frozenset({
+    "counter_inc", "gauge_val", "status_val", "histo_val", "histo_wt",
+    "histo_stat_min", "histo_stat_max", "histo_stat_recip"})
+
+
+def batch_sizes(batch: Batch) -> tuple:
+    """Static lane lengths of a batch (the packed program's compile key,
+    alongside spec). None lanes (the optional histo_stat_* import-scalar
+    lanes) encode as 0 and round-trip back to None."""
+    return tuple(0 if a is None else int(a.size) for a in batch)
+
+
+def pack_batch(batch: Batch, do_compact: bool = False):
+    """Host side: one contiguous i32 buffer holding every lane (f32 lanes
+    bit-viewed, u8 lanes padded to word multiples, None lanes skipped),
+    preceded by one control word (the in-band compact flag — a separate
+    scalar argument would be a second transfer). Pure numpy; ~microseconds
+    next to the transfer it replaces."""
+    import numpy as np
+    parts = [np.asarray([1 if do_compact else 0], np.int32)]
+    for name, a in zip(Batch._fields, batch):
+        if a is None:
+            continue
+        a = np.ascontiguousarray(a)
+        if a.dtype == np.uint8:
+            pad = (-a.size) % 4
+            if pad:
+                a = np.concatenate([a, np.zeros(pad, np.uint8)])
+        parts.append(a.view(np.int32).ravel())
+    return np.concatenate(parts)
+
+
+def unpack_batch(flat, sizes: tuple) -> Batch:
+    """Device side (inside jit): static slices + bitcasts back into lanes.
+    A 0 size restores the lane to None (ingest_core's optional-lane
+    contract, see Batch docstring)."""
+    out = []
+    off = 0
+    for name, n in zip(Batch._fields, sizes):
+        if n == 0:
+            out.append(None)
+            continue
+        if name in _U8_LANES:
+            words = (n + 3) // 4
+            a = jax.lax.bitcast_convert_type(
+                flat[off:off + words], jnp.uint8).reshape(-1)[:n]
+            off += words
+        elif name in _F32_LANES:
+            a = jax.lax.bitcast_convert_type(flat[off:off + n], jnp.float32)
+            off += n
+        else:
+            a = flat[off:off + n]
+            off += n
+        out.append(a)
+    return Batch(*out)
+
+
+@partial(jax.jit, static_argnames=("spec", "sizes"),
+         donate_argnames=("state",))
+def ingest_step_packed(state: DeviceState, flat, *, spec: TableSpec,
+                       sizes: tuple) -> DeviceState:
+    """Ingest one packed batch; when the control word is set, re-compress
+    the digest rows in the SAME program (lax.cond — only the taken branch
+    executes). Folding compaction in keeps the steady-state hot loop at
+    ONE resident executable, which matters twice: fewer dispatches is
+    plain good TPU practice, and the tunneled single-chip backend drops
+    to a slow per-dispatch mode once more than two distinct executables
+    are in flight (measured: 2s/dispatch for a separate compact program)."""
+    state = ingest_core(state, unpack_batch(flat[1:], sizes), spec=spec)
+    return jax.lax.cond(flat[0] != 0,
+                        lambda s: compact_core(s, spec=spec),
+                        lambda s: s, state)
+
+
 def _fold_core(state: DeviceState) -> DeviceState:
     ch, cl = twofloat_add(state.counter_hi, state.counter_lo, state.counter_acc)
     hch, hcl = twofloat_add(state.h_count_hi, state.h_count_lo, state.h_count_acc)
@@ -318,14 +403,7 @@ def flush_live_core(state: DeviceState, qs: jax.Array, cidx, gidx, stidx,
     return out
 
 
-def _flush_live_packed_core(state, qs, cidx, gidx, stidx, setidx, hidx, *,
-                            spec, want_raw: bool = False):
-    """flush_live + device-side packing of every output into ONE flat f32
-    array. Each device→host transfer pays a fixed sync latency (~200ms
-    through a tunneled TPU); 15 per flush dominated the interval, one is
-    noise. uint8 arrays (HLL registers) ride as bitcast f32 words."""
-    out = flush_live_core(state, qs, cidx, gidx, stidx, setidx, hidx,
-                          spec=spec, want_raw=want_raw)
+def _pack_outputs(out: dict):
     parts = []
     for k in sorted(out):
         a = out[k]
@@ -336,8 +414,30 @@ def _flush_live_packed_core(state, qs, cidx, gidx, stidx, setidx, hidx, *,
     return jnp.concatenate(parts)
 
 
-flush_live_packed = partial(
-    jax.jit, static_argnames=("spec", "want_raw"))(_flush_live_packed_core)
+def pack_flush_inputs(perc, idx_arrays):
+    """Host side: quantile list + the five live-index buckets as ONE i32
+    buffer (f32 quantiles bit-viewed), the H2D mirror of the packed
+    output — 6 transfers per flush become 1."""
+    import numpy as np
+    qs = np.asarray(perc, np.float32).view(np.int32)
+    return np.concatenate([qs] + [np.asarray(i, np.int32).ravel()
+                                  for i in idx_arrays])
+
+
+def _flush_live_in_packed_core(state, flat, *, spec, n_q: int,
+                               buckets: tuple, want_raw: bool = False):
+    qs = jax.lax.bitcast_convert_type(flat[:n_q], jnp.float32)
+    idx, off = [], n_q
+    for n in buckets:
+        idx.append(flat[off:off + n])
+        off += n
+    out = flush_live_core(state, qs, *idx, spec=spec, want_raw=want_raw)
+    return _pack_outputs(out)
+
+
+flush_live_in_packed = partial(
+    jax.jit, static_argnames=("spec", "n_q", "buckets", "want_raw"))(
+        _flush_live_in_packed_core)
 
 
 def unpack_flush(packed, shapes: dict) -> dict:
@@ -388,9 +488,14 @@ def flush_live_shapes(spec, n_c, n_g, n_st, n_set, n_h, n_q,
 
 
 def pad_bucket(n: int, cap: int) -> int:
-    """Size bucket for live-slot index arrays: next power of two (min 8),
-    clamped to capacity — bounds compiled variants to ~log2(capacity)."""
-    p = 8
+    """Size bucket for live-slot index arrays: next power of two (min 64),
+    clamped to capacity — bounds compiled variants to ~log2(capacity).
+    The 64 floor keeps small kinds (self-telemetry counters/gauges grow a
+    little between the first and second flush) inside ONE bucket, so a
+    steady server re-uses a single compiled flush program instead of
+    minting a variant per flush — which both avoids recompiles and keeps
+    the resident-executable count at two (see ingest_step_packed)."""
+    p = 64
     while p < n:
         p <<= 1
     return min(p, max(cap, 1))

@@ -18,7 +18,7 @@ import numpy as np
 from veneur_tpu.aggregation.host import Batcher, BatchSpec, KeyTable
 from veneur_tpu.aggregation.state import TableSpec, empty_state
 from veneur_tpu.aggregation.step import (
-    compact, flush_compute, fold_scalars, ingest_step)
+    batch_sizes, ingest_step_packed, pack_batch)
 from veneur_tpu.samplers import parser
 from veneur_tpu.samplers.parser import UDPMetric
 
@@ -47,10 +47,13 @@ class Aggregator:
 
     # -- ingest -------------------------------------------------------------
     def _on_batch(self, batch):
-        self.state = ingest_step(self.state, batch, spec=self.spec)
+        # one packed H2D transfer per step; compaction rides the same
+        # program via the control word (step.py pack_batch rationale)
         self._steps += 1
-        if self._steps % self.compact_every == 0:
-            self.state = compact(self.state, spec=self.spec)
+        self.state = ingest_step_packed(
+            self.state,
+            pack_batch(batch, self._steps % self.compact_every == 0),
+            spec=self.spec, sizes=batch_sizes(batch))
 
     def process_metric(self, m: UDPMetric) -> None:
         """reference worker.go:344 ProcessMetric: switch on type+scope,
@@ -174,10 +177,9 @@ class Aggregator:
         (flush_live gathers live rows on device, so only O(live) bytes
         cross the host boundary). With want_raw, also returns the live
         rows' mergeable sketch state (numpy) for forwarding."""
-        import jax.numpy as jnp
         from veneur_tpu.aggregation.step import (
-            combine_flush_scalars, flush_live_packed, flush_live_shapes,
-            live_indices, unpack_flush)
+            combine_flush_scalars, flush_live_in_packed, flush_live_shapes,
+            live_indices, pack_flush_inputs, unpack_flush)
 
         # No fold/compact pass here: ingest folds accumulators in-program
         # (step.py ingest_core), and the quantile kernel argsorts cells
@@ -187,16 +189,18 @@ class Aggregator:
         # capacity for no accuracy gain (temps unmerged are strictly more
         # precise; forwarding re-adds centroids either way).
         perc = percentiles or [0.5]
-        qs = jnp.asarray(perc, jnp.float32)
         spec = self.spec
         idx = [live_indices(table, "counter", spec.counter_capacity),
                live_indices(table, "gauge", spec.gauge_capacity),
                live_indices(table, "status", spec.status_capacity),
                live_indices(table, "set", spec.set_capacity),
                live_indices(table, "histogram", spec.histo_capacity)]
-        packed = np.asarray(flush_live_packed(
-            state, qs, *[jnp.asarray(i) for i in idx],
-            spec=spec, want_raw=want_raw))   # ONE device->host transfer
+        # ONE host->device transfer in (quantiles + index buckets), ONE
+        # device->host transfer out (the packed flush arrays)
+        packed = np.asarray(flush_live_in_packed(
+            state, pack_flush_inputs(perc, idx), spec=spec,
+            n_q=len(perc), buckets=tuple(len(i) for i in idx),
+            want_raw=want_raw))
         out = unpack_flush(packed, flush_live_shapes(
             spec, *[len(i) for i in idx], len(perc), want_raw=want_raw))
         result = combine_flush_scalars(out)

@@ -50,7 +50,7 @@ def _gather_sharded_impl(out, cidx, gidx, stidx, setidx, hidx):
     """Live-row gather over the merged flush's [S, K_per] dense arrays
     (global KeyTable slots are flat indices by construction), packed into
     one flat f32 array — one device->host transfer per flush, same as
-    the single-device flush_live_packed."""
+    the single-device flush_live_in_packed."""
     import jax.numpy as jnp
     which = {"counter_hi": cidx, "counter_lo": cidx, "gauge": gidx,
              "status": stidx, "set_estimate": setidx}
