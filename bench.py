@@ -123,21 +123,13 @@ def main():
 def kernel_main():
     steps = int(os.environ.get("BENCH_STEPS", "100"))
     # A wedged accelerator tunnel hangs backend init forever; fail fast
-    # with a diagnostic line instead of hanging the driver.
-    import threading
-    init_budget = float(os.environ.get("BENCH_INIT_TIMEOUT", "600"))
-
-    def _init_watchdog():
-        print(json.dumps({
-            "metric": "aggregation_samples_per_sec_per_chip_1M_keys",
-            "value": 0, "unit": "samples/sec", "vs_baseline": 0,
-            "error": f"device backend init exceeded {init_budget:.0f}s "
-                     "(accelerator tunnel down?)"}), flush=True)
-        os._exit(2)
-
-    timer = threading.Timer(init_budget, _init_watchdog)
-    timer.daemon = True
-    timer.start()
+    # with a diagnostic line instead of hanging the driver (shared with
+    # the e2e config children so the orchestrator's "backend init"
+    # dead-tunnel detection matches both).
+    from benchmarks.e2e import _arm_init_watchdog
+    timer = _arm_init_watchdog({
+        "metric": "aggregation_samples_per_sec_per_chip_1M_keys",
+        "value": 0, "unit": "samples/sec", "vs_baseline": 0})
     import jax
     import jax.numpy as jnp
     from veneur_tpu.aggregation.state import TableSpec, empty_state

@@ -207,6 +207,9 @@ class Config:
     # TPU aggregation backend (this framework's addition)
     aggregation_backend: str = "tpu"
     native_ingest: bool = True   # C++ parse+key+stage path when buildable
+    # C++ recvmmsg reader threads for UDP statsd (GIL-free socket reads;
+    # requires native_ingest). Python reader threads otherwise.
+    native_udp_readers: bool = True
     tpu_counter_capacity: int = 1 << 17
     tpu_gauge_capacity: int = 1 << 15
     tpu_status_capacity: int = 1 << 10

@@ -46,7 +46,7 @@ def _build_and_load():
             # half-written ELF
             tmp_path = f"{so_path}.{os.getpid()}.tmp"
             subprocess.run(
-                ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
                  "-o", tmp_path, _SRC],
                 check=True, capture_output=True, timeout=120)
             os.replace(tmp_path, so_path)
@@ -77,6 +77,16 @@ def _build_and_load():
         lib.vt_reset.argtypes = [ctypes.c_void_p]
         lib.vt_stats.argtypes = [ctypes.c_void_p,
                                  ctypes.POINTER(ctypes.c_uint64)]
+        lib.vr_start.restype = ctypes.c_void_p
+        lib.vr_start.argtypes = [ctypes.c_void_p,
+                                 ctypes.POINTER(ctypes.c_int),
+                                 ctypes.c_int, ctypes.c_int, ctypes.c_int]
+        lib.vr_pump.restype = ctypes.c_int
+        lib.vr_pump.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                ctypes.POINTER(ctypes.c_uint64)]
+        lib.vr_counters.argtypes = [ctypes.c_void_p,
+                                    ctypes.POINTER(ctypes.c_uint64)]
+        lib.vr_stop.argtypes = [ctypes.c_void_p]
         _lib = lib
     except Exception as e:  # noqa: BLE001 — any failure => python fallback
         _load_err = str(e)
@@ -201,3 +211,40 @@ class NativeIngest:
         s = (ctypes.c_uint64 * 3)()
         _lib.vt_stats(self._h, s)
         return {"processed": s[0], "parse_errors": s[1], "dropped": s[2]}
+
+    # -- native UDP reader group (vr_* in dogstatsd.cpp) --------------------
+
+    def readers_start(self, fds: List[int], max_len: int = 65536,
+                      ring_cap: int = 65536) -> None:
+        """Spawn one C++ recvmmsg thread per fd, feeding the shared
+        datagram ring drained by pump(). Python retains fd ownership —
+        keep the sockets open until readers_stop()."""
+        arr = (ctypes.c_int * len(fds))(*fds)
+        self._readers = _lib.vr_start(self._h, arr, len(fds), max_len,
+                                      ring_cap)
+
+    def pump(self, max_wait_ms: int) -> tuple:
+        """Drain queued datagrams into staging (blocks in C++ with the GIL
+        released while the ring is idle). Returns (full, stats) where full
+        means a staging lane filled — emit and call pump(0) again — and
+        stats is {parsed, ring_depth, ring_dropped, datagrams}."""
+        out = (ctypes.c_uint64 * 4)()
+        full = _lib.vr_pump(self._readers, max_wait_ms, out)
+        return bool(full), {"parsed": out[0], "ring_depth": out[1],
+                            "ring_dropped": out[2], "datagrams": out[3]}
+
+    def reader_counters(self) -> dict:
+        """Live reader-group counters, callable from any thread."""
+        r = getattr(self, "_readers", None)
+        if not r:
+            return {"datagrams": 0, "ring_dropped": 0, "ring_depth": 0}
+        out = (ctypes.c_uint64 * 3)()
+        _lib.vr_counters(r, out)
+        return {"datagrams": out[0], "ring_dropped": out[1],
+                "ring_depth": out[2]}
+
+    def readers_stop(self) -> None:
+        r = getattr(self, "_readers", None)
+        if r:
+            _lib.vr_stop(r)
+            self._readers = None

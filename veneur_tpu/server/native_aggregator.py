@@ -189,6 +189,35 @@ class NativeAggregator(Aggregator):
     def extra_parse_errors(self) -> int:
         return self.eng.stats()["parse_errors"]
 
+    # -- native UDP reader group ---------------------------------------------
+    def readers_start(self, fds, max_len: int = 65536,
+                      ring_cap: int = 65536) -> None:
+        self.eng.readers_start(fds, max_len=max_len, ring_cap=ring_cap)
+
+    def pump(self, max_wait_ms: int, max_emits: int = 8) -> List[bytes]:
+        """Drain the C++ datagram ring into staging (GIL released while
+        idle), emitting device batches whenever a lane fills. Bounded:
+        under sustained overload an unbounded drain would never return to
+        the pipeline dispatch loop and flush requests (which ride
+        packet_queue) would starve — exactly when operators most need the
+        flush. Returns escalated event/service-check lines."""
+        full, st = self.eng.pump(max_wait_ms)
+        for _ in range(max_emits):
+            if not full:
+                break
+            self._emit_native()
+            full, st = self.eng.pump(0)
+        if full:
+            # leave staging drained so the next call ingests immediately
+            self._emit_native()
+        return self.eng.drain_specials()
+
+    def reader_counters(self) -> dict:
+        return self.eng.reader_counters()
+
+    def readers_stop(self) -> None:
+        self.eng.readers_stop()
+
     # `processed` spans both ingest paths: the C++ engine's count plus the
     # Python-side samples (imports, extracted metrics, service checks).
     @property

@@ -136,6 +136,7 @@ class Server:
             n_shards=max(1, cfg.tpu_n_shards) if cfg.tpu_n_shards else 1,
             compact_every=cfg.tpu_compact_every)
         self._native = False
+        self._native_readers_active = False
         n_shards = agg_args["n_shards"]
         if cfg.tpu_n_shards == 0:
             # auto: one shard per accelerator when several are attached
@@ -225,7 +226,8 @@ class Server:
         self.flush_count = 0
         self.parse_errors = 0
         self.import_errors = 0
-        self.packets_received = 0
+        self._packets_received = 0
+        self._packets_dropped_py = 0
         self._shutdown = threading.Event()
         self._stats_sock: Optional[socket.socket] = None
         self._stats_dest = None
@@ -289,31 +291,54 @@ class Server:
                 self.handle_metric_packet(line)
 
     def _pipeline_loop(self):
-        """The single device-owning thread (all worker goroutines in one)."""
+        """The single device-owning thread (all worker goroutines in one).
+        With the native reader group, UDP datagrams bypass packet_queue
+        entirely: C++ threads recvmmsg into a ring, and pump() drains it
+        here (parse + stage + batch dispatch) with the GIL released while
+        idle. packet_queue still carries control items and the non-UDP
+        listeners' data."""
         while True:
-            item = self.packet_queue.get()
-            if item is _STOP:
-                return
-            if isinstance(item, FlushRequest):
-                self._handle_flush_request(item)
-                continue
-            if isinstance(item, _ImportBatch):
-                from veneur_tpu.forward.convert import import_into
-                for metric in item:
+            # re-checked each pass: start() flips the flag after binding
+            # the UDP sockets, which happens after this thread launches
+            if self._native_readers_active:
+                for special in self.aggregator.pump(20):
+                    self.handle_metric_packet(special)
+                while True:
                     try:
-                        import_into(self.aggregator, metric)
-                    except Exception as e:
-                        # counted into self-telemetry so a mixed fleet sees
-                        # incompatible payloads (e.g. foreign sketch bytes)
-                        # instead of silently losing them
-                        self.import_errors += 1
-                        log.warning("bad imported metric %s: %s",
-                                    metric.name, e)
-                continue
-            if isinstance(item, _SpanMetricBatch):
-                for m in item:
-                    self.aggregator.process_metric(m)
-                continue
+                        item = self.packet_queue.get_nowait()
+                    except queue.Empty:
+                        break
+                    if item is _STOP:
+                        return
+                    self._dispatch_item(item)
+            else:
+                try:
+                    item = self.packet_queue.get(timeout=0.05)
+                except queue.Empty:
+                    continue
+                if item is _STOP:
+                    return
+                self._dispatch_item(item)
+
+    def _dispatch_item(self, item):
+        if isinstance(item, FlushRequest):
+            self._handle_flush_request(item)
+        elif isinstance(item, _ImportBatch):
+            from veneur_tpu.forward.convert import import_into
+            for metric in item:
+                try:
+                    import_into(self.aggregator, metric)
+                except Exception as e:
+                    # counted into self-telemetry so a mixed fleet sees
+                    # incompatible payloads (e.g. foreign sketch bytes)
+                    # instead of silently losing them
+                    self.import_errors += 1
+                    log.warning("bad imported metric %s: %s",
+                                metric.name, e)
+        elif isinstance(item, _SpanMetricBatch):
+            for m in item:
+                self.aggregator.process_metric(m)
+        else:
             self._process_packets(item)
 
     def _handle_flush_request(self, req: FlushRequest) -> None:
@@ -345,6 +370,7 @@ class Server:
         # stats call isn't safe to interleave with feed()
         stats = {
             "packets_received": self.packets_received,
+            "packets_dropped": self.packets_dropped,
             "parse_errors": self.parse_errors
             + self.aggregator.extra_parse_errors(),
             "processed": self.aggregator.processed + 0,
@@ -409,11 +435,29 @@ class Server:
                 continue
             except OSError:
                 return
-            self.packets_received += 1
+            self._packets_received += 1
             try:
                 self.packet_queue.put(data, timeout=1.0)
             except queue.Full:
-                pass  # drop like a kernel would; counted upstream
+                self._packets_dropped_py += 1  # backpressure drop, counted
+
+    @property
+    def packets_received(self) -> int:
+        """Python-read packets plus the native reader group's datagrams
+        (C++ counters are mutex-guarded; readable from any thread)."""
+        n = self._packets_received
+        if self._native_readers_active:
+            n += self.aggregator.reader_counters()["datagrams"]
+        return n
+
+    @property
+    def packets_dropped(self) -> int:
+        """Datagrams lost to backpressure after the kernel delivered them:
+        the native ring's overflow or the Python path's queue.Full drops."""
+        n = self._packets_dropped_py
+        if self._native_readers_active:
+            n += self.aggregator.reader_counters()["ring_dropped"]
+        return n
 
     def _ssf_udp_reader(self, sock: socket.socket):
         """One SSF span protobuf per datagram (server.go:1125
@@ -591,6 +635,13 @@ class Server:
         fw.start()
         self._flush_thread = fw
 
+        # C++ recvmmsg readers when the native engine is active: socket
+        # reads and parsing never touch the GIL (the Python per-datagram
+        # recv -> queue.put loop capped ingest around 6k datagrams/s and
+        # dropped 31% of BASELINE config 1's replay)
+        use_native_readers = (self._native and self.cfg.native_udp_readers
+                              and hasattr(self.aggregator, "readers_start"))
+        native_reader_fds = []
         for addr in self.cfg.statsd_listen_addresses:
             kind, target = resolve_addr(addr)
             if kind == "udp":
@@ -617,10 +668,13 @@ class Server:
                     # must not get a lossy listener)
                     sock.bind(target)
                     self._sockets.append(sock)
-                    rt = threading.Thread(target=self._udp_reader,
-                                          args=(sock,), daemon=True)
-                    rt.start()
-                    self._threads.append(rt)
+                    if use_native_readers:
+                        native_reader_fds.append(sock.fileno())
+                    else:
+                        rt = threading.Thread(target=self._udp_reader,
+                                              args=(sock,), daemon=True)
+                        rt.start()
+                        self._threads.append(rt)
             elif kind == "tcp":
                 sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
                 sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -656,6 +710,12 @@ class Server:
                                       args=(sock, None), daemon=True)
                 lt.start()
                 self._threads.append(lt)
+
+        if native_reader_fds:
+            self.aggregator.readers_start(
+                native_reader_fds,
+                max_len=max(self.cfg.metric_max_length, 65536))
+            self._native_readers_active = True
 
         # SSF span listeners (networking.go:198 StartSSF)
         self.span_pipeline.start()
@@ -907,6 +967,8 @@ class Server:
         from veneur_tpu.trace.client import report_batch
 
         cur = {"veneur.packets_received_total": stats["packets_received"],
+               "veneur.packets_dropped_total":
+                   stats.get("packets_dropped", 0),
                "veneur.parse_errors_total": stats["parse_errors"],
                "veneur.worker.metrics_processed_total": stats["processed"],
                "veneur.worker.metrics_dropped_total": stats["dropped"],
@@ -1061,6 +1123,12 @@ class Server:
         (`FATAL: exception not rethrown`, rc 134 — the round-2 bench
         failure). Shutdown must leave NO thread inside the JAX runtime."""
         self._shutdown.set()
+        # stop entering pump() on the pipeline thread's next pass; the
+        # C++ reader threads themselves are joined AFTER the pipeline
+        # thread exits (vr_stop frees the group a mid-flight vr_pump call
+        # would still be reading)
+        stop_native_readers = self._native_readers_active
+        self._native_readers_active = False
         for s in self._sockets:
             try:
                 s.close()
@@ -1092,6 +1160,16 @@ class Server:
             if self._pipeline_thread.is_alive():
                 log.error("pipeline thread did not exit within %.0fs",
                           device_timeout)
+        # pipeline is out of pump(); now it is safe to join + free the
+        # C++ reader group (skip if the pipeline thread is wedged — a
+        # freed group under a live vr_pump would be use-after-free)
+        if stop_native_readers and not (
+                self._pipeline_thread is not None
+                and self._pipeline_thread.is_alive()):
+            try:
+                self.aggregator.readers_stop()
+            except Exception:
+                log.exception("native reader shutdown failed")
         # bounded put: with a full queue AND a wedged worker, a blocking
         # put would hang shutdown forever (the watchdog is already
         # disarmed); drop one stale job to make room instead
