@@ -70,3 +70,37 @@ def test_heavy_hitters_find_true_top():
     assert set(top) == {f"heavy{i}".encode() for i in range(5)}
     # ordered by frequency
     assert top[0] == b"heavy0"
+
+
+def test_columns_for_batch_matches_scalar():
+    """The vectorized batch hashing (native FNV + numpy splitmix) must be
+    bit-identical to the scalar columns_for for arbitrary member bytes."""
+    import numpy as np
+    from veneur_tpu.ops.countmin import columns_for, columns_for_batch
+
+    rng = np.random.default_rng(11)
+    members = [bytes(rng.integers(0, 256, int(n)).astype(np.uint8))
+               for n in rng.integers(0, 40, 200)]
+    members += [b"", b"a", b"customer:hot1", b"x" * 100]
+    batch = columns_for_batch(members, depth=4, width=1 << 16)
+    for i, m in enumerate(members):
+        np.testing.assert_array_equal(
+            batch[i], columns_for(m, depth=4, width=1 << 16), err_msg=repr(m))
+
+
+def test_insert_and_estimate_matches_separate_ops():
+    import numpy as np
+    import jax.numpy as jnp
+    from veneur_tpu.ops.countmin import (
+        columns_for_batch, empty_counters, estimate, insert_and_estimate,
+        insert_batch)
+
+    members = [b"m%d" % (i % 7) for i in range(50)]
+    cols = jnp.asarray(columns_for_batch(members, 4, 1 << 10))
+    w = jnp.ones(len(members), jnp.float32)
+    c0 = empty_counters(4, 1 << 10)
+    fused_c, fused_est = insert_and_estimate(c0, cols, w)
+    sep_c = insert_batch(c0, cols, w)
+    sep_est = estimate(sep_c, cols)
+    np.testing.assert_array_equal(np.asarray(fused_c), np.asarray(sep_c))
+    np.testing.assert_array_equal(np.asarray(fused_est), np.asarray(sep_est))

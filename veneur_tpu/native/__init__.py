@@ -87,6 +87,9 @@ def _build_and_load():
         lib.vr_counters.argtypes = [ctypes.c_void_p,
                                     ctypes.POINTER(ctypes.c_uint64)]
         lib.vr_stop.argtypes = [ctypes.c_void_p]
+        lib.vt_hash64_batch.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_uint64)]
         _lib = lib
     except Exception as e:  # noqa: BLE001 — any failure => python fallback
         _load_err = str(e)
@@ -101,6 +104,25 @@ def available() -> bool:
 KIND_NAMES = {0: "counter", 1: "gauge", 2: "histogram", 3: "set",
               4: "timer"}
 KIND_IDS = {v: k for k, v in KIND_NAMES.items()}
+
+
+def hash64_batch(members: List[bytes]) -> "np.ndarray":
+    """FNV-1a 64 of each byte string, hashed in one C call (bit-identical
+    to utils.hashing.fnv1a_64). Raises when the engine isn't built —
+    callers gate on available()."""
+    _build_and_load()
+    if _lib is None:
+        raise RuntimeError(f"native ingest unavailable: {_load_err}")
+    n = len(members)
+    buf = b"".join(members)
+    offs = np.zeros(n + 1, np.int64)
+    if n:
+        np.cumsum([len(m) for m in members], out=offs[1:])
+    out = np.empty(n, np.uint64)
+    _lib.vt_hash64_batch(
+        buf, offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)))
+    return out
 
 
 class NativeIngest:
@@ -217,8 +239,10 @@ class NativeIngest:
     def readers_start(self, fds: List[int], max_len: int = 65536,
                       ring_cap: int = 65536) -> None:
         """Spawn one C++ recvmmsg thread per fd, feeding the shared
-        datagram ring drained by pump(). Python retains fd ownership —
-        keep the sockets open until readers_stop()."""
+        datagram ring drained by pump(). Each fd is dup()ed into C++
+        ownership (vr_start), so the Python sockets may be closed at any
+        time after this returns; the dups are released by
+        readers_stop()."""
         arr = (ctypes.c_int * len(fds))(*fds)
         self._readers = _lib.vr_start(self._h, arr, len(fds), max_len,
                                       ring_cap)

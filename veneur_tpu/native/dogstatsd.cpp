@@ -572,6 +572,15 @@ void vt_reset(void* hp) {
   p->new_keys.clear();
 }
 
+// Batch FNV-1a 64 over concatenated byte strings (offsets has n+1
+// entries). Standalone — no parser handle; used for count-min member
+// hashing where a per-member Python byte loop dominated the sketch path.
+void vt_hash64_batch(const char* buf, const int64_t* offsets, int n,
+                     uint64_t* out) {
+  for (int i = 0; i < n; i++)
+    out[i] = fnv64(buf + offsets[i], (size_t)(offsets[i + 1] - offsets[i]));
+}
+
 void vt_stats(void* hp, uint64_t* out) {
   auto* p = (Parser*)hp;
   out[0] = p->processed;

@@ -38,6 +38,18 @@ def empty_counters(depth: int = DEFAULT_DEPTH, width: int = DEFAULT_WIDTH):
     return jnp.zeros((depth, width), jnp.float32)
 
 
+_M64 = (1 << 64) - 1
+
+
+def _splitmix64_np(x: np.ndarray) -> np.ndarray:
+    """Vectorized utils.hashing.splitmix64 (numpy uint64 wraps mod 2^64,
+    matching the scalar's `& _M64`)."""
+    x = x + np.uint64(0x9E3779B97F4A7C15)
+    x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    return x ^ (x >> np.uint64(31))
+
+
 def columns_for(member: bytes, depth: int = DEFAULT_DEPTH,
                 width: int = DEFAULT_WIDTH) -> np.ndarray:
     """Host-side: the D column indices for one item. One 64-bit base hash,
@@ -51,7 +63,21 @@ def columns_for(member: bytes, depth: int = DEFAULT_DEPTH,
 
 def columns_for_batch(members: List[bytes], depth: int = DEFAULT_DEPTH,
                       width: int = DEFAULT_WIDTH) -> np.ndarray:
-    return np.stack([columns_for(m, depth, width) for m in members])
+    """Batch columns_for: one C call for the member hashes, numpy for the
+    per-row remix (bit-identical to the scalar; asserted in tests). The
+    per-member Python loop was the span firehose's top host cost."""
+    from veneur_tpu import native
+    if native.available():
+        hs = native.hash64_batch(members)
+    else:
+        hs = np.asarray([fnv1a_64(m) for m in members], np.uint64)
+    cols = np.empty((len(members), depth), np.int32)
+    mask = np.uint64(width - 1)
+    with np.errstate(over="ignore"):
+        for d in range(depth):
+            salt = np.uint64((0x9E3779B97F4A7C15 * (d + 1)) & _M64)
+            cols[:, d] = (_splitmix64_np(hs ^ salt) & mask).astype(np.int32)
+    return cols
 
 
 @jax.jit
@@ -76,6 +102,23 @@ def estimate(counters, cols):
     rows = jnp.arange(d, dtype=jnp.int32)[None, :]
     vals = counters[rows, jnp.maximum(cols, 0)]           # [B, D]
     return jnp.where((cols >= 0).all(axis=1), vals.min(axis=1), 0.0)
+
+
+@jax.jit
+def insert_and_estimate(counters, cols, weights):
+    """insert_batch + estimate of the same items in ONE compiled program
+    (one dispatch per batch instead of two — dispatch count is the scarce
+    resource on a tunneled chip, and the update path always wants both)."""
+    d, w = counters.shape
+    b = cols.shape[0]
+    rows = jnp.arange(d, dtype=jnp.int32)[None, :]
+    flat = jnp.where(cols >= 0, rows * w + cols, d * w)
+    upd = jnp.broadcast_to(weights[:, None], (b, d))
+    out = counters.reshape(-1).at[flat.reshape(-1)].add(
+        upd.reshape(-1), mode="drop").reshape(d, w)
+    vals = out[rows, jnp.maximum(cols, 0)]
+    est = jnp.where((cols >= 0).all(axis=1), vals.min(axis=1), 0.0)
+    return out, est
 
 
 @jax.jit
@@ -107,13 +150,14 @@ class HeavyHitters:
                weights: np.ndarray = None) -> None:
         if not members:
             return
-        cols = columns_for_batch(members, self.depth, self.width)
+        cols = jnp.asarray(columns_for_batch(members, self.depth,
+                                             self.width))
         w = (np.ones(len(members), np.float32) if weights is None
              else np.asarray(weights, np.float32))
-        self.counters = insert_batch(self.counters, jnp.asarray(cols),
-                                     jnp.asarray(w))
+        self.counters, est = insert_and_estimate(self.counters, cols,
+                                                 jnp.asarray(w))
         self.total += float(w.sum())
-        est = np.asarray(estimate(self.counters, jnp.asarray(cols)))
+        est = np.asarray(est)
         for m, e in zip(members, est):
             self.candidates[m] = float(e)
         if len(self.candidates) > 4 * self.k:

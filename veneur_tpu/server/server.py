@@ -1126,8 +1126,15 @@ class Server:
         # stop entering pump() on the pipeline thread's next pass; the
         # C++ reader threads themselves are joined AFTER the pipeline
         # thread exits (vr_stop frees the group a mid-flight vr_pump call
-        # would still be reading)
+        # would still be reading). Fold the group's counters into the
+        # Python ones FIRST: a FlushRequest already queued behind us will
+        # snapshot packets_received, and losing the reader counts there
+        # would emit a huge negative self-telemetry delta.
         stop_native_readers = self._native_readers_active
+        if stop_native_readers:
+            rc = self.aggregator.reader_counters()
+            self._packets_received += rc["datagrams"]
+            self._packets_dropped_py += rc["ring_dropped"]
         self._native_readers_active = False
         for s in self._sockets:
             try:

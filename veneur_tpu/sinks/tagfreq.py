@@ -53,16 +53,35 @@ class TagFrequencySink:
     def start(self):
         pass
 
+    def _span_members(self, span) -> List[bytes]:
+        return [f"{k}:{v}".encode() for k, v in span.tags.items()
+                if not self.tag_keys or k in self.tag_keys]
+
     def ingest(self, span) -> None:
-        members = []
-        for k, v in span.tags.items():
-            if self.tag_keys and k not in self.tag_keys:
-                continue
-            members.append(f"{k}:{v}".encode())
+        members = self._span_members(span)
         if not members:
             return
         with self._lock:
             self.spans_seen += 1
+            self.members_seen += len(members)
+            self._buf.extend(members)
+            if len(self._buf) >= self.batch_size:
+                self._drain_locked()
+
+    def ingest_many(self, spans) -> None:
+        """Batched span-worker path: one lock round-trip per batch.
+        Atomic per the SpanPipeline contract — all member extraction
+        happens before any state is touched, so a raise leaves the sink
+        unchanged and the pipeline's per-span retry stays exactly-once."""
+        members = []
+        n_spans = 0
+        for span in spans:
+            m = self._span_members(span)
+            if m:
+                n_spans += 1
+                members.extend(m)
+        with self._lock:
+            self.spans_seen += n_spans
             self.members_seen += len(members)
             self._buf.extend(members)
             if len(self._buf) >= self.batch_size:
