@@ -88,12 +88,93 @@ def sweep(samples=50_000, seed=0, batch=1024):
     return rows
 
 
+class SequentialDigest:
+    """Reference-style sequential merging t-digest (δ-constrained greedy
+    merge of sorted centroids — the algorithm of merging_digest.go:140,
+    re-expressed minimally). Used as the accuracy BASELINE: the north
+    star's error budget is "vs the Go t-digest", so the fair comparison
+    for the k-cell device digest is this construction, not exact order
+    statistics."""
+
+    def __init__(self, compression: float = 100.0, buf: int = 500):
+        self.d = compression
+        self.buf_cap = buf
+        self.mean = np.zeros(0)
+        self.w = np.zeros(0)
+        self.buf: list = []
+
+    @staticmethod
+    def _k1(q, d):
+        return d / (2 * np.pi) * np.arcsin(2 * np.clip(q, 0.0, 1.0) - 1)
+
+    def add(self, v: float):
+        self.buf.append(v)
+        if len(self.buf) >= self.buf_cap:
+            self.compress()
+
+    def compress(self):
+        if not self.buf and len(self.mean):
+            return
+        m = np.concatenate([self.mean, np.asarray(self.buf, np.float64)])
+        w = np.concatenate([self.w, np.ones(len(self.buf))])
+        self.buf = []
+        o = np.argsort(m)
+        m, w = m[o], w[o]
+        tot = w.sum()
+        nm, nw = [m[0]], [w[0]]
+        wsum = 0.0
+        for i in range(1, len(m)):
+            q0 = wsum / tot
+            q2 = (wsum + nw[-1] + w[i]) / tot
+            if self._k1(q2, self.d) - self._k1(q0, self.d) <= 1.0:
+                nw[-1] += w[i]
+                nm[-1] += (m[i] - nm[-1]) * w[i] / nw[-1]
+            else:
+                wsum += nw[-1]
+                nm.append(m[i])
+                nw.append(w[i])
+        self.mean, self.w = np.asarray(nm), np.asarray(nw)
+
+    def quantile(self, q: float) -> float:
+        self.compress()
+        cum = np.cumsum(self.w) - self.w / 2
+        return float(np.interp(q * self.w.sum(), cum, self.mean))
+
+
+def small_sample_baseline(seed=7, trials=60, lo=300, hi=1000, q=0.99):
+    """Per-name p99 error of the sequential baseline on the size regime
+    where e2e config 2's p99_err_max lives (a few hundred samples per
+    name). Answers whether a double-digit max is this implementation or
+    the algorithm class — measured: the baseline shows mean ~1.8% / max
+    ~9.6% here, worse mean than the pipeline's."""
+    rng = np.random.default_rng(seed)
+    errs = []
+    for _ in range(trials):
+        n = int(rng.integers(lo, hi))
+        v = rng.lognormal(3.0, 0.9, n)
+        dig = SequentialDigest()
+        for x in v:
+            dig.add(x)
+        exact = midpoint_quantile(np.sort(v), q)
+        errs.append(abs(dig.quantile(q) - exact) / exact)
+    e = np.asarray(errs)
+    return {"trials": trials, "q": q,
+            "err_mean": round(float(e.mean()), 5),
+            "err_max": round(float(e.max()), 5)}
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default="digest_sweep.csv")
     ap.add_argument("--samples", type=int, default=50_000)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--baseline", action="store_true",
+                    help="print the sequential-digest small-sample "
+                         "baseline instead of the sweep")
     args = ap.parse_args(argv)
+    if args.baseline:
+        print(json.dumps(small_sample_baseline(seed=args.seed)))
+        return
 
     rows = sweep(samples=args.samples, seed=args.seed)
     with open(args.out, "w", newline="") as f:

@@ -29,3 +29,15 @@ def test_digest_sweep_csv_output(tmp_path):
     assert "100" in summary
     header = out.read_text().splitlines()[0]
     assert header.startswith("distribution,compression")
+
+
+def test_sequential_baseline_small_sample_regime():
+    """The e2e-config-2 accuracy framing: on 300-1000-sample lognormal
+    names, the reference-style sequential digest itself shows percent-
+    scale mean and ~10% max p99 error — the device digest is held to the
+    MEAN budget, and a double-digit max is the algorithm class."""
+    from benchmarks.tdigest_analysis import small_sample_baseline
+
+    b = small_sample_baseline(seed=7, trials=40)
+    assert 0.005 < b["err_mean"] < 0.05, b
+    assert b["err_max"] > 0.03, b

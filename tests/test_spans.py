@@ -165,3 +165,76 @@ def test_ssf_stream_unix_end_to_end(tmp_path):
         assert m["stream.count"].value == 6.0
     finally:
         srv.shutdown()
+
+
+def test_ingest_many_failure_falls_back_per_span_exactly_once():
+    """A sink whose ingest_many raises gets per-span redelivery; with an
+    atomic ingest_many (contract), every span is delivered exactly once."""
+    import time
+
+    from veneur_tpu.server.spans import SpanPipeline
+
+    class FlakySink:
+        name = "flaky"
+
+        def __init__(self):
+            self.got = []
+            self.many_calls = 0
+
+        def ingest_many(self, spans):
+            self.many_calls += 1
+            raise RuntimeError("batch path down")  # atomic: no state
+
+        def ingest(self, span):
+            self.got.append(span.id)
+
+    sink = FlakySink()
+    pipe = SpanPipeline([sink], capacity=1024, num_workers=2)
+    pipe.start()
+    try:
+        for i in range(200):
+            sp = make_span(trace_id=i + 1, span_id=i + 1)
+            assert pipe.handle_span(sp)
+        t0 = time.time()
+        while len(sink.got) < 200 and time.time() - t0 < 20:
+            time.sleep(0.01)
+    finally:
+        pipe.stop()
+    assert sink.many_calls > 0
+    assert sorted(sink.got) == list(range(1, 201))   # exactly once
+
+
+def test_tagfreq_ingest_many_atomic_on_update_failure():
+    """TagFrequencySink honors the atomicity contract: a device update
+    failure leaves buffers/counters untouched, so redelivery cannot
+    double-count."""
+    from veneur_tpu.sinks.tagfreq import TagFrequencySink
+
+    sink = TagFrequencySink(top_k=4, batch_size=8)
+    spans = [make_span(trace_id=i + 1, span_id=i + 1)
+             for i in range(8)]
+    for i, sp in enumerate(spans):
+        sp.tags["customer"] = f"c{i % 2}"
+
+    fails = {"n": 0}
+    real_update = sink.hh.update
+
+    def flaky_update(members, weights=None):
+        if fails["n"] == 0:
+            fails["n"] += 1
+            raise RuntimeError("device hiccup")
+        return real_update(members, weights)
+
+    sink.hh.update = flaky_update
+    try:
+        sink.ingest_many(spans)       # crosses batch_size -> update raises
+    except RuntimeError:
+        pass
+    assert sink.spans_seen == 0 and sink.members_seen == 0
+    assert sink._buf == []            # nothing half-staged
+    # redelivery per span (the pipeline's fallback) succeeds second time
+    for sp in spans:
+        sink.ingest(sp)
+    assert sink.spans_seen == 8
+    counts = dict(sink.hh.top(4))
+    assert counts[b"customer:c0"] == 4.0 and counts[b"customer:c1"] == 4.0

@@ -228,6 +228,9 @@ class Server:
         self.import_errors = 0
         self._packets_received = 0
         self._packets_dropped_py = 0
+        # orders shutdown's reader-counter fold against concurrent
+        # packets_received/packets_dropped reads on the flush thread
+        self._reader_fold_lock = threading.Lock()
         self._shutdown = threading.Event()
         self._stats_sock: Optional[socket.socket] = None
         self._stats_dest = None
@@ -445,18 +448,20 @@ class Server:
     def packets_received(self) -> int:
         """Python-read packets plus the native reader group's datagrams
         (C++ counters are mutex-guarded; readable from any thread)."""
-        n = self._packets_received
-        if self._native_readers_active:
-            n += self.aggregator.reader_counters()["datagrams"]
+        with self._reader_fold_lock:
+            n = self._packets_received
+            if self._native_readers_active:
+                n += self.aggregator.reader_counters()["datagrams"]
         return n
 
     @property
     def packets_dropped(self) -> int:
         """Datagrams lost to backpressure after the kernel delivered them:
         the native ring's overflow or the Python path's queue.Full drops."""
-        n = self._packets_dropped_py
-        if self._native_readers_active:
-            n += self.aggregator.reader_counters()["ring_dropped"]
+        with self._reader_fold_lock:
+            n = self._packets_dropped_py
+            if self._native_readers_active:
+                n += self.aggregator.reader_counters()["ring_dropped"]
         return n
 
     def _ssf_udp_reader(self, sock: socket.socket):
@@ -1130,12 +1135,13 @@ class Server:
         # Python ones FIRST: a FlushRequest already queued behind us will
         # snapshot packets_received, and losing the reader counts there
         # would emit a huge negative self-telemetry delta.
-        stop_native_readers = self._native_readers_active
-        if stop_native_readers:
-            rc = self.aggregator.reader_counters()
-            self._packets_received += rc["datagrams"]
-            self._packets_dropped_py += rc["ring_dropped"]
-        self._native_readers_active = False
+        with self._reader_fold_lock:
+            stop_native_readers = self._native_readers_active
+            if stop_native_readers:
+                rc = self.aggregator.reader_counters()
+                self._packets_received += rc["datagrams"]
+                self._packets_dropped_py += rc["ring_dropped"]
+            self._native_readers_active = False
         for s in self._sockets:
             try:
                 s.close()

@@ -11,6 +11,7 @@ from __future__ import annotations
 import logging
 from typing import Callable
 
+from veneur_tpu.protocol.wire import valid_trace
 from veneur_tpu.samplers import parser
 from veneur_tpu.sinks.base import SpanSink
 
@@ -32,24 +33,38 @@ class MetricExtractionSink(SpanSink):
         self.uniqueness_rate = uniqueness_rate
         self.invalid_samples = 0
 
-    def ingest(self, span) -> None:
-        from veneur_tpu.protocol.wire import valid_trace
-
+    def _extract(self, span, out: list) -> None:
         metrics, invalid = parser.convert_metrics(span)
         self.invalid_samples += len(invalid)
+        out.extend(metrics)
         # indicator + uniqueness extraction only for valid trace spans;
         # metric-carrier-only packets stop here (metrics.go:111-114)
         if valid_trace(span):
             if self.indicator_timer_name or self.objective_timer_name:
                 try:
-                    metrics.extend(parser.convert_indicator_metrics(
+                    out.extend(parser.convert_indicator_metrics(
                         span, self.indicator_timer_name,
                         self.objective_timer_name))
                 except parser.ParseError as e:
                     log.debug("indicator conversion failed: %s", e)
             if self.uniqueness_rate > 0:
-                metrics.extend(
+                out.extend(
                     parser.convert_span_uniqueness_metrics(
                         span, self.uniqueness_rate))
+
+    def ingest(self, span) -> None:
+        metrics: list = []
+        self._extract(span, metrics)
+        if metrics:
+            self.process_metrics(metrics)
+
+    def ingest_many(self, spans) -> None:
+        """One pipeline hand-off per worker batch instead of per span.
+        Atomic per the SpanPipeline contract: extraction happens into a
+        local list; counters aside, no state changes until the single
+        process_metrics call."""
+        metrics: list = []
+        for span in spans:
+            self._extract(span, metrics)
         if metrics:
             self.process_metrics(metrics)

@@ -57,35 +57,37 @@ class TagFrequencySink:
         return [f"{k}:{v}".encode() for k, v in span.tags.items()
                 if not self.tag_keys or k in self.tag_keys]
 
-    def ingest(self, span) -> None:
-        members = self._span_members(span)
+    def _ingest_members(self, members: List[bytes], n_spans: int) -> None:
+        """Single buffering path for both ingest flavors. Atomic per the
+        SpanPipeline retry contract: the (possibly raising) device update
+        runs BEFORE any state mutation, so a failure leaves the sink
+        exactly as it was and per-span redelivery cannot double-count."""
         if not members:
             return
         with self._lock:
-            self.spans_seen += 1
+            merged = self._buf + members
+            if len(merged) >= self.batch_size:
+                self.hh.update(merged)   # may raise -> nothing mutated
+                self._buf = []
+            else:
+                self._buf = merged
+            self.spans_seen += n_spans
             self.members_seen += len(members)
-            self._buf.extend(members)
-            if len(self._buf) >= self.batch_size:
-                self._drain_locked()
+
+    def ingest(self, span) -> None:
+        members = self._span_members(span)
+        self._ingest_members(members, 1 if members else 0)
 
     def ingest_many(self, spans) -> None:
-        """Batched span-worker path: one lock round-trip per batch.
-        Atomic per the SpanPipeline contract — all member extraction
-        happens before any state is touched, so a raise leaves the sink
-        unchanged and the pipeline's per-span retry stays exactly-once."""
-        members = []
+        """Batched span-worker path: one lock round-trip per batch."""
+        members: List[bytes] = []
         n_spans = 0
         for span in spans:
             m = self._span_members(span)
             if m:
                 n_spans += 1
                 members.extend(m)
-        with self._lock:
-            self.spans_seen += n_spans
-            self.members_seen += len(members)
-            self._buf.extend(members)
-            if len(self._buf) >= self.batch_size:
-                self._drain_locked()
+        self._ingest_members(members, n_spans)
 
     def _drain_locked(self):
         if self._buf:
