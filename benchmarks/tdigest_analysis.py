@@ -113,7 +113,7 @@ class SequentialDigest:
             self.compress()
 
     def compress(self):
-        if not self.buf and len(self.mean):
+        if not self.buf:
             return
         m = np.concatenate([self.mean, np.asarray(self.buf, np.float64)])
         w = np.concatenate([self.w, np.ones(len(self.buf))])
@@ -137,6 +137,8 @@ class SequentialDigest:
 
     def quantile(self, q: float) -> float:
         self.compress()
+        if not len(self.mean):
+            return float("nan")
         cum = np.cumsum(self.w) - self.w / 2
         return float(np.interp(q * self.w.sum(), cum, self.mean))
 

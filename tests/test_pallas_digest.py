@@ -1,0 +1,83 @@
+"""Parity of the Pallas quantile kernel (interpret mode on CPU) against
+the XLA path in ops/tdigest.py — the two must agree within float noise
+over random occupancy patterns, empties, and endpoint quantiles."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from veneur_tpu.ops import tdigest as td
+from veneur_tpu.ops.pallas_digest import (
+    _bitonic_sort_pairs, quantiles_rows)
+
+
+def _xla_rows(mean, weight, mn, mx, qs):
+    return np.asarray(jax.vmap(
+        td._quantiles_one, in_axes=(0, 0, 0, 0, None))(
+            jnp.asarray(mean), jnp.asarray(weight),
+            jnp.asarray(mn), jnp.asarray(mx), jnp.asarray(qs)))
+
+
+def test_bitonic_sort_matches_argsort():
+    rng = np.random.default_rng(0)
+    for c in (2, 8, 64, 256):
+        key = rng.uniform(-5, 5, (7, c)).astype(np.float32)
+        val = rng.uniform(0, 1, (7, c)).astype(np.float32)
+        sk, sv = _bitonic_sort_pairs(jnp.asarray(key), jnp.asarray(val))
+        order = np.argsort(key, axis=1, kind="stable")
+        np.testing.assert_array_equal(np.asarray(sk),
+                                      np.take_along_axis(key, order, 1))
+        # values ride with their keys (keys here are unique w.h.p.)
+        np.testing.assert_array_equal(np.asarray(sv),
+                                      np.take_along_axis(val, order, 1))
+
+
+def test_quantiles_parity_random_digests():
+    rng = np.random.default_rng(1)
+    r, c = 40, 232          # production cell count (non-power-of-two)
+    mean = rng.lognormal(2.0, 1.0, (r, c)).astype(np.float32)
+    weight = rng.uniform(0.0, 4.0, (r, c)).astype(np.float32)
+    # random sparsity incl. fully-empty and single-cell rows
+    weight[rng.uniform(size=(r, c)) < 0.5] = 0.0
+    weight[0] = 0.0
+    weight[1] = 0.0
+    weight[1, 17] = 3.0
+    mn = np.where(weight.sum(1) > 0,
+                  np.where(weight > 0, mean, np.inf).min(1),
+                  np.inf).astype(np.float32)
+    mx = np.where(weight.sum(1) > 0,
+                  np.where(weight > 0, mean, -np.inf).max(1),
+                  -np.inf).astype(np.float32)
+    qs = np.asarray([0.0, 0.01, 0.5, 0.99, 1.0], np.float32)
+
+    got = np.asarray(quantiles_rows(
+        jnp.asarray(mean), jnp.asarray(weight), jnp.asarray(mn),
+        jnp.asarray(mx), jnp.asarray(qs), interpret=True))
+    want = _xla_rows(mean, weight, mn, mx, qs)
+
+    # empty rows: NaN on both paths
+    assert np.isnan(got[0]).all() and np.isnan(want[0]).all()
+    live = ~np.isnan(want)
+    np.testing.assert_allclose(got[live], want[live], rtol=2e-5, atol=2e-5)
+
+
+def test_quantiles_parity_through_table():
+    """End-to-end through td.quantiles' row flattening (leading batch
+    shape preserved)."""
+    rng = np.random.default_rng(2)
+    spec_c = 64
+    mean = rng.normal(50, 10, (3, 5, spec_c)).astype(np.float32)
+    weight = rng.uniform(0, 2, (3, 5, spec_c)).astype(np.float32)
+    mn = np.where(weight > 0, mean, np.inf).min(-1).astype(np.float32)
+    mx = np.where(weight > 0, mean, -np.inf).max(-1).astype(np.float32)
+    qs = np.asarray([0.25, 0.75], np.float32)
+    got = np.asarray(quantiles_rows(
+        jnp.asarray(mean.reshape(-1, spec_c)),
+        jnp.asarray(weight.reshape(-1, spec_c)),
+        jnp.asarray(mn.reshape(-1)), jnp.asarray(mx.reshape(-1)),
+        jnp.asarray(qs), interpret=True)).reshape(3, 5, 2)
+    want = _xla_rows(mean.reshape(-1, spec_c), weight.reshape(-1, spec_c),
+                     mn.reshape(-1), mx.reshape(-1), qs).reshape(3, 5, 2)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)

@@ -1,0 +1,195 @@
+"""Pallas TPU kernel for t-digest quantiles: per-row bitonic sort +
+prefix-sum + piecewise-linear interpolation fused in VMEM.
+
+The XLA path (ops/tdigest.py quantiles) lowers to a generic variadic
+sort, a gather, and several elementwise passes — each a round-trip
+through HBM over the [rows, cells] arrays. Rows are independent and a
+row (≤256 cells after padding) fits comfortably in VMEM, so the whole
+reduction is one kernel: load a tile of rows, sort each row's
+(mean, weight) pairs with a fixed bitonic network (static shapes — the
+digest's cell count is compile-time), cumsum, and evaluate the midpoint
+interpolation for every requested quantile without ever leaving VMEM.
+
+The sort is the standard vectorized bitonic network expressed with
+reshape-based compare-exchange (no dynamic indexing — Pallas/TPU wants
+static addressing), ~log²(C)/2 vectorized passes over the tile.
+Interpolation avoids gathers entirely: for each quantile, every
+adjacent centroid interval computes its candidate value and a one-hot
+interval mask selects the right one (VPU-friendly mask+reduce).
+
+Used by ops/tdigest.quantiles when `enabled()` — a real TPU backend
+that passes a one-time probe compile (the tunneled dev platform is
+experimental; a probe failure falls back to the XLA path rather than
+breaking every flush). Force with VENEUR_TPU_PALLAS=1/0. Parity with
+the XLA path is asserted bit-tolerantly in tests/test_pallas_digest.py
+using interpret mode, which runs the same kernel on CPU.
+
+Reference behavioral contract: merging_digest.go:302 Quantile (midpoint
+interpolation between centroid masses, min/max endpoints).
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+log = logging.getLogger("veneur_tpu.ops.pallas_digest")
+
+ROW_TILE = 256  # rows per grid step; [256, 256] f32 tiles ≈ 256KB VMEM each
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+def _bitonic_sort_pairs(key, val):
+    """Sort (key, val) rows ascending by key along the last axis with a
+    bitonic network. Static shapes only: last dim must be a power of two.
+    key/val: f32[..., C]."""
+    c = key.shape[-1]
+    lead = key.shape[:-1]
+    k = 2
+    while k <= c:
+        j = k // 2
+        while j >= 1:
+            # partner exchange at distance j via reshape [..., C/2j, 2, j]
+            ks = key.reshape(lead + (c // (2 * j), 2, j))
+            vs = val.reshape(lead + (c // (2 * j), 2, j))
+            lo_k, hi_k = ks[..., 0, :], ks[..., 1, :]
+            lo_v, hi_v = vs[..., 0, :], vs[..., 1, :]
+            # ascending blocks of size k: direction flips per k-block;
+            # base = the pair's flat index with the partner bit clear
+            base = jax.lax.broadcasted_iota(
+                jnp.int32, (c // (2 * j), j), 0) * (2 * j) \
+                + jax.lax.broadcasted_iota(jnp.int32, (c // (2 * j), j), 1)
+            asc = ((base // k) % 2) == 0          # [C/2j, j]
+            swap = jnp.where(asc, lo_k > hi_k, lo_k < hi_k)
+            new_lo_k = jnp.where(swap, hi_k, lo_k)
+            new_hi_k = jnp.where(swap, lo_k, hi_k)
+            new_lo_v = jnp.where(swap, hi_v, lo_v)
+            new_hi_v = jnp.where(swap, lo_v, hi_v)
+            key = jnp.stack([new_lo_k, new_hi_k], axis=-2).reshape(
+                lead + (c,))
+            val = jnp.stack([new_lo_v, new_hi_v], axis=-2).reshape(
+                lead + (c,))
+            j //= 2
+        k *= 2
+    return key, val
+
+
+def _quantile_kernel(qs_ref, m_ref, w_ref, mn_ref, mx_ref, out_ref,
+                     *, n_q: int):
+    m = m_ref[...]                                   # [T, C]
+    w = w_ref[...]
+    mn = mn_ref[...]                                 # [T, 1]
+    mx = mx_ref[...]
+    live = w > 0
+    key = jnp.where(live, m, jnp.float32(jnp.inf))
+    skey, sw = _bitonic_sort_pairs(key, jnp.where(live, w, 0.0))
+    tot = jnp.sum(sw, axis=-1, keepdims=True)        # [T, 1]
+    cum = jnp.cumsum(sw, axis=-1)
+    mid = cum - 0.5 * sw
+    # breakpoints: xs = [0, mid_0..mid_{C-1}, tot], ys = [min, mean.., max]
+    # (empty cells collapse onto (tot, max): identical to the XLA path)
+    occupied = sw > 0
+    xs = jnp.where(occupied, mid, tot)
+    ys = jnp.where(occupied, skey, mx)
+    for qi in range(n_q):
+        t = qs_ref[qi] * tot                         # [T, 1]
+        # interval [xs_k, xs_{k+1}) containing t, plus the two endpoint
+        # segments; one-hot masks instead of a gather
+        x_lo = jnp.concatenate([jnp.zeros_like(tot), xs], axis=-1)
+        x_hi = jnp.concatenate([xs, tot], axis=-1)
+        y_lo = jnp.concatenate([mn, ys], axis=-1)
+        y_hi = jnp.concatenate([ys, mx], axis=-1)
+        denom = jnp.maximum(x_hi - x_lo, jnp.float32(1e-30))
+        seg = y_lo + (t - x_lo) * (y_hi - y_lo) / denom
+        inside = (t >= x_lo) & (t < x_hi)
+        # t == tot falls outside every half-open interval: clamp to max
+        any_inside = jnp.any(inside, axis=-1, keepdims=True)
+        picked = jnp.sum(jnp.where(inside, seg, 0.0), axis=-1,
+                         keepdims=True)
+        # degenerate intervals (duplicate xs) can double-select; divide
+        # by the selection count to keep the value (all dups are equal)
+        n_sel = jnp.maximum(
+            jnp.sum(inside.astype(jnp.float32), axis=-1, keepdims=True),
+            1.0)
+        v = jnp.where(any_inside, picked / n_sel, mx)
+        v = jnp.where(tot > 0, v, jnp.float32(jnp.nan))
+        out_ref[:, qi:qi + 1] = v
+
+
+def quantiles_rows(mean, weight, mn, mx, qs, *, interpret: bool = False):
+    """Pallas quantiles over rows: mean/weight f32[R, C], mn/mx f32[R],
+    qs f32[Q] -> f32[R, Q]. R is padded to a ROW_TILE multiple and C to
+    a power of two (pad cells carry weight 0)."""
+    r, c = mean.shape
+    n_q = int(qs.shape[0])
+    c_pad = max(_next_pow2(c), 128)
+    r_pad = ((r + ROW_TILE - 1) // ROW_TILE) * ROW_TILE
+    if c_pad != c or r_pad != r:
+        mean = jnp.pad(mean, ((0, r_pad - r), (0, c_pad - c)))
+        weight = jnp.pad(weight, ((0, r_pad - r), (0, c_pad - c)))
+        mn = jnp.pad(mn, (0, r_pad - r))
+        mx = jnp.pad(mx, (0, r_pad - r))
+    grid = (r_pad // ROW_TILE,)
+    out = pl.pallas_call(
+        functools.partial(_quantile_kernel, n_q=n_q),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((n_q,), lambda i: (0,)),
+            pl.BlockSpec((ROW_TILE, c_pad), lambda i: (i, 0)),
+            pl.BlockSpec((ROW_TILE, c_pad), lambda i: (i, 0)),
+            pl.BlockSpec((ROW_TILE, 1), lambda i: (i, 0)),
+            pl.BlockSpec((ROW_TILE, 1), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((ROW_TILE, n_q), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((r_pad, n_q), jnp.float32),
+        interpret=interpret,
+    )(jnp.asarray(qs, jnp.float32), mean, weight,
+      mn.reshape(-1, 1), mx.reshape(-1, 1))
+    return out[:r]
+
+
+_PROBE_RESULT = None
+
+
+def enabled() -> bool:
+    """Use the Pallas path? VENEUR_TPU_PALLAS=1/0 forces; default is a
+    one-time probe compile on the real-TPU backend (the dev tunnel's
+    Pallas lowering is experimental — a broken lowering must degrade to
+    the XLA path, not break every flush)."""
+    global _PROBE_RESULT
+    force = os.environ.get("VENEUR_TPU_PALLAS", "")
+    if force == "1":
+        return True
+    if force == "0":
+        return False
+    if _PROBE_RESULT is None:
+        try:
+            if jax.devices()[0].platform == "cpu":
+                _PROBE_RESULT = False
+            else:
+                out = quantiles_rows(
+                    jnp.asarray([[1.0, 2.0, 3.0, 4.0]], jnp.float32),
+                    jnp.ones((1, 4), jnp.float32),
+                    jnp.asarray([1.0], jnp.float32),
+                    jnp.asarray([4.0], jnp.float32),
+                    jnp.asarray([0.5], jnp.float32))
+                # exact answer is 2.5 (midpoint interpolation between
+                # centroids 2 and 3); a loose tolerance would accept a
+                # miscompiled lowering that returns a raw centroid
+                _PROBE_RESULT = bool(abs(float(out[0, 0]) - 2.5) < 1e-3)
+        except Exception as e:  # noqa: BLE001 — any failure => XLA path
+            log.warning("pallas quantile kernel unavailable, using XLA "
+                        "path: %s", e)
+            _PROBE_RESULT = False
+    return _PROBE_RESULT

@@ -213,13 +213,24 @@ def _quantiles_one(mean, weight, mn, mx, qs):
 
 
 def quantiles(table: TDigestTable, qs) -> jax.Array:
-    """Quantiles for every digest: returns f32[..., Q]."""
+    """Quantiles for every digest: returns f32[..., Q]. On a real TPU
+    backend this routes to the fused Pallas kernel (sort + cumsum +
+    interpolation in one VMEM pass, ops/pallas_digest.py) when its probe
+    compile succeeds; the XLA vmap path is the portable fallback and the
+    parity oracle (tests/test_pallas_digest.py)."""
     qs = jnp.asarray(qs, jnp.float32)
     lead = table.mean.shape[:-1]
-    flat = jax.vmap(_quantiles_one, in_axes=(0, 0, 0, 0, None))(
-        table.mean.reshape((-1, table.mean.shape[-1])),
-        table.weight.reshape((-1, table.weight.shape[-1])),
-        table.min.reshape((-1,)), table.max.reshape((-1,)), qs)
+    c = table.mean.shape[-1]
+    m = table.mean.reshape((-1, c))
+    w = table.weight.reshape((-1, c))
+    mn = table.min.reshape((-1,))
+    mx = table.max.reshape((-1,))
+    from veneur_tpu.ops import pallas_digest
+    if pallas_digest.enabled():
+        flat = pallas_digest.quantiles_rows(m, w, mn, mx, qs)
+    else:
+        flat = jax.vmap(_quantiles_one, in_axes=(0, 0, 0, 0, None))(
+            m, w, mn, mx, qs)
     return flat.reshape(lead + (qs.shape[0],))
 
 

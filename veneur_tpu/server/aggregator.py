@@ -125,8 +125,11 @@ class Aggregator:
             # imported recip minus what the centroid re-add will add.
             live = weights > 0
             means, weights = means[live], weights[live]
-            for v, w in zip(means, weights):
-                self.batcher.add_histo_weighted(slot, float(v), float(w))
+            # bulk-stage the centroid re-add: a per-centroid Python call
+            # costs ~230 calls per imported digest and dominated the
+            # global tier's import throughput (BASELINE config 4)
+            self.batcher.add_histos_bulk(
+                np.full(len(means), slot, np.int32), means, weights)
             mn = float(payload.get("min", np.inf))
             mx = float(payload.get("max", -np.inf))
             recip = payload.get("recip")

@@ -198,8 +198,8 @@ class ShardedAggregator(Aggregator):
             weights = np.asarray(payload["weights"], np.float32)
             live = weights > 0
             means, weights = means[live], weights[live]
-            for v, w in zip(means, weights):
-                b.add_histo_weighted(local, float(v), float(w))
+            b.add_histos_bulk(np.full(len(means), local, np.int32),
+                              means, weights)
             recip = payload.get("recip")
             recip_corr = 0.0
             if recip is not None and np.all(means != 0.0):

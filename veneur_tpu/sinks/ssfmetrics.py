@@ -33,9 +33,11 @@ class MetricExtractionSink(SpanSink):
         self.uniqueness_rate = uniqueness_rate
         self.invalid_samples = 0
 
-    def _extract(self, span, out: list) -> None:
+    def _extract(self, span, out: list) -> int:
+        """Returns the span's invalid-sample count instead of mutating
+        state — callers fold it in only after the pipeline hand-off
+        succeeds (SpanPipeline atomicity contract)."""
         metrics, invalid = parser.convert_metrics(span)
-        self.invalid_samples += len(invalid)
         out.extend(metrics)
         # indicator + uniqueness extraction only for valid trace spans;
         # metric-carrier-only packets stop here (metrics.go:111-114)
@@ -51,20 +53,24 @@ class MetricExtractionSink(SpanSink):
                 out.extend(
                     parser.convert_span_uniqueness_metrics(
                         span, self.uniqueness_rate))
+        return len(invalid)
 
     def ingest(self, span) -> None:
         metrics: list = []
-        self._extract(span, metrics)
+        invalid = self._extract(span, metrics)
         if metrics:
             self.process_metrics(metrics)
+        self.invalid_samples += invalid
 
     def ingest_many(self, spans) -> None:
         """One pipeline hand-off per worker batch instead of per span.
-        Atomic per the SpanPipeline contract: extraction happens into a
-        local list; counters aside, no state changes until the single
-        process_metrics call."""
+        Atomic per the SpanPipeline contract: nothing — not even the
+        invalid-sample counter — mutates until the single
+        process_metrics call has succeeded."""
         metrics: list = []
+        invalid = 0
         for span in spans:
-            self._extract(span, metrics)
+            invalid += self._extract(span, metrics)
         if metrics:
             self.process_metrics(metrics)
+        self.invalid_samples += invalid
