@@ -102,16 +102,19 @@ def _quantile_kernel(qs_ref, m_ref, w_ref, mn_ref, mx_ref, out_ref,
     occupied = sw > 0
     xs = jnp.where(occupied, mid, tot)
     ys = jnp.where(occupied, skey, mx)
+    # interval breakpoints are quantile-invariant: build the segment
+    # tables once, only t/inside/seg vary per quantile
+    x_lo = jnp.concatenate([jnp.zeros_like(tot), xs], axis=-1)
+    x_hi = jnp.concatenate([xs, tot], axis=-1)
+    y_lo = jnp.concatenate([mn, ys], axis=-1)
+    y_hi = jnp.concatenate([ys, mx], axis=-1)
+    denom = jnp.maximum(x_hi - x_lo, jnp.float32(1e-30))
+    slope = (y_hi - y_lo) / denom
     for qi in range(n_q):
         t = qs_ref[qi] * tot                         # [T, 1]
         # interval [xs_k, xs_{k+1}) containing t, plus the two endpoint
         # segments; one-hot masks instead of a gather
-        x_lo = jnp.concatenate([jnp.zeros_like(tot), xs], axis=-1)
-        x_hi = jnp.concatenate([xs, tot], axis=-1)
-        y_lo = jnp.concatenate([mn, ys], axis=-1)
-        y_hi = jnp.concatenate([ys, mx], axis=-1)
-        denom = jnp.maximum(x_hi - x_lo, jnp.float32(1e-30))
-        seg = y_lo + (t - x_lo) * (y_hi - y_lo) / denom
+        seg = y_lo + (t - x_lo) * slope
         inside = (t >= x_lo) & (t < x_hi)
         # t == tot falls outside every half-open interval: clamp to max
         any_inside = jnp.any(inside, axis=-1, keepdims=True)
@@ -178,16 +181,29 @@ def enabled() -> bool:
             if jax.devices()[0].platform == "cpu":
                 _PROBE_RESULT = False
             else:
-                out = quantiles_rows(
-                    jnp.asarray([[1.0, 2.0, 3.0, 4.0]], jnp.float32),
-                    jnp.ones((1, 4), jnp.float32),
-                    jnp.asarray([1.0], jnp.float32),
-                    jnp.asarray([4.0], jnp.float32),
-                    jnp.asarray([0.5], jnp.float32))
+                # probe the PRODUCTION calling contexts, not just the
+                # standalone kernel: the flush paths run this under jit
+                # (and the sharded merge under vmap inside shard_map),
+                # where a missing pallas batching/lowering rule fails at
+                # outer compile time — that failure must land here, not
+                # in the first real flush
+                def call(m, w, mn, mx):
+                    return quantiles_rows(
+                        m, w, mn, mx, jnp.asarray([0.5], jnp.float32))
+
+                m = jnp.asarray([[1.0, 2.0, 3.0, 4.0]], jnp.float32)
+                w = jnp.ones((1, 4), jnp.float32)
+                mn = jnp.asarray([1.0], jnp.float32)
+                mx = jnp.asarray([4.0], jnp.float32)
+                out = jax.jit(call)(m, w, mn, mx)
+                out_v = jax.jit(jax.vmap(call))(
+                    m[None], w[None], mn[None], mx[None])
                 # exact answer is 2.5 (midpoint interpolation between
                 # centroids 2 and 3); a loose tolerance would accept a
                 # miscompiled lowering that returns a raw centroid
-                _PROBE_RESULT = bool(abs(float(out[0, 0]) - 2.5) < 1e-3)
+                _PROBE_RESULT = bool(
+                    abs(float(out[0, 0]) - 2.5) < 1e-3
+                    and abs(float(out_v[0, 0, 0]) - 2.5) < 1e-3)
         except Exception as e:  # noqa: BLE001 — any failure => XLA path
             log.warning("pallas quantile kernel unavailable, using XLA "
                         "path: %s", e)

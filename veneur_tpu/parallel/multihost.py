@@ -1,0 +1,113 @@
+"""Multi-host mesh plumbing: jax.distributed init + SPMD-safe placement.
+
+## Architecture: where ICI ends and DCN begins
+
+The reference scales across hosts with a name-keyed gRPC tier
+(flusher.go:474 forwardGRPC -> importsrv; proxied by the consistent-hash
+router). This framework keeps that tier as the DCN backend ON PURPOSE:
+
+- **Within a host/slice** (chips joined by ICI): the aggregation state
+  shards over a `(replica, shard)` Mesh and the global merge is XLA
+  collectives (parallel/sharded.py) — psum / all-gather / register-max
+  ride ICI, exactly where the hardware wants them.
+- **Between hosts** (DCN): metric keys are dynamic strings; each host's
+  key table assigns slots in arrival order, so two hosts' raw state
+  arrays are NOT slot-aligned and cannot be psum-merged. The name-keyed
+  gRPC forward/import path (forward/rpc.py -> server import) re-keys on
+  the receiving tier — the TPU-native analogue of the reference's
+  cross-host protocol, and the reason collectives never cross DCN for
+  ingest. ("Lay out shardings so collectives ride ICI, not DCN.")
+
+What multi-PROCESS jax (this module) is still for: a pod slice whose
+hosts share one SPMD program — e.g. a global tier whose *merge
+collectives* span hosts. jax.distributed joins the processes, the mesh
+is built over GLOBAL devices, and the helpers below create/place arrays
+in the multi-controller world where plain `jax.device_put(host_array,
+NamedSharding)` is not allowed. The cross-process collective merge is
+validated end-to-end (2 processes, CPU Gloo backend) in
+tests/test_multihost.py; slot alignment there is the caller's contract,
+as it is for replicas inside one process.
+"""
+
+from __future__ import annotations
+
+import os
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from veneur_tpu.aggregation.state import TableSpec, empty_state
+from veneur_tpu.parallel.sharded import state_sharding
+
+
+def init_multihost(coordinator_address: str = None,
+                   num_processes: int = None,
+                   process_id: int = None) -> None:
+    """Join this server process into a multi-controller jax runtime.
+    Arguments default from VENEUR_TPU_COORDINATOR / _NUM_PROCESSES /
+    _PROCESS_ID (mirroring the reference's env-driven fleet config);
+    no-op when neither arguments nor env are set."""
+    coordinator_address = coordinator_address or os.environ.get(
+        "VENEUR_TPU_COORDINATOR", "")
+    if not coordinator_address:
+        return
+    # unset stays None: jax.distributed auto-detects num_processes /
+    # process_id on managed TPU fleets; explicit sentinels would poison
+    # that detection and hang cluster formation
+    if num_processes is None:
+        env = os.environ.get("VENEUR_TPU_NUM_PROCESSES", "")
+        num_processes = int(env) if env else None
+    if process_id is None:
+        env = os.environ.get("VENEUR_TPU_PROCESS_ID", "")
+        process_id = int(env) if env else None
+    jax.distributed.initialize(coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+
+
+def multihost_empty_state(spec: TableSpec, n_replicas: int, n_shards: int,
+                          mesh):
+    """sharded_empty_state for a mesh that may span processes: arrays are
+    created INSIDE jit with out_shardings (SPMD-safe — every process runs
+    the identical program; no host array ever needs global placement)."""
+    sh = state_sharding(mesh)
+
+    def make():
+        one = empty_state(spec)
+        return jax.tree.map(
+            lambda x: jnp.broadcast_to(x, (n_replicas, n_shards) + x.shape),
+            one)
+
+    shardings = jax.tree.map(lambda _: sh, jax.eval_shape(make))
+    return jax.jit(make, out_shardings=shardings)()
+
+
+def put_process_local_rows(local, mesh, global_leading: int):
+    """Place each process's [r_local, ...] rows of a [R, ...] row-sharded
+    global array (R = global_leading split over the replica axis).
+    `local` is host numpy for THIS process's replica rows. Single-process
+    meshes fall back to a plain device_put."""
+    sharding = NamedSharding(mesh, P("replica"))
+    if jax.process_count() == 1:
+        return jax.device_put(local, sharding)
+    global_shape = (global_leading,) + tuple(local.shape[1:])
+    return jax.make_array_from_process_local_data(
+        sharding, local, global_shape)
+
+
+def put_process_local_batch(stacked_local, mesh, n_replicas: int):
+    """Global [R, S, ...] Batch from each process's local [r_local, S, ...]
+    stacked rows (stack_batches output for the process's replicas)."""
+    sh = NamedSharding(mesh, P("replica", "shard"))
+
+    def place(x):
+        if x is None:
+            return None
+        if jax.process_count() == 1:
+            return jax.device_put(x, sh)
+        global_shape = (n_replicas,) + tuple(x.shape[1:])
+        return jax.make_array_from_process_local_data(sh, x, global_shape)
+
+    return jax.tree.map(place, stacked_local, is_leaf=lambda x: x is None)
