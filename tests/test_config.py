@@ -73,3 +73,18 @@ def test_example_yaml_is_strictly_valid():
     pcfg = read_proxy_config(os.path.join(root, "example_proxy.yaml"),
                              env={})
     assert pcfg.unknown_keys == []
+
+
+def test_deprecated_trace_lightstep_aliases_fill_canonical():
+    """reference config_parse.go:185-210: trace_lightstep_* fills the
+    lightstep_* key only when the canonical key is unset."""
+    import io
+
+    from veneur_tpu.config import read_config
+
+    cfg = read_config(io.StringIO(
+        "trace_lightstep_access_token: tok\n"
+        "lightstep_collector_host: canonical\n"
+        "trace_lightstep_collector_host: deprecated\n"), env={})
+    assert cfg.lightstep_access_token == "tok"
+    assert cfg.lightstep_collector_host == "canonical"

@@ -12,7 +12,6 @@ insertion order here).
 """
 
 import os
-import socket
 import subprocess
 import sys
 
@@ -79,11 +78,9 @@ print(f"rank{rank} MERGED OK median={med}", flush=True)
 def test_two_process_collective_merge(tmp_path):
     if sys.platform != "linux":
         pytest.skip("gloo cpu backend exercised on linux only")
-    # pick a free port for the coordinator
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = str(s.getsockname()[1])
-    s.close()
+    # pid-derived coordinator port (a bind-then-close free-port probe is
+    # TOCTOU-racy on a busy host); stays clear of the ephemeral range
+    port = str(20000 + os.getpid() % 20000)
     script = tmp_path / "child.py"
     script.write_text(_CHILD)
     env = dict(os.environ,

@@ -172,6 +172,14 @@ class Config:
     lightstep_reconnect_period: str = ""
     lightstep_maximum_spans: int = 0
     lightstep_num_clients: int = 0
+    # deprecated aliases the reference still parses with a warning
+    # (config_parse.go:185-210): trace_lightstep_* fills lightstep_*
+    # only when the canonical key is unset
+    trace_lightstep_access_token: str = ""
+    trace_lightstep_collector_host: str = ""
+    trace_lightstep_reconnect_period: str = ""
+    trace_lightstep_maximum_spans: int = 0
+    trace_lightstep_num_clients: int = 0
     xray_address: str = ""
     xray_annotation_tags: List[str] = dataclasses.field(default_factory=list)
     xray_sample_percentage: float = 0.0
@@ -309,6 +317,14 @@ def read_config(path_or_file, env: Optional[dict] = None,
         if cur == _FIELDS[k].default or (
                 isinstance(cur, list) and not cur) or cur in ("", 0):
             setattr(cfg, k, v)
+    for stem in ("access_token", "collector_host", "reconnect_period",
+                 "maximum_spans", "num_clients"):
+        dep = getattr(cfg, f"trace_lightstep_{stem}")
+        if dep:
+            log.warning("trace_lightstep_%s has been replaced by "
+                        "lightstep_%s", stem, stem)
+            if not getattr(cfg, f"lightstep_{stem}"):
+                setattr(cfg, f"lightstep_{stem}", dep)
     if not cfg.hostname and not cfg.omit_empty_hostname:
         import socket
         cfg.hostname = socket.gethostname()

@@ -32,7 +32,6 @@ as it is for replicas inside one process.
 from __future__ import annotations
 
 import os
-from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -82,19 +81,6 @@ def multihost_empty_state(spec: TableSpec, n_replicas: int, n_shards: int,
 
     shardings = jax.tree.map(lambda _: sh, jax.eval_shape(make))
     return jax.jit(make, out_shardings=shardings)()
-
-
-def put_process_local_rows(local, mesh, global_leading: int):
-    """Place each process's [r_local, ...] rows of a [R, ...] row-sharded
-    global array (R = global_leading split over the replica axis).
-    `local` is host numpy for THIS process's replica rows. Single-process
-    meshes fall back to a plain device_put."""
-    sharding = NamedSharding(mesh, P("replica"))
-    if jax.process_count() == 1:
-        return jax.device_put(local, sharding)
-    global_shape = (global_leading,) + tuple(local.shape[1:])
-    return jax.make_array_from_process_local_data(
-        sharding, local, global_shape)
 
 
 def put_process_local_batch(stacked_local, mesh, n_replicas: int):
