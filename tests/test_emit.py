@@ -1,0 +1,85 @@
+"""veneur-emit CLI surface (reference cmd/veneur-emit/main.go): packet
+shapes round-trip through this framework's own parser."""
+
+import socket
+import threading
+
+from veneur_tpu.cli.emit import main as emit_main
+from veneur_tpu.samplers import parser
+
+
+def _recv_udp(n_packets, port_holder, done):
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.bind(("127.0.0.1", 0))
+    s.settimeout(10)
+    port_holder.append(s.getsockname()[1])
+    got = []
+    try:
+        while len(got) < n_packets:
+            got.append(s.recv(65536))
+    except socket.timeout:
+        pass
+    finally:
+        s.close()
+    done.extend(got)
+
+
+def _run_emit(args, n_packets=1):
+    port_holder, got = [], []
+    t = threading.Thread(target=_recv_udp, args=(n_packets, port_holder,
+                                                 got))
+    t.start()
+    while not port_holder:
+        pass
+    rc = emit_main(["-hostport", f"udp://127.0.0.1:{port_holder[0]}"]
+                   + args)
+    t.join(timeout=12)
+    assert rc == 0
+    return got
+
+
+def test_event_all_fields():
+    (pkt,) = _run_emit([
+        "-e_title", "deploy", "-e_text", "v2 shipped",
+        "-e_time", "1700000000", "-e_hostname", "web1",
+        "-e_aggr_key", "deploys", "-e_priority", "low",
+        "-e_source_type", "ci", "-e_alert_type", "info",
+        "-e_event_tags", "env:prod", "-tag", "team:infra"])
+    ev = parser.parse_event(pkt)
+    assert ev.name == "deploy" and "v2 shipped" in ev.message
+    assert ev.timestamp == 1700000000
+    assert ev.tags["team"] == "infra" and ev.tags["env"] == "prod"
+    assert ev.tags["vdogstatsd_hostname"] == "web1"
+    assert ev.tags["vdogstatsd_pri"] == "low"
+    assert ev.tags["vdogstatsd_at"] == "info"
+
+
+def test_service_check_all_fields():
+    (pkt,) = _run_emit([
+        "-sc_name", "db.up", "-sc_status", "1", "-sc_msg", "degraded",
+        "-sc_time", "1700000000", "-sc_hostname", "db1",
+        "-sc_tags", "shard:3"])
+    m = parser.parse_service_check(pkt)
+    assert m.name == "db.up" and m.value == 1.0
+    assert m.message == "degraded"
+    assert "shard:3" in m.tags
+
+
+def test_legacy_long_event_flag_spellings_still_work():
+    (pkt,) = _run_emit(["-event_title", "t", "-event_text", "x"])
+    ev = parser.parse_event(pkt)
+    assert ev.name == "t"
+
+
+def test_ssf_span_identity_flags():
+    from veneur_tpu.protocol.wire import parse_ssf
+    (pkt,) = _run_emit([
+        "-ssf", "-trace_id", "42", "-parent_span_id", "7",
+        "-span_service", "svc-x", "-name", "op", "-error",
+        "-span_starttime", "1700000000", "-span_endtime", "1700000001",
+        "-count", "1"])
+    span = parse_ssf(pkt)
+    assert span.trace_id == 42 and span.parent_id == 7
+    assert span.service == "svc-x" and span.name == "op" and span.error
+    assert span.end_timestamp - span.start_timestamp == int(1e9)
+    assert span.metrics[0].name == "op" if span.metrics else True

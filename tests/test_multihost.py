@@ -78,9 +78,10 @@ print(f"rank{rank} MERGED OK median={med}", flush=True)
 def test_two_process_collective_merge(tmp_path):
     if sys.platform != "linux":
         pytest.skip("gloo cpu backend exercised on linux only")
-    # pid-derived coordinator port (a bind-then-close free-port probe is
-    # TOCTOU-racy on a busy host); stays clear of the ephemeral range
-    port = str(20000 + os.getpid() % 20000)
+    # pid-derived coordinator port below the ephemeral range (32768+),
+    # above the registered range's busy spots (a bind-then-close
+    # free-port probe would be TOCTOU-racy)
+    port = str(21000 + os.getpid() % 11000)
     script = tmp_path / "child.py"
     script.write_text(_CHILD)
     env = dict(os.environ,

@@ -36,9 +36,15 @@ def build_event_packet(title, text, tags=(), **fields):
     return body.encode()
 
 
-def build_service_check_packet(name, status, tags=(), message=""):
-    """reference cmd/veneur-emit/main.go:715."""
+def build_service_check_packet(name, status, tags=(), message="",
+                               timestamp="", hostname=""):
+    """reference cmd/veneur-emit/main.go:715 (field order: d:, h:, then
+    #tags, m: last — the parser requires the message field terminal)."""
     body = f"_sc|{name}|{status}"
+    if timestamp:
+        body += f"|d:{timestamp}"
+    if hostname:
+        body += f"|h:{hostname}"
     if tags:
         body += "|#" + ",".join(tags)
     if message:
@@ -78,11 +84,42 @@ def main(argv=None):
     ap.add_argument("-set", dest="set_", default=None)
     ap.add_argument("-tag", default="", help="comma-separated k:v tags")
     ap.add_argument("-sample_rate", type=float, default=1.0)
-    ap.add_argument("-event_title", default="")
-    ap.add_argument("-event_text", default="")
+    ap.add_argument("-mode", default="metric",
+                    choices=["metric", "event", "sc"],
+                    help="payload kind (reference -mode; event/sc fields "
+                         "also imply their mode)")
+    ap.add_argument("-debug", action="store_true")
+    # events: reference flag names are e_*; the long spellings are kept
+    # as aliases
+    ap.add_argument("-e_title", "-event_title", dest="event_title",
+                    default="")
+    ap.add_argument("-e_text", "-event_text", dest="event_text", default="")
+    ap.add_argument("-e_time", default="", help="event timestamp (d:)")
+    ap.add_argument("-e_hostname", default="")
+    ap.add_argument("-e_aggr_key", default="")
+    ap.add_argument("-e_priority", default="", help="normal|low")
+    ap.add_argument("-e_source_type", default="")
+    ap.add_argument("-e_alert_type", default="",
+                    help="error|warning|info|success")
+    ap.add_argument("-e_event_tags", default="",
+                    help="comma-separated tags for the event only")
+    # service checks
     ap.add_argument("-sc_name", default="")
     ap.add_argument("-sc_status", type=int, default=0)
     ap.add_argument("-sc_msg", default="")
+    ap.add_argument("-sc_time", default="", help="check timestamp (d:)")
+    ap.add_argument("-sc_hostname", default="")
+    ap.add_argument("-sc_tags", default="",
+                    help="comma-separated tags for the check only")
+    # span identity (SSF mode)
+    ap.add_argument("-trace_id", type=int, default=0)
+    ap.add_argument("-parent_span_id", type=int, default=0)
+    ap.add_argument("-span_service", default="",
+                    help="alias for -service (reference flag name)")
+    ap.add_argument("-span_starttime", default="")
+    ap.add_argument("-span_endtime", default="")
+    ap.add_argument("-error", action="store_true",
+                    help="mark the emitted span as errored")
     ap.add_argument("-ssf", action="store_true",
                     help="emit SSF protobuf instead of statsd text "
                          "(reference veneur-emit -ssf)")
@@ -102,6 +139,15 @@ def main(argv=None):
         print("-ssf mode does not support events, service checks, sample "
               "rates, or -replay (reference veneur-emit rejects these too)",
               file=sys.stderr)
+        return 2
+    # a selected mode must carry its required field — the parser on the
+    # receiving end rejects nameless events/checks, so emitting one
+    # would silently drop
+    if args.mode == "event" and not args.event_title:
+        print("-mode event requires -e_title", file=sys.stderr)
+        return 2
+    if args.mode == "sc" and not args.sc_name:
+        print("-mode sc requires -sc_name", file=sys.stderr)
         return 2
     kind, sock = open_sink(args.hostport)
     # stream transports need the newline frame delimiter
@@ -145,14 +191,22 @@ def main(argv=None):
         if args.set_ is not None:
             packets.append(build_metric_packet(
                 args.name, args.set_, "s", tags=tags))
-        if args.event_title:
+        if args.event_title or args.mode == "event":
+            etags = tags + [t for t in args.e_event_tags.split(",") if t]
             packets.append(build_event_packet(
-                args.event_title, args.event_text, tags))
-        if args.sc_name:
+                args.event_title, args.event_text, etags,
+                d=args.e_time, h=args.e_hostname, k=args.e_aggr_key,
+                p=args.e_priority, s=args.e_source_type,
+                t=args.e_alert_type))
+        if args.sc_name or args.mode == "sc":
+            sctags = tags + [t for t in args.sc_tags.split(",") if t]
             packets.append(build_service_check_packet(
-                args.sc_name, args.sc_status, tags, args.sc_msg))
+                args.sc_name, args.sc_status, sctags, args.sc_msg,
+                timestamp=args.sc_time, hostname=args.sc_hostname))
 
     for p in packets:
+        if args.debug:
+            print(f"sending {p!r}", file=sys.stderr)
         sock.send(p + nl)
     sock.close()
     return 0
@@ -169,16 +223,57 @@ def _emit_ssf(args, tags, kind, sock):
 
     tag_map = dict(t.split(":", 1) if ":" in t else (t, "")
                    for t in tags)
+    service = args.span_service or args.service
     rc = 0
     if args.command:
         span = Span(args.name or " ".join(args.command),
-                    service=args.service, indicator=args.indicator,
+                    service=service, indicator=args.indicator,
                     tags=tag_map)
+        if args.trace_id:
+            span.trace_id = args.trace_id
+        if args.parent_span_id:
+            span.parent_id = args.parent_span_id
         rc = subprocess.call(args.command)
-        span.error = rc != 0
+        span.error = args.error or rc != 0
         ssf_span = span.finish()
     else:
         ssf_span = ssf_pb2.SSFSpan()
+        # span descriptors apply to the carrier whether or not it has a
+        # trace identity (-error/-span_service/-name must never be
+        # silently dropped); -trace_id/-parent_span_id upgrade it to a
+        # real trace span
+        ssf_span.version = 0
+        ssf_span.service = service
+        ssf_span.name = args.name or "veneur-emit"
+        ssf_span.indicator = args.indicator
+        ssf_span.error = args.error
+        for k, v in tag_map.items():
+            ssf_span.tags[k] = v
+        if args.trace_id:
+            import random as _random
+            ssf_span.trace_id = args.trace_id
+            ssf_span.id = _random.getrandbits(63) or 1
+            ssf_span.parent_id = args.parent_span_id
+        now = time.time()
+        from veneur_tpu.config import parse_duration
+
+        def ts(flag, raw, default):
+            """Unix seconds, or a Go duration meaning 'that long ago'."""
+            if not raw:
+                return int(default * 1e9)
+            try:
+                return int(float(raw) * 1e9)
+            except ValueError:
+                pass
+            try:
+                return int((now - parse_duration(raw)) * 1e9)
+            except ValueError:
+                print(f"{flag} must be unix seconds or a Go duration "
+                      f"(got {raw!r})", file=sys.stderr)
+                raise SystemExit(2)
+        ssf_span.start_timestamp = ts("-span_starttime",
+                                      args.span_starttime, now)
+        ssf_span.end_timestamp = ts("-span_endtime", args.span_endtime, now)
         samples = []
         if args.count is not None:
             samples.append(ssf_samples.count(args.name, args.count, tag_map))
