@@ -114,3 +114,38 @@ def test_every_reference_key_is_a_config_field():
     missing = [k for k in REFERENCE_TOP_LEVEL_KEYS
                if k not in fields]
     assert not missing, missing
+
+
+REFERENCE_PROXY_KEYS = [
+    'consul_forward_grpc_service_name',
+    'consul_forward_service_name',
+    'consul_refresh_interval',
+    'consul_trace_service_name',
+    'debug',
+    'enable_profiling',
+    'forward_address',
+    'forward_timeout',
+    'grpc_address',
+    'grpc_forward_address',
+    'http_address',
+    'idle_connection_timeout',
+    'max_idle_conns',
+    'max_idle_conns_per_host',
+    'runtime_metrics_interval',
+    'sentry_dsn',
+    'ssf_destination_address',
+    'stats_address',
+    'trace_address',
+    'trace_api_address',
+    'tracing_client_capacity',
+    'tracing_client_flush_interval',
+    'tracing_client_metrics_interval',
+]
+
+
+def test_every_reference_proxy_key_is_a_field():
+    from veneur_tpu.config_proxy import ProxyConfig
+    fields = {f.name for f in dataclasses.fields(ProxyConfig)}
+    missing = [k for k in REFERENCE_PROXY_KEYS
+               if k not in fields]
+    assert not missing, missing

@@ -143,8 +143,9 @@ def main(argv=None):
     # a selected mode must carry its required field — the parser on the
     # receiving end rejects nameless events/checks, so emitting one
     # would silently drop
-    if args.mode == "event" and not args.event_title:
-        print("-mode event requires -e_title", file=sys.stderr)
+    if args.mode == "event" and not (args.event_title and args.event_text):
+        print("-mode event requires -e_title and -e_text (the receiving "
+              "parser rejects zero-length fields)", file=sys.stderr)
         return 2
     if args.mode == "sc" and not args.sc_name:
         print("-mode sc requires -sc_name", file=sys.stderr)
@@ -247,40 +248,51 @@ def _emit_ssf(args, tags, kind, sock):
         ssf_span.name = args.name or "veneur-emit"
         ssf_span.indicator = args.indicator
         ssf_span.error = args.error
+        ssf_span.parent_id = args.parent_span_id
         for k, v in tag_map.items():
             ssf_span.tags[k] = v
         if args.trace_id:
-            import random as _random
+            from veneur_tpu.trace.tracer import _new_id
             ssf_span.trace_id = args.trace_id
-            ssf_span.id = _random.getrandbits(63) or 1
-            ssf_span.parent_id = args.parent_span_id
+            ssf_span.id = _new_id()
         now = time.time()
         from veneur_tpu.config import parse_duration
+        import math
 
         def ts(flag, raw, default):
-            """Unix seconds, or a Go duration meaning 'that long ago'."""
+            """Unix seconds, or a Go duration meaning 'that long ago'.
+            Raises ValueError with a usage message (caught below — the
+            socket must be closed and rc returned, not SystemExit'd out
+            of a programmatic main() call)."""
             if not raw:
                 return int(default * 1e9)
             try:
-                return int(float(raw) * 1e9)
+                v = float(raw)
+                if math.isfinite(v):
+                    return int(v * 1e9)
             except ValueError:
                 pass
             try:
                 return int((now - parse_duration(raw)) * 1e9)
             except ValueError:
-                print(f"{flag} must be unix seconds or a Go duration "
-                      f"(got {raw!r})", file=sys.stderr)
-                raise SystemExit(2)
-        ssf_span.start_timestamp = ts("-span_starttime",
-                                      args.span_starttime, now)
-        ssf_span.end_timestamp = ts("-span_endtime", args.span_endtime, now)
+                raise ValueError(
+                    f"{flag} must be unix seconds or a Go duration "
+                    f"(got {raw!r})")
+        try:
+            ssf_span.start_timestamp = ts("-span_starttime",
+                                          args.span_starttime, now)
+            ssf_span.end_timestamp = ts("-span_endtime",
+                                        args.span_endtime, now)
+        except ValueError as e:
+            print(str(e), file=sys.stderr)
+            sock.close()
+            return 2
         samples = []
         if args.count is not None:
             samples.append(ssf_samples.count(args.name, args.count, tag_map))
         if args.gauge is not None:
             samples.append(ssf_samples.gauge(args.name, args.gauge, tag_map))
         if args.timing is not None:
-            from veneur_tpu.config import parse_duration
             samples.append(ssf_samples.timing(
                 args.name, parse_duration(args.timing), tag_map))
         if args.set_ is not None:
@@ -288,6 +300,9 @@ def _emit_ssf(args, tags, kind, sock):
         for s in samples:
             ssf_span.metrics.append(s)
 
+    if args.debug:
+        print(f"sending span {ssf_span!r}".replace("\n", " "),
+              file=sys.stderr)
     if kind in ("tcp", "unix"):
         f = sock.makefile("wb")
         write_ssf(f, ssf_span)

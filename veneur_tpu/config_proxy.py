@@ -38,6 +38,10 @@ class ProxyConfig:
     ssf_destination_address: str = ""
     trace_address: str = ""
     trace_api_address: str = ""
+    # Consul service name for trace-forwarding destinations
+    # (reference proxy.go:122 ConsulTraceService; parsed for config
+    # compatibility — span routing rides ssf_destination_address here)
+    consul_trace_service_name: str = ""
     unknown_keys: List[str] = dataclasses.field(default_factory=list)
 
 

@@ -92,6 +92,10 @@ def start_http_server(server, address) -> "http.server.ThreadingHTTPServer":
         def do_GET(self):
             if self.path == "/healthcheck":
                 self._reply(200, b"ok")
+            elif self.path == "/healthcheck/tracing":
+                # tracing is always on (reference http.go:44 keeps the
+                # endpoint for fleet compatibility)
+                self._reply(200, b"ok")
             elif self.path == "/version":
                 self._reply(200, VERSION.encode())
             elif self.path == "/builddate":
