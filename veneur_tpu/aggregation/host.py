@@ -48,6 +48,12 @@ class SlotMeta:
     # imported mixed histos have no local scalars, so only percentiles
     # flush). Cleared on the first directly-sampled value.
     imported_only: bool = False
+    # flusher.generate_intermetrics cache: (tags list, sink route,
+    # hostname) computed once per key per interval. The tags list is
+    # SHARED by every InterMetric of the key — sinks must derive
+    # (tags + [...]) rather than mutate, which they all do.
+    _emit_prep: Optional[tuple] = dataclasses.field(
+        default=None, repr=False, compare=False)
 
 
 class _KindTable:

@@ -143,8 +143,9 @@ def main(argv=None):
     # a selected mode must carry its required field — the parser on the
     # receiving end rejects nameless events/checks, so emitting one
     # would silently drop
-    if args.mode == "event" and not (args.event_title and args.event_text):
-        print("-mode event requires -e_title and -e_text (the receiving "
+    if ((args.mode == "event" or args.event_title or args.event_text)
+            and not (args.event_title and args.event_text)):
+        print("events require both -e_title and -e_text (the receiving "
               "parser rejects zero-length fields)", file=sys.stderr)
         return 2
     if args.mode == "sc" and not args.sc_name:
@@ -293,8 +294,14 @@ def _emit_ssf(args, tags, kind, sock):
         if args.gauge is not None:
             samples.append(ssf_samples.gauge(args.name, args.gauge, tag_map))
         if args.timing is not None:
-            samples.append(ssf_samples.timing(
-                args.name, parse_duration(args.timing), tag_map))
+            try:
+                secs = parse_duration(args.timing)
+            except ValueError:
+                print(f"-timing must be a Go duration (got "
+                      f"{args.timing!r})", file=sys.stderr)
+                sock.close()
+                return 2
+            samples.append(ssf_samples.timing(args.name, secs, tag_map))
         if args.set_ is not None:
             samples.append(ssf_samples.set_(args.name, args.set_, tag_map))
         for s in samples:

@@ -74,12 +74,19 @@ def generate_intermetrics(flush: Dict[str, np.ndarray], table: KeyTable,
     out: List[InterMetric] = []
     perc = list(percentiles)
 
+    # per-KEY invariants (tag list copy, sink routing, hostname) hoisted
+    # out of the per-metric emit: a 100k-name interval emits ~6 metrics
+    # per key and route_info scans were ~half of generation time
     def emit(meta, name, value, mtype, message=""):
+        prep = meta._emit_prep
+        if prep is None:
+            prep = meta._emit_prep = (list(meta.tags),
+                                      route_info(meta.tags),
+                                      meta.hostname or hostname)
         out.append(InterMetric(
             name=name, timestamp=timestamp, value=float(value),
-            tags=list(meta.tags), type=mtype, message=message,
-            hostname=meta.hostname or hostname,
-            sinks=route_info(meta.tags)))
+            tags=prep[0], type=mtype, message=message,
+            hostname=prep[2], sinks=prep[1]))
 
     # flush arrays are COMPACT: row i pairs with get_meta(kind)[i]
     # (aggregator.compute_flush gathers live rows on device)
