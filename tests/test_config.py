@@ -88,3 +88,13 @@ def test_deprecated_trace_lightstep_aliases_fill_canonical():
         "trace_lightstep_collector_host: deprecated\n"), env={})
     assert cfg.lightstep_access_token == "tok"
     assert cfg.lightstep_collector_host == "canonical"
+
+
+def test_digest_fidelity_knobs_reach_the_table_spec():
+    from veneur_tpu.config import Config
+    from veneur_tpu.server.server import spec_from_config
+
+    spec = spec_from_config(Config(tpu_digest_compression=200.0,
+                                   tpu_digest_cells_per_k=4))
+    assert spec.compression == 200.0 and spec.cells_per_k == 4
+    assert spec.centroids > spec_from_config(Config()).centroids

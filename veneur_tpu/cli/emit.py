@@ -187,7 +187,13 @@ def main(argv=None):
                 args.name, args.gauge, "g", tags=tags))
         if args.timing is not None:
             from veneur_tpu.config import parse_duration
-            ms = parse_duration(args.timing) * 1000.0
+            try:
+                ms = parse_duration(args.timing) * 1000.0
+            except ValueError:
+                print(f"-timing must be a Go duration (got "
+                      f"{args.timing!r})", file=sys.stderr)
+                sock.close()
+                return 2
             packets.append(build_metric_packet(
                 args.name, f"{ms:.3f}", "ms", args.sample_rate, tags))
         if args.set_ is not None:

@@ -231,6 +231,12 @@ class Config:
     tpu_n_shards: int = 0      # 0 = one shard per local device
     tpu_n_replicas: int = 1
     tpu_compact_every: int = 8
+    # t-digest fidelity: δ (the reference's samplers.go:502 compression,
+    # default 100 ≈ 157-centroid bound) and cells per k-unit (canonical
+    # cells ≈ δ/2·cells_per_k + 2, ops/tdigest.py centroid_capacity;
+    # higher = finer quantiles, more HBM per key)
+    tpu_digest_compression: float = 100.0
+    tpu_digest_cells_per_k: int = 2
 
     def parse_interval(self) -> float:
         return parse_duration(self.interval)

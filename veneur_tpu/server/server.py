@@ -115,7 +115,9 @@ def spec_from_config(cfg: Config) -> TableSpec:
         gauge_capacity=cfg.tpu_gauge_capacity,
         status_capacity=cfg.tpu_status_capacity,
         set_capacity=cfg.tpu_set_capacity,
-        histo_capacity=cfg.tpu_histo_capacity)
+        histo_capacity=cfg.tpu_histo_capacity,
+        compression=float(cfg.tpu_digest_compression),
+        cells_per_k=cfg.tpu_digest_cells_per_k)
 
 
 class Server:
