@@ -256,21 +256,26 @@ def unpack_batch(flat, sizes: tuple) -> Batch:
     return Batch(*out)
 
 
-@partial(jax.jit, static_argnames=("spec", "sizes"),
-         donate_argnames=("state",))
-def ingest_step_packed(state: DeviceState, flat, *, spec: TableSpec,
-                       sizes: tuple) -> DeviceState:
-    """Ingest one packed batch; when the control word is set, re-compress
-    the digest rows in the SAME program (lax.cond — only the taken branch
-    executes). Folding compaction in keeps the steady-state hot loop at
-    ONE resident executable, which matters twice: fewer dispatches is
-    plain good TPU practice, and the tunneled single-chip backend drops
-    to a slow per-dispatch mode once more than two distinct executables
-    are in flight (measured: 2s/dispatch for a separate compact program)."""
+def packed_step_core(state: DeviceState, flat, *, spec: TableSpec,
+                     sizes: tuple) -> DeviceState:
+    """The un-jitted production step: ingest one packed batch; when the
+    control word is set, re-compress the digest rows in the SAME program
+    (lax.cond — only the taken branch executes). Folding compaction in
+    keeps the steady-state hot loop at ONE resident executable, which
+    matters twice: fewer dispatches is plain good TPU practice, and the
+    tunneled single-chip backend drops to a slow per-dispatch mode once
+    more than two distinct executables are in flight (measured:
+    2s/dispatch for a separate compact program). Shared by
+    ingest_step_packed and the driver entry (__graft_entry__.entry)."""
     state = ingest_core(state, unpack_batch(flat[1:], sizes), spec=spec)
     return jax.lax.cond(flat[0] != 0,
                         lambda s: compact_core(s, spec=spec),
                         lambda s: s, state)
+
+
+ingest_step_packed = partial(
+    jax.jit, static_argnames=("spec", "sizes"),
+    donate_argnames=("state",))(packed_step_core)
 
 
 def _fold_core(state: DeviceState) -> DeviceState:
