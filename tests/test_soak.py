@@ -79,9 +79,9 @@ def test_flush_watchdog_aborts_on_wedged_flush_worker(tmp_path):
     wedged flush worker must abort the PROCESS (exit 3) rather than let
     the server silently stop reporting. Subprocess: tiny interval,
     watchdog budget, a PLUGIN whose flush blocks forever (sinks cannot
-    wedge the worker — per-sink flush threads are joined with a timeout,
-    the reference's 9s sink budget; plugins run inline post-flush and
-    are exactly what the watchdog protects against)."""
+    wedge the worker — per-sink flush threads are joined with a budget
+    of one flush interval, server._do_flush; plugins run inline
+    post-flush and are exactly what the watchdog protects against)."""
     script = tmp_path / "wedge.py"
     script.write_text(r"""
 import os, sys, threading, time
@@ -123,3 +123,54 @@ sys.exit(0)
     assert proc.returncode == 3, (proc.returncode, proc.stderr[-500:])
     assert "flush watchdog" in proc.stderr
     assert "WEDGE-REACHED" in proc.stdout
+
+
+def test_wedged_sink_does_not_block_shutdown(tmp_path):
+    """A sink that blows its per-flush join budget leaves a dangling
+    thread; it must be daemon so process exit is clean (rc 0), not a
+    hang or teardown abort."""
+    script = tmp_path / "slowsink.py"
+    script.write_text(r"""
+import sys, time
+sys.path.insert(0, %r)
+from veneur_tpu.config import Config
+from veneur_tpu.server.server import Server
+from veneur_tpu.sinks.base import MetricSink
+
+class SlowSink(MetricSink):
+    name = "slow"
+    def flush(self, metrics):
+        print("SINK-WEDGED", flush=True)
+        time.sleep(3600)
+
+srv = Server(Config(interval="1s", hostname="w",
+                    statsd_listen_addresses=[], percentiles=[0.5],
+                    aggregates=["count"],
+                    tpu_counter_capacity=256, tpu_gauge_capacity=64,
+                    tpu_status_capacity=16, tpu_set_capacity=16,
+                    tpu_histo_capacity=64),
+             metric_sinks=[SlowSink()])
+srv.start()
+import threading
+# wait until the wedge has provably been skipped twice: flushes keep
+# completing AND later intervals skip the wedged sink
+deadline = time.time() + 90
+while srv.sink_flushes_skipped < 2 and time.time() < deadline:
+    time.sleep(0.2)
+assert srv.sink_flushes_skipped >= 2, (
+    srv.sink_flushes_skipped, srv.flush_count)
+assert srv.flush_count >= 3, "flushes stalled behind the wedged sink"
+slow_threads = sum(1 for t in threading.enumerate()
+                   if getattr(t, "_target", None) is not None
+                   and "flush_sink" in getattr(t._target, "__name__", ""))
+assert slow_threads <= 1, f"{slow_threads} dangling sink threads"
+srv.shutdown()
+print("CLEAN-EXIT", flush=True)
+""" % (os.path.dirname(os.path.dirname(os.path.abspath(__file__))),))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    proc = subprocess.run([sys.executable, str(script)],
+                          capture_output=True, text=True, env=env,
+                          timeout=150)
+    assert proc.returncode == 0, (proc.returncode, proc.stderr[-500:])
+    assert "CLEAN-EXIT" in proc.stdout

@@ -226,6 +226,9 @@ class Server:
         self.last_flush = time.time()
         self.last_flush_done = time.time()
         self.flush_count = 0
+        # slow-sink containment (flush-worker thread only)
+        self._sink_threads: dict = {}
+        self.sink_flushes_skipped = 0
         self.parse_errors = 0
         self.import_errors = 0
         self._packets_received = 0
@@ -383,6 +386,7 @@ class Server:
             "import_errors": self.import_errors,
             "spans_received": self.span_pipeline.spans_received,
             "intervals_deferred": self.flush_intervals_deferred,
+            "sink_flushes_skipped": self.sink_flushes_skipped,
         }
         self._flush_jobs.put_nowait((state, table, stats, now, req))
 
@@ -929,16 +933,49 @@ class Server:
             is_local=self.cfg.is_local,
             timestamp=ts, hostname=self.hostname)
         if final:
-            # parallel sink flushes + barrier (flusher.go:105-115)
+            # parallel sink flushes + barrier with a per-interval join
+            # budget (flusher.go:105-115). Slow-sink containment:
+            # - a sink whose PREVIOUS flush is still running gets this
+            #   interval skipped (counted) instead of a second thread —
+            #   a wedged sink must not accrete a thread + metrics list
+            #   per interval
+            # - a thread that outlives the join budget is handed to the
+            #   aux set so shutdown still joins it (abandoning a thread
+            #   inside gRPC/JAX at teardown aborts the process); daemon
+            #   so a truly wedged one cannot block interpreter exit
             sinks_span = stage("sinks")
             sinks_span.set_tag("metrics", str(len(final)))
-            threads = [threading.Thread(target=self._flush_sink,
-                                        args=(s, final, sinks_span))
-                       for s in self.metric_sinks]
+            threads = []
+            for s in self.metric_sinks:
+                # keyed by instance, not .name — names are class-level
+                # constants and two same-named sinks must not share a
+                # containment slot (instances live as long as the server,
+                # so id() is stable)
+                prev = self._sink_threads.get(id(s))
+                if prev is not None and prev.is_alive():
+                    self.sink_flushes_skipped += 1
+                    log.warning("sink %s: previous flush still running; "
+                                "skipping this interval", s.name)
+                    continue
+                t = threading.Thread(target=self._flush_sink,
+                                     args=(s, final, sinks_span),
+                                     daemon=True)
+                self._sink_threads[id(s)] = t
+                threads.append(t)
             for t in threads:
                 t.start()
+            # ONE shared interval budget for the whole barrier (a
+            # per-thread timeout would give N slow sinks N intervals and
+            # stale the watchdog's last_flush_done for merely-slow sinks)
+            barrier_deadline = time.monotonic() + self.interval
             for t in threads:
-                t.join(timeout=self.interval)
+                t.join(timeout=max(0.0,
+                                   barrier_deadline - time.monotonic()))
+                if t.is_alive():
+                    with self._aux_lock:
+                        self._aux_threads = [
+                            x for x in self._aux_threads if x.is_alive()]
+                        self._aux_threads.append(t)
             sinks_span.client_finish(self.trace_client)
             # plugins run post-flush (flusher.go:117-131)
             psp = stage("plugins") if self.plugins else None
@@ -982,6 +1019,8 @@ class Server:
                "veneur.import.errors_total": stats["import_errors"],
                "veneur.flush.intervals_deferred_total":
                    stats["intervals_deferred"],
+               "veneur.flush.sink_flushes_skipped_total":
+                   stats.get("sink_flushes_skipped", 0),
                "veneur.spans_received_total": stats["spans_received"]}
         samples = [ssf_samples.timing("veneur.flush.total_duration_ns",
                                       flush_seconds),
