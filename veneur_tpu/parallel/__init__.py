@@ -4,7 +4,7 @@ from veneur_tpu.parallel.sharded import (  # noqa: F401
     make_mesh,
     sharded_empty_state,
     make_sharded_ingest,
-    make_sharded_compact,
+    make_sharded_ingest_packed,
     make_merged_flush,
     stack_batches,
 )

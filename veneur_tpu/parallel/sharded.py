@@ -115,6 +115,39 @@ def make_sharded_ingest(mesh: Mesh, spec: TableSpec):
     return jax.jit(fn, donate_argnums=(0,))
 
 
+def make_sharded_ingest_packed(mesh: Mesh, spec: TableSpec, sizes: tuple):
+    """Packed-transfer variant of make_sharded_ingest: (state, flat) ->
+    state where flat is i32[R, S, W] — each tile's batch as ONE bit-packed
+    buffer (aggregation/step.py pack_batch), with the compact control word
+    in-band. Same single-executable / single-transfer rationale as the
+    single-device ingest_step_packed, applied per mesh tile.
+
+    The compact cond sits ABOVE the tile vmaps with a scalar predicate
+    (every tile of a dispatch carries the same word): a vmapped cond
+    would lower to a select that computes BOTH branches, running the
+    sort-based recompression every step instead of every
+    compact_every-th."""
+    from veneur_tpu.aggregation.step import (
+        compact_core, ingest_core, unpack_batch)
+
+    def tile_ingest(state, flat):
+        return ingest_core(state, unpack_batch(flat[1:], sizes), spec=spec)
+
+    vv_ingest = jax.vmap(jax.vmap(tile_ingest))
+    vv_compact = jax.vmap(jax.vmap(partial(compact_core, spec=spec)))
+
+    def block(state, flat):
+        st = vv_ingest(state, flat)
+        do_compact = flat[0, 0, 0] != 0   # scalar: cond stays a branch
+        return jax.lax.cond(do_compact, vv_compact, lambda s: s, st)
+
+    fn = jax.shard_map(
+        block, mesh=mesh,
+        in_specs=(P(REPLICA_AXIS, SHARD_AXIS), P(REPLICA_AXIS, SHARD_AXIS)),
+        out_specs=P(REPLICA_AXIS, SHARD_AXIS))
+    return jax.jit(fn, donate_argnums=(0,))
+
+
 def _merge_replica_block(state: DeviceState, spec: TableSpec):
     """Inside shard_map: merge a [r_local, s_local, ...] block over the full
     replica axis (local reduce + named-axis collective). Returns arrays with
@@ -211,17 +244,6 @@ def _merge_replica_block(state: DeviceState, spec: TableSpec):
         h_recip_lo=h_recip[1],
     )
     return merged
-
-
-def make_sharded_compact(mesh: Mesh, spec: TableSpec):
-    """Per-tile digest re-compression over the mesh."""
-    from veneur_tpu.aggregation.step import compact_core
-    core = partial(compact_core, spec=spec)
-    vv = jax.vmap(jax.vmap(core))
-    fn = jax.shard_map(vv, mesh=mesh,
-                       in_specs=P(REPLICA_AXIS, SHARD_AXIS),
-                       out_specs=P(REPLICA_AXIS, SHARD_AXIS))
-    return jax.jit(fn, donate_argnums=(0,))
 
 
 def make_merged_flush(mesh: Mesh, spec: TableSpec):

@@ -113,7 +113,7 @@ class ShardedAggregator(Aggregator):
                  n_shards: int = 2, compact_every: int = 8):
         import jax
         from veneur_tpu.parallel import (
-            make_mesh, make_merged_flush, make_sharded_ingest,
+            make_mesh, make_merged_flush, make_sharded_ingest_packed,
             sharded_empty_state)
 
         self.spec = spec            # total capacities (KeyTable slot space)
@@ -123,10 +123,14 @@ class ShardedAggregator(Aggregator):
         self.compact_every = compact_every
 
         self.mesh = make_mesh(1, n_shards)
-        self._ingest = make_sharded_ingest(self.mesh, self.pspec)
+        # packed ingest: each tile's batch ships as one i32 buffer with
+        # the compact word in-band — mirrors the single-device backend
+        # (one executable, one transfer per step per tile)
+        from veneur_tpu.aggregation.step import batch_sizes
+        self._sizes = batch_sizes(Batcher(self.pspec, bspec).force_emit())
+        self._ingest = make_sharded_ingest_packed(self.mesh, self.pspec,
+                                                  self._sizes)
         self._flush = make_merged_flush(self.mesh, self.pspec)
-        from veneur_tpu.parallel import make_sharded_compact
-        self._compact = make_sharded_compact(self.mesh, self.pspec)
         self._empty = partial(sharded_empty_state, self.pspec, 1, n_shards,
                               self.mesh)
         self.state = self._empty()
@@ -218,26 +222,24 @@ class ShardedAggregator(Aggregator):
                         on_batch=partial(self._on_shard_batch, i))
                 for i in range(self.n_shards)]
 
-    def _on_shard_batch(self, shard: int, batch):
-        from veneur_tpu.parallel import stack_batches
-        row = [batch if i == shard else b.force_emit()
-               for i, b in enumerate(self.batchers)]
-        self.state = self._ingest(self.state,
-                                  stack_batches([row], 1, self.n_shards))
+    def _dispatch_row(self, row):
+        """Pack each shard's batch into its flat buffer and run the fused
+        mesh step; compaction rides the in-band control word at the same
+        cadence as the single-device backend (Aggregator._on_batch)."""
+        from veneur_tpu.aggregation.step import pack_batch
         self._steps += 1
-        # same accumulator-precision cadence as the single-device backend
-        # (Aggregator._on_batch): compact digests / fold f32 accumulators
-        if self._steps % self.compact_every == 0:
-            self.state = self._compact(self.state)
+        dc = self._steps % self.compact_every == 0
+        flat = np.stack([[pack_batch(b, dc) for b in row]])  # [1, S, W]
+        self.state = self._ingest(self.state, flat)
+
+    def _on_shard_batch(self, shard: int, batch):
+        self._dispatch_row([batch if i == shard else b.force_emit()
+                            for i, b in enumerate(self.batchers)])
 
     def _emit_all(self):
-        from veneur_tpu.parallel import stack_batches
         if not any(b.pending() for b in self.batchers):
             return
-        row = [b.force_emit() for b in self.batchers]
-        self.state = self._ingest(self.state,
-                                  stack_batches([row], 1, self.n_shards))
-        self._steps += 1
+        self._dispatch_row([b.force_emit() for b in self.batchers])
 
     def _apply_hll_imports(self):
         """Imported HLL rows merge host-side then re-place sharded (rare
