@@ -83,11 +83,13 @@ def main():
     budget = float(os.environ.get("BENCH_KERNEL_TIMEOUT", "1500"))
     out = {"metric": "aggregation_samples_per_sec_per_chip_1M_keys",
            "value": 0, "unit": "samples/sec", "vs_baseline": 0}
-    from benchmarks.e2e import parse_last_json_line
+    from benchmarks.e2e import cache_env, parse_last_json_line
+    env = cache_env()   # one persistent XLA cache across every stage
     try:
         proc = subprocess.run(
             [sys.executable, os.path.join(here, "bench.py"), "--kernel"],
-            capture_output=True, text=True, cwd=here, timeout=budget)
+            capture_output=True, text=True, cwd=here, timeout=budget,
+            env=env)
         parsed = parse_last_json_line(proc.stdout)
         if parsed:
             out.update(parsed)

@@ -572,6 +572,20 @@ def _arm_init_watchdog(diag: dict):
     return t
 
 
+def cache_env() -> dict:
+    """Child-process env with ONE persistent XLA compilation cache shared
+    by every benchmark stage (kernel + the five config children): each
+    child otherwise pays every compile cold — measured 2x total wall on
+    repeat runs, and warmer timed regions. setdefault so an operator's
+    JAX_COMPILATION_CACHE_DIR wins."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                   os.path.join(repo, ".xla_cache"))
+    env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+    return env
+
+
 def _run_config_subprocess(n, scale):
     """One config per subprocess. Two reasons: (a) the reference's own
     perf story is per-benchmark processes (`go test -bench` spawns a
@@ -589,9 +603,10 @@ def _run_config_subprocess(n, scale):
     # scale=None is resolved by the CHILD (where jax.devices() is safe);
     # resolving it here would initialize the backend in the parent and
     # block every child from acquiring the single tunneled chip
+    env = cache_env()
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True,
-                              cwd=repo, timeout=SUBPROC_TIMEOUT)
+                              cwd=repo, timeout=SUBPROC_TIMEOUT, env=env)
     except subprocess.TimeoutExpired:
         return {"config": n, "error": f"timeout after {SUBPROC_TIMEOUT:.0f}s"}
     parsed = parse_last_json_line(proc.stdout)
