@@ -234,3 +234,94 @@ def test_native_udp_reader_group_lossless_and_counted():
         srv.shutdown()
     # reader group freed; counters must be safely zero afterwards
     assert not srv._native_readers_active
+
+
+def test_fuzz_differential_parse_parity():
+    """Randomized differential fuzz: structured mutations of valid lines
+    plus raw random bytes must be ACCEPTED/REJECTED identically by the
+    C++ engine and the Python parser, and accepted lines must stage the
+    same (kind, slot, value). The fixed parity lists above pin known
+    shapes; this hunts the unknown ones."""
+    rng = np.random.default_rng(0x5EED)
+
+    names = [b"a", b"metric.name", b"x" * 64, b"dot.", b".lead",
+             b"uni\xc3\xa9", b"sp ace", b"tab\t"]
+    values = [b"1", b"-3.5", b"1e3", b"0", b"nan", b"inf", b"-inf",
+              b"0x1p3", b"1.", b".5", b"", b"abc", b"1_000", b" 1", b"1 "]
+    types = [b"c", b"g", b"ms", b"h", b"d", b"s", b"cc", b"", b"m"]
+    rates = [b"", b"|@0.5", b"|@1", b"|@0", b"|@-1", b"|@2", b"|@abc",
+             b"|@0.001"]
+    tagss = [b"", b"|#", b"|#a:b", b"|#b:2,a:1", b"|#veneurlocalonly",
+             b"|#veneurglobalonly,x:y", b"|#dup:1,dup:2", b"|#:v", b"|#k:",
+             b"|#comma\\,esc"]
+    extras = [b"", b"|", b"|x:y", b"||", b"|c"]
+
+    lines = []
+    for _ in range(1500):
+        ln = (names[rng.integers(len(names))] + b":"
+              + values[rng.integers(len(values))] + b"|"
+              + types[rng.integers(len(types))]
+              + rates[rng.integers(len(rates))]
+              + tagss[rng.integers(len(tagss))]
+              + extras[rng.integers(len(extras))])
+        lines.append(ln)
+    for _ in range(500):   # raw noise (printable-heavy so memchr paths vary)
+        n = int(rng.integers(1, 60))
+        lines.append(bytes(rng.integers(32, 127, n).astype(np.uint8)))
+
+    eng = mk()
+    table = KeyTable(SPEC)
+    batcher = Batcher(SPEC, BSPEC)
+    py_accept = 0
+    for ln in lines:
+        st0 = eng.stats()
+        eng.feed(ln)
+        st1 = eng.stats()
+        # processed advances on accept; dropped advances when the parse
+        # succeeded but the key table was full — both count as "parsed"
+        native_parsed = (st1["processed"] + st1["dropped"]
+                         == st0["processed"] + st0["dropped"] + 1)
+        try:
+            m = parser.parse_metric(ln)
+        except parser.ParseError:
+            assert not native_parsed, ln
+            continue
+        assert native_parsed, ln
+        py_accept += 1
+        slot = table.slot_for(m.type, m.name, m.tags, m.scope, m.digest)
+        if slot is None:
+            continue
+        if m.type == "counter":
+            batcher.add_counter(slot, m.value, m.sample_rate)
+        elif m.type == "gauge":
+            batcher.add_gauge(slot, m.value)
+        elif m.type == "set":
+            v = m.value if isinstance(m.value, bytes) else str(
+                m.value).encode()
+            batcher.add_set(slot, v)
+        elif m.type == "status":
+            batcher.add_status(slot, m.value)
+        else:
+            batcher.add_histo(slot, m.value, m.sample_rate)
+    # aggregate accept/reject parity
+    st = eng.stats()
+    assert st["processed"] + st["dropped"] == py_accept, (
+        st, py_accept)
+
+    # staged-sample parity on everything accepted
+    arrays = emit_arrays()
+    nc, ng, ns, nh = eng.emit_into(arrays)
+    (c_slot, c_inc, g_slot, g_val, s_slot, s_reg, s_rho,
+     h_slot, h_val, h_wt) = arrays
+    assert (nc, ng, ns, nh) == (batcher.nc, batcher.ng, batcher.ns,
+                                batcher.nh)
+    np.testing.assert_array_equal(c_slot[:nc], batcher.c_slot[:nc])
+    np.testing.assert_allclose(c_inc[:nc], batcher.c_inc[:nc], rtol=1e-6)
+    np.testing.assert_array_equal(g_slot[:ng], batcher.g_slot[:ng])
+    np.testing.assert_allclose(g_val[:ng], batcher.g_val[:ng], rtol=1e-6)
+    np.testing.assert_array_equal(s_slot[:ns], batcher.s_slot[:ns])
+    np.testing.assert_array_equal(s_reg[:ns], batcher.s_reg[:ns])
+    np.testing.assert_array_equal(s_rho[:ns], batcher.s_rho[:ns])
+    np.testing.assert_array_equal(h_slot[:nh], batcher.h_slot[:nh])
+    np.testing.assert_allclose(h_val[:nh], batcher.h_val[:nh], rtol=1e-6)
+    np.testing.assert_allclose(h_wt[:nh], batcher.h_wt[:nh], rtol=1e-6)

@@ -120,9 +120,18 @@ class KeyTable:
 
     def slot_for(self, kind: str, name: str, tags: tuple, scope: int,
                  digest: int, hostname: str = "",
-                 imported: bool = False) -> Optional[int]:
+                 imported: bool = False,
+                 joined_tags: Optional[str] = None) -> Optional[int]:
         t = self.tables[self._table_name(kind)]
-        key = (kind, name, tags)
+        # key identity is the JOINED tag string, exactly the reference's
+        # MetricKey.JoinedTags (samplers/parser.go:76,412): an empty tag
+        # section (`|#` -> [""]) joins to "" and shares the no-tags key,
+        # and the C++ engine keys the same way (dogstatsd.cpp keybuf).
+        # Callers on the hot path pass the parser's precomputed
+        # UDPMetric.joined_tags to skip the per-sample join.
+        if joined_tags is None:
+            joined_tags = ",".join(tags)
+        key = (kind, name, joined_tags)
         return t.slot_for(
             key, digest,
             lambda: SlotMeta(name=name, tags=tags, scope=scope, kind=kind,

@@ -63,6 +63,14 @@ class NativeKeyTable:
             if slot in self.by_slot[tname]:
                 # registered python-side with the exact tag tuple already
                 continue
+            # flush labels use the FIRST arrival's tags, matching the
+            # reference's one-sampler-per-MetricKey semantics. Deliberate
+            # deviation: an empty tag SECTION (`|#`) and no section both
+            # serialize to joined == "" in the C++ key record, so the
+            # label here is () where the reference would keep [""] when
+            # the empty section arrived first — a cosmetic empty tag on
+            # a pathological packet shape; the key identity (and the
+            # digest) agree with the reference either way.
             m = SlotMeta(name=name,
                          tags=tuple(joined.split(",")) if joined else (),
                          scope=scope, kind=kind)
@@ -70,14 +78,18 @@ class NativeKeyTable:
             self.by_slot[tname][slot] = m
 
     def slot_for(self, kind: str, name: str, tags: tuple, scope: int,
-                 digest: int, hostname: str = "", imported: bool = False):
+                 digest: int, hostname: str = "", imported: bool = False,
+                 joined_tags=None):
         if kind == "status":
-            key = (kind, name, tags)
+            # joined-string identity, same as host.py KeyTable and the
+            # C++ engine's keybuf (reference MetricKey.JoinedTags)
+            key = (kind, name, joined_tags if joined_tags is not None
+                   else ",".join(tags))
             return self.status.slot_for(
                 key, digest,
                 lambda: SlotMeta(name=name, tags=tags, scope=scope,
                                  kind=kind, hostname=hostname))
-        joined = ",".join(tags)
+        joined = joined_tags if joined_tags is not None else ",".join(tags)
         slot, was_new = self.eng.slot_for(kind, name, joined, scope, digest)
         if slot is not None and was_new:
             # register the exact tuple now — tags from SSF maps may contain

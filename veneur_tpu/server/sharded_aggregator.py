@@ -151,7 +151,8 @@ class ShardedAggregator(Aggregator):
     def process_metric(self, m) -> None:
         kind = m.type
         slot = self.table.slot_for(kind, m.name, m.tags, m.scope, m.digest,
-                                   hostname=m.hostname)
+                                   hostname=m.hostname,
+                                   joined_tags=m.joined_tags)
         if slot is None:
             self.dropped_capacity += 1
             return
