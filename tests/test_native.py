@@ -325,3 +325,30 @@ def test_fuzz_differential_parse_parity():
     np.testing.assert_array_equal(h_slot[:nh], batcher.h_slot[:nh])
     np.testing.assert_allclose(h_val[:nh], batcher.h_val[:nh], rtol=1e-6)
     np.testing.assert_allclose(h_wt[:nh], batcher.h_wt[:nh], rtol=1e-6)
+
+
+def test_fuzz_multiline_packet_splitting_parity():
+    """Datagram splitting parity: feeding N lines as one newline-joined
+    packet must parse exactly like feeding them line by line (counts and
+    staged samples), including lines that are rejects, specials, and
+    empty strings."""
+    lines = (GOOD_PACKETS + BAD_PACKETS
+             + [b"", b"_sc|db.up|1", b"_e{5,2}:hello|hi"]) * 3
+
+    one = mk()
+    for ln in lines:
+        one.feed(ln)
+    spl_one = one.drain_specials()
+
+    packed = mk()
+    packed.feed(b"\n".join(lines))
+    spl_packed = packed.drain_specials()
+
+    assert one.stats() == packed.stats()
+    assert spl_one == spl_packed
+    a1, a2 = emit_arrays(), emit_arrays()
+    n1 = one.emit_into(a1)
+    n2 = packed.emit_into(a2)
+    assert n1 == n2
+    for x, y in zip(a1, a2):
+        np.testing.assert_array_equal(x, y)
