@@ -1,0 +1,320 @@
+"""Microbenchmarks mirroring the reference's `go test -bench` table
+(BASELINE.md §Microbenchmarks; reference files cited per entry).
+
+Each micro times its hot path standalone and prints one JSON line
+`{"bench": name, "iters": N, "ns_per_op": x, "ops_per_sec": y}` — the
+shape of `go test -bench` output, so the two tables compare directly.
+CPU-runnable; device micros (ingest/flush) use whatever backend the
+session provides.
+
+Run:  python -m benchmarks.micro [--only NAME ...] [--seconds S]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def _timeit(fn, seconds: float, batch: int = 1):
+    """Run fn repeatedly for ~seconds (after one warmup call); returns
+    (iters, ns/op) where an op is one item of the batch fn processes."""
+    fn()
+    n = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < seconds:
+        fn()
+        n += 1
+    dt = time.perf_counter() - t0
+    ops = n * batch
+    return ops, dt / ops * 1e9
+
+
+# -- parse (parser_test.go:818 BenchmarkParseMetric / :805 ParseSSF) ---------
+
+def bench_parse_metric(seconds):
+    from veneur_tpu.samplers import parser
+    pkt = b"a.b.c:1|c|#a:b,c:d"
+    return _timeit(lambda: parser.parse_metric(pkt), seconds)
+
+
+def bench_parse_metric_native(seconds):
+    from veneur_tpu import native
+    if not native.available():
+        return None
+    from veneur_tpu.aggregation.host import BatchSpec
+    from veneur_tpu.aggregation.state import TableSpec
+    eng = native.NativeIngest(
+        TableSpec(counter_capacity=1 << 10, gauge_capacity=64,
+                  status_capacity=16, set_capacity=64,
+                  histo_capacity=1 << 8),
+        BatchSpec(counter=1 << 15, gauge=256, status=64, set=1 << 10,
+                  histo=1 << 12))
+    # one packet buffer of 100 lines per feed call; emit arrays hoisted
+    # out of the timed region (emit drains staging, the arrays are
+    # overwritten each call)
+    buf = b"\n".join(b"a.b.c.%d:1|c|#a:b,c:d" % (i % 200)
+                     for i in range(100))
+    arrays = _native_arrays(eng)
+
+    def run():
+        eng.feed(buf)
+        if eng.pending() > (1 << 14):
+            eng.emit_into(arrays)
+
+    return _timeit(run, seconds, batch=100)
+
+
+def _native_arrays(eng):
+    b = eng.bspec
+    return (np.empty(b.counter, np.int32), np.empty(b.counter, np.float32),
+            np.empty(b.gauge, np.int32), np.empty(b.gauge, np.float32),
+            np.empty(b.set, np.int32), np.empty(b.set, np.int32),
+            np.empty(b.set, np.uint8), np.empty(b.histo, np.int32),
+            np.empty(b.histo, np.float32), np.empty(b.histo, np.float32))
+
+
+def bench_parse_ssf(seconds):
+    from veneur_tpu.proto import ssf_pb2
+    from veneur_tpu.protocol.wire import parse_ssf
+    span = ssf_pb2.SSFSpan(version=0, trace_id=1, id=2, service="svc",
+                           name="op", start_timestamp=1, end_timestamp=2)
+    span.tags["foo"] = "bar"
+    data = span.SerializeToString()
+    return _timeit(lambda: parse_ssf(data), seconds)
+
+
+# -- worker aggregation (worker_test.go:506 BenchmarkWork) -------------------
+
+def bench_worker_ingest(seconds):
+    from veneur_tpu.aggregation.host import BatchSpec
+    from veneur_tpu.aggregation.state import TableSpec
+    from veneur_tpu.samplers import parser
+    from veneur_tpu.server.aggregator import Aggregator
+    agg = Aggregator(TableSpec(counter_capacity=1 << 12, gauge_capacity=256,
+                               status_capacity=16, set_capacity=256,
+                               histo_capacity=1 << 10),
+                     BatchSpec(counter=1 << 14, histo=1 << 14))
+    metrics = [parser.parse_metric(b"w.%d:%d|c" % (i % 1000, i))
+               for i in range(1000)]
+
+    def run():
+        for m in metrics:
+            agg.process_metric(m)
+
+    return _timeit(run, seconds, batch=len(metrics))
+
+
+# -- full flush (server_test.go:1139 BenchmarkServerFlush) -------------------
+
+def bench_server_flush(seconds):
+    from veneur_tpu.aggregation.host import BatchSpec
+    from veneur_tpu.aggregation.state import TableSpec
+    from veneur_tpu.samplers import parser
+    from veneur_tpu.server.aggregator import Aggregator
+    from veneur_tpu.server.flusher import generate_intermetrics
+    spec = TableSpec(counter_capacity=1 << 12, gauge_capacity=256,
+                     status_capacity=16, set_capacity=256,
+                     histo_capacity=1 << 10)
+    bspec = BatchSpec(counter=1 << 14, histo=1 << 14)
+    metrics = [parser.parse_metric(b"f.%d:%d|c" % (i % 2000, i))
+               for i in range(2000)]
+    metrics += [parser.parse_metric(b"t.%d:%d|ms" % (i % 500, i))
+                for i in range(500)]
+    agg = Aggregator(spec, bspec)
+
+    def run():
+        for m in metrics:
+            agg.process_metric(m)
+        state, table = agg.swap()
+        out, table = agg.compute_flush(state, table, [0.5, 0.99])
+        generate_intermetrics(out, table, percentiles=[0.5, 0.99],
+                              aggregates=["min", "max", "count"],
+                              is_local=False, timestamp=1)
+
+    return _timeit(run, seconds)
+
+
+# -- SSF ingest (server_test.go:1547 BenchmarkHandleSSF) ---------------------
+
+def bench_handle_ssf(seconds):
+    from veneur_tpu.proto import ssf_pb2
+    from veneur_tpu.protocol.wire import parse_ssf
+    from veneur_tpu.server.spans import SpanPipeline
+
+    class Null:
+        name = "null"
+
+        def ingest_many(self, spans):
+            pass
+
+    pipe = SpanPipeline([Null()], capacity=1 << 14, num_workers=1)
+    pipe.start()
+    span = ssf_pb2.SSFSpan(version=0, trace_id=1, id=2, service="svc",
+                           name="op", start_timestamp=1, end_timestamp=2)
+    data = span.SerializeToString()
+
+    def run():
+        for _ in range(100):
+            while not pipe.handle_span(parse_ssf(data)):
+                time.sleep(0.0005)
+
+    try:
+        return _timeit(run, seconds, batch=100)
+    finally:
+        pipe.stop()
+
+
+# -- import (importsrv/server_test.go:115) -----------------------------------
+
+def bench_import_metrics(seconds):
+    from veneur_tpu.aggregation.host import BatchSpec
+    from veneur_tpu.aggregation.state import TableSpec
+    from veneur_tpu.forward.convert import export_metrics, import_into
+    from veneur_tpu.samplers import parser
+    from veneur_tpu.server.aggregator import Aggregator
+    spec = TableSpec(counter_capacity=1 << 10, gauge_capacity=64,
+                     status_capacity=16, set_capacity=16,
+                     histo_capacity=1 << 8)
+    bspec = BatchSpec(counter=1 << 13, histo=1 << 13)
+    src = Aggregator(spec, bspec)
+    rng = np.random.default_rng(0)
+    for c in range(200):
+        src.process_metric(parser.parse_metric(
+            b"i.c.%d:%d|c|#veneurglobalonly" % (c, c)))
+    for h in range(50):
+        for v in rng.lognormal(2, 0.8, 20):
+            src.process_metric(parser.parse_metric(
+                b"i.t.%d:%.3f|ms" % (h, v)))
+    _, table, raw = src.flush([0.5], want_raw=True)
+    exported = export_metrics(raw, table, compression=spec.compression,
+                              hll_precision=spec.hll_precision)
+    dst = Aggregator(TableSpec(counter_capacity=1 << 11, gauge_capacity=64,
+                               status_capacity=16, set_capacity=16,
+                               histo_capacity=1 << 9), bspec)
+
+    def run():
+        for m in exported:
+            import_into(dst, m)
+
+    return _timeit(run, seconds, batch=len(exported))
+
+
+# -- proxy routing (proxysrv/server_test.go:225) -----------------------------
+
+def bench_proxy_route(seconds):
+    from veneur_tpu.forward.proxysrv import HashRing
+    ring = HashRing([f"host{i}:8128" for i in range(16)])
+    keys = [b"metric.%dcountera:b,c:d" % i for i in range(1000)]
+
+    def run():
+        for k in keys:
+            ring.get(k)
+
+    return _timeit(run, seconds, batch=len(keys))
+
+
+# -- t-digest (tdigest/histo_test.go:181 Add / :191 Quantile) ----------------
+
+def bench_tdigest_add(seconds):
+    import jax
+    import jax.numpy as jnp
+    from veneur_tpu.ops import tdigest as td
+    rng = np.random.default_rng(1)
+    tbl = td.empty_table((), compression=100.0)
+    vals = jnp.asarray(rng.lognormal(2, 1, 1024).astype(np.float32))
+    ones = jnp.ones(1024, jnp.float32)
+
+    def run():
+        jax.block_until_ready(td.add_batch_single(tbl, vals, ones))
+
+    return _timeit(run, seconds, batch=1024)
+
+
+def bench_tdigest_quantile(seconds):
+    import jax
+    import jax.numpy as jnp
+    from veneur_tpu.ops import tdigest as td
+    rng = np.random.default_rng(1)
+    tbl = td.empty_table((), compression=100.0)
+    vals = jnp.asarray(rng.lognormal(2, 1, 4096).astype(np.float32))
+    tbl = td.add_batch_single(tbl, vals, jnp.ones(4096, jnp.float32))
+    qs = jnp.asarray([0.5, 0.9, 0.99], jnp.float32)
+
+    def run():
+        jax.block_until_ready(td.quantiles(tbl, qs))
+
+    return _timeit(run, seconds)
+
+
+# -- metric extraction (sinks/ssfmetrics/metrics_test.go:92) -----------------
+
+def bench_metric_extraction(seconds):
+    from veneur_tpu.proto import ssf_pb2
+    from veneur_tpu.protocol.wire import parse_ssf
+    from veneur_tpu.sinks.ssfmetrics import MetricExtractionSink
+    span = ssf_pb2.SSFSpan(version=0, trace_id=1, id=1, service="svc",
+                           name="op", indicator=True,
+                           start_timestamp=int(1e9),
+                           end_timestamp=int(1.25e9))
+    m = span.metrics.add()
+    m.metric = ssf_pb2.SSFSample.COUNTER
+    m.name = "emb"
+    m.value = 2.0
+    m.sample_rate = 1.0
+    spans = [parse_ssf(span.SerializeToString()) for _ in range(100)]
+    sink = MetricExtractionSink(lambda ms: None,
+                                indicator_timer_name="sli")
+
+    def run():
+        sink.ingest_many(spans)
+
+    return _timeit(run, seconds, batch=len(spans))
+
+
+MICROS = {
+    "parse_metric": bench_parse_metric,
+    "parse_metric_native": bench_parse_metric_native,
+    "parse_ssf": bench_parse_ssf,
+    "worker_ingest": bench_worker_ingest,
+    "server_flush": bench_server_flush,
+    "handle_ssf": bench_handle_ssf,
+    "import_metrics": bench_import_metrics,
+    "proxy_route": bench_proxy_route,
+    "tdigest_add": bench_tdigest_add,
+    "tdigest_quantile": bench_tdigest_quantile,
+    "metric_extraction": bench_metric_extraction,
+}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--only", action="append", choices=sorted(MICROS),
+                    help="run a subset (repeatable; default all)")
+    ap.add_argument("--seconds", type=float, default=1.0,
+                    help="time budget per micro")
+    args = ap.parse_args(argv)
+    results = []
+    for name in (args.only or sorted(MICROS)):
+        out = MICROS[name](args.seconds)
+        if out is None:
+            line = {"bench": name, "skipped": "native engine unavailable"}
+        else:
+            iters, ns = out
+            line = {"bench": name, "iters": iters,
+                    "ns_per_op": round(ns, 1),
+                    "ops_per_sec": round(1e9 / ns, 1)}
+        results.append(line)
+        print(json.dumps(line), flush=True)
+    return results
+
+
+if __name__ == "__main__":
+    import os
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    main()

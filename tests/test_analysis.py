@@ -41,3 +41,17 @@ def test_sequential_baseline_small_sample_regime():
     b = small_sample_baseline(seed=7, trials=40)
     assert 0.005 < b["err_mean"] < 0.05, b
     assert b["err_max"] > 0.03, b
+
+
+def test_microbenchmarks_all_run():
+    """Every micro in benchmarks/micro.py runs and reports sane numbers
+    at a tiny time budget (the perf table's plumbing must not rot)."""
+    from benchmarks.micro import MICROS, main
+
+    results = main(["--seconds", "0.05"])
+    names = {r["bench"] for r in results}
+    assert len(results) == len(MICROS) and names == set(MICROS)
+    for r in results:
+        if "skipped" in r:
+            continue
+        assert r["iters"] >= 1 and r["ns_per_op"] > 0, r
