@@ -81,3 +81,33 @@ def test_quantiles_parity_through_table():
     want = _xla_rows(mean.reshape(-1, spec_c), weight.reshape(-1, spec_c),
                      mn.reshape(-1), mx.reshape(-1), qs).reshape(3, 5, 2)
     np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_quantiles_under_jit_vmap():
+    """The sharded merged flush calls quantiles inside shard_map+vmap;
+    the kernel must produce identical results under jit(vmap(...)) —
+    the calling context the production probe also exercises."""
+    rng = np.random.default_rng(5)
+    b, r, c = 3, 8, 64
+    mean = rng.lognormal(1.0, 0.8, (b, r, c)).astype(np.float32)
+    weight = rng.uniform(0, 2, (b, r, c)).astype(np.float32)
+    weight[rng.uniform(size=(b, r, c)) < 0.4] = 0.0
+    mn = np.where(weight.sum(-1) > 0,
+                  np.where(weight > 0, mean, np.inf).min(-1),
+                  np.inf).astype(np.float32)
+    mx = np.where(weight.sum(-1) > 0,
+                  np.where(weight > 0, mean, -np.inf).max(-1),
+                  -np.inf).astype(np.float32)
+    qs = np.asarray([0.1, 0.5, 0.9], np.float32)
+
+    fn = jax.jit(jax.vmap(
+        lambda m, w, lo, hi: quantiles_rows(m, w, lo, hi,
+                                            jnp.asarray(qs),
+                                            interpret=True)))
+    got = np.asarray(fn(jnp.asarray(mean), jnp.asarray(weight),
+                        jnp.asarray(mn), jnp.asarray(mx)))
+    for i in range(b):
+        want = _xla_rows(mean[i], weight[i], mn[i], mx[i], qs)
+        live = ~np.isnan(want)
+        np.testing.assert_allclose(got[i][live], want[live],
+                                   rtol=2e-5, atol=2e-5)
