@@ -111,3 +111,26 @@ def test_quantiles_under_jit_vmap():
         live = ~np.isnan(want)
         np.testing.assert_allclose(got[i][live], want[live],
                                    rtol=2e-5, atol=2e-5)
+
+
+def test_empty_row_nan_under_jit_vmap():
+    """Zero-weight rows must yield NaN through the batched calling
+    context too (a batching-rule bug returning finite garbage for empty
+    rows would otherwise slip past the masked parity checks)."""
+    mean = np.ones((2, 4, 64), np.float32)
+    weight = np.zeros((2, 4, 64), np.float32)
+    weight[1, 2, :8] = 1.0       # one live row among empties
+    mn = np.full((2, 4), np.inf, np.float32)
+    mx = np.full((2, 4), -np.inf, np.float32)
+    mn[1, 2], mx[1, 2] = 1.0, 1.0
+    qs = np.asarray([0.5], np.float32)
+    fn = jax.jit(jax.vmap(
+        lambda m, w, lo, hi: quantiles_rows(m, w, lo, hi,
+                                            jnp.asarray(qs),
+                                            interpret=True)))
+    got = np.asarray(fn(jnp.asarray(mean), jnp.asarray(weight),
+                        jnp.asarray(mn), jnp.asarray(mx)))
+    live = np.zeros((2, 4), bool)
+    live[1, 2] = True
+    assert np.isnan(got[~live]).all()
+    np.testing.assert_allclose(got[1, 2], [1.0], rtol=1e-6)

@@ -33,6 +33,7 @@ from __future__ import annotations
 import functools
 import logging
 import os
+import sys
 
 import jax
 import jax.numpy as jnp
@@ -181,31 +182,64 @@ def enabled() -> bool:
             if jax.devices()[0].platform == "cpu":
                 _PROBE_RESULT = False
             else:
-                # probe the PRODUCTION calling contexts, not just the
-                # standalone kernel: the flush paths run this under jit
-                # (and the sharded merge under vmap inside shard_map),
-                # where a missing pallas batching/lowering rule fails at
-                # outer compile time — that failure must land here, not
-                # in the first real flush
-                def call(m, w, mn, mx):
-                    return quantiles_rows(
-                        m, w, mn, mx, jnp.asarray([0.5], jnp.float32))
-
-                m = jnp.asarray([[1.0, 2.0, 3.0, 4.0]], jnp.float32)
-                w = jnp.ones((1, 4), jnp.float32)
-                mn = jnp.asarray([1.0], jnp.float32)
-                mx = jnp.asarray([4.0], jnp.float32)
-                out = jax.jit(call)(m, w, mn, mx)
-                out_v = jax.jit(jax.vmap(call))(
-                    m[None], w[None], mn[None], mx[None])
-                # exact answer is 2.5 (midpoint interpolation between
-                # centroids 2 and 3); a loose tolerance would accept a
-                # miscompiled lowering that returns a raw centroid
-                _PROBE_RESULT = bool(
-                    abs(float(out[0, 0]) - 2.5) < 1e-3
-                    and abs(float(out_v[0, 0, 0]) - 2.5) < 1e-3)
+                _PROBE_RESULT = _run_probe_bounded()
         except Exception as e:  # noqa: BLE001 — any failure => XLA path
             log.warning("pallas quantile kernel unavailable, using XLA "
                         "path: %s", e)
             _PROBE_RESULT = False
     return _PROBE_RESULT
+
+
+def _probe() -> bool:
+    """Probe the PRODUCTION calling contexts, not just the standalone
+    kernel: the flush paths run this under jit (and the sharded merge
+    under vmap inside shard_map), where a missing pallas batching/
+    lowering rule fails at outer compile time — that failure must land
+    here, not in the first real flush."""
+    def call(m, w, mn, mx):
+        return quantiles_rows(m, w, mn, mx,
+                              jnp.asarray([0.5], jnp.float32))
+
+    m = jnp.asarray([[1.0, 2.0, 3.0, 4.0]], jnp.float32)
+    w = jnp.ones((1, 4), jnp.float32)
+    mn = jnp.asarray([1.0], jnp.float32)
+    mx = jnp.asarray([4.0], jnp.float32)
+    out = jax.jit(call)(m, w, mn, mx)
+    out_v = jax.jit(jax.vmap(call))(m[None], w[None], mn[None], mx[None])
+    # exact answer is 2.5 (midpoint interpolation between centroids 2
+    # and 3); a loose tolerance would accept a miscompiled lowering
+    # that returns a raw centroid
+    return bool(abs(float(out[0, 0]) - 2.5) < 1e-3
+                and abs(float(out_v[0, 0, 0]) - 2.5) < 1e-3)
+
+
+def _run_probe_bounded(budget_s: float = 60.0) -> bool:
+    """Run the probe in a SUBPROCESS with a hard budget. Two reasons for
+    the process boundary: a wedged remote-compile service would
+    otherwise stall the FIRST flush (the probe runs during its trace),
+    and a timed-out in-process thread abandoned inside the JAX runtime
+    aborts the interpreter at teardown (the rc-134 failure mode
+    server.shutdown documents). A killed child leaks nothing, and with
+    JAX_COMPILATION_CACHE_DIR set (bench.py does) the child's compile
+    even seeds this process's cache. Operators running a flush watchdog
+    tighter than this budget should pin VENEUR_TPU_PALLAS=0/1 instead
+    of relying on the probe."""
+    import subprocess
+    code = ("import sys; sys.path.insert(0, %r); "
+            "from veneur_tpu.ops.pallas_digest import _probe; "
+            "print('PALLAS_OK' if _probe() else 'PALLAS_NO')"
+            % os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))))
+    try:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True,
+                              timeout=budget_s)
+    except subprocess.TimeoutExpired:
+        log.warning("pallas probe exceeded %.0fs (compile service "
+                    "stalled?); using XLA path", budget_s)
+        return False
+    ok = "PALLAS_OK" in proc.stdout
+    if not ok:
+        log.warning("pallas quantile kernel unavailable, using XLA path "
+                    "(probe rc=%d)", proc.returncode)
+    return ok
