@@ -84,26 +84,68 @@ def main():
     out = {"metric": "aggregation_samples_per_sec_per_chip_1M_keys",
            "value": 0, "unit": "samples/sec", "vs_baseline": 0}
     from benchmarks.e2e import cache_env, parse_last_json_line
-    env = cache_env()   # one persistent XLA cache across every stage
-    try:
-        proc = subprocess.run(
-            [sys.executable, os.path.join(here, "bench.py"), "--kernel"],
-            capture_output=True, text=True, cwd=here, timeout=budget,
-            env=env)
-        parsed = parse_last_json_line(proc.stdout)
-        if parsed:
-            out.update(parsed)
-        else:
-            out["kernel_error"] = (f"rc={proc.returncode}: "
-                                   f"{proc.stderr.strip()[-400:]}")
-    except subprocess.TimeoutExpired:
-        out["kernel_error"] = f"kernel stage timeout after {budget:.0f}s"
 
-    # a dead tunnel diagnosed by the kernel stage would hang every e2e
-    # child too — skip the stage rather than burn 5 subprocess timeouts
-    tunnel_down = "backend init" in str(
-        out.get("error", "")) + str(out.get("kernel_error", ""))
-    if tunnel_down:
+    def run_kernel(force_cpu):
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.join(here, "bench.py"),
+                 "--kernel"],
+                capture_output=True, text=True, cwd=here, timeout=budget,
+                env=cache_env(force_cpu=force_cpu))
+            parsed = parse_last_json_line(proc.stdout)
+            if parsed is not None:
+                return parsed
+            return {"kernel_error": (f"rc={proc.returncode}: "
+                                     f"{proc.stderr.strip()[-400:]}")}
+        except subprocess.TimeoutExpired:
+            return {"kernel_error":
+                    f"kernel stage timeout after {budget:.0f}s"}
+
+    def init_failed(r):
+        # "backend init exceeded" = the child's init watchdog fired;
+        # "kernel stage timeout" = the child wedged AFTER init (the
+        # tunnel's documented slow-mode/wedge behavior) and the parent
+        # timeout killed it. Both mean this attempt saw no healthy chip:
+        # retry/fall back, and never point five e2e children at it.
+        s = str(r.get("error", "")) + str(r.get("kernel_error", ""))
+        return "backend init" in s or "stage timeout" in s
+
+    # The accelerator tunnel is flaky at round boundaries; a single
+    # 600s-watchdog attempt zeroed round 3's artifact. Re-probe until the
+    # retry budget is spent, then fall back to CPU-smoke numbers labeled
+    # as such — a down tunnel must never produce a value-0 artifact.
+    want_tpu = env_on_tpu()
+    force_cpu = not want_tpu
+    retry_budget = float(os.environ.get("BENCH_TUNNEL_RETRY_BUDGET",
+                                        "1800"))
+    retry_sleep = float(os.environ.get("BENCH_TUNNEL_RETRY_SLEEP", "120"))
+    deadline = time.monotonic() + retry_budget
+    attempts = 0
+    while True:
+        attempts += 1
+        res = run_kernel(force_cpu)
+        if not (want_tpu and not force_cpu and init_failed(res)):
+            break
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            out["tunnel_error"] = (
+                f"{res.get('error') or res.get('kernel_error')} "
+                f"({attempts} attempts over {retry_budget:.0f}s); "
+                "falling back to CPU smoke")
+            force_cpu = True
+            continue
+        time.sleep(min(retry_sleep, remaining))
+    out.update(res)
+    out["kernel_attempts"] = attempts
+    # the child reports the platform it actually ran on; an orchestrator
+    # guess would mislabel e.g. a host with no tunnel plugin at all
+    child_platform = res.get("platform", "cpu" if force_cpu else "tpu")
+    on_cpu = force_cpu or child_platform == "cpu"
+    out["platform"] = "cpu_smoke" if on_cpu else child_platform
+
+    if init_failed(res):
+        # even the fallback could not bring up a backend — hang every e2e
+        # child too?  No: skip the stage rather than burn 5 timeouts.
         out["e2e_error"] = "skipped: device backend init failed in the " \
                            "kernel stage"
     elif os.environ.get("BENCH_SKIP_E2E", "") != "1":
@@ -111,8 +153,8 @@ def main():
             from benchmarks import e2e
             scale_env = os.environ.get("BENCH_E2E_SCALE")
             scale = float(scale_env) if scale_env else (
-                0.25 if env_on_tpu() else 0.02)
-            out["e2e"] = e2e.main(scale=scale)
+                0.02 if on_cpu else 0.25)
+            out["e2e"] = e2e.main(scale=scale, force_cpu=on_cpu)
             cfg2 = next((r for r in out["e2e"] if r.get("config") == 2), None)
             if cfg2 and "samples_per_sec" in cfg2:
                 out["e2e_samples_per_sec"] = cfg2["samples_per_sec"]
@@ -128,11 +170,12 @@ def kernel_main():
     # with a diagnostic line instead of hanging the driver (shared with
     # the e2e config children so the orchestrator's "backend init"
     # dead-tunnel detection matches both).
-    from benchmarks.e2e import _arm_init_watchdog
+    from benchmarks.e2e import _arm_init_watchdog, pin_platform
     timer = _arm_init_watchdog({
         "metric": "aggregation_samples_per_sec_per_chip_1M_keys",
         "value": 0, "unit": "samples/sec", "vs_baseline": 0})
     import jax
+    pin_platform()
     import jax.numpy as jnp
     from veneur_tpu.aggregation.state import TableSpec, empty_state
     from veneur_tpu.aggregation.step import (
@@ -236,6 +279,7 @@ def kernel_main():
         "value": round(rate, 1),
         "unit": "samples/sec",
         "vs_baseline": round(rate / 50e6, 4),
+        "platform": dev.platform,
         "digest_accuracy": digest_accuracy(
             jnp, state, spec, batches, uses, flush_compute),
     }

@@ -572,21 +572,43 @@ def _arm_init_watchdog(diag: dict):
     return t
 
 
-def cache_env() -> dict:
+def cache_env(force_cpu: bool = False) -> dict:
     """Child-process env with ONE persistent XLA compilation cache shared
     by every benchmark stage (kernel + the five config children): each
     child otherwise pays every compile cold — measured 2x total wall on
     repeat runs, and warmer timed regions. setdefault so an operator's
-    JAX_COMPILATION_CACHE_DIR wins."""
+    JAX_COMPILATION_CACHE_DIR wins.
+
+    With force_cpu (or a parent env already requesting cpu), the child is
+    kept off the accelerator tunnel COMPLETELY: the tunnel plugin's
+    registration phones its remote agent even when the cpu platform is
+    ultimately selected, so a wedged tunnel would hang `jax.devices()`
+    regardless of JAX_PLATFORMS. Dropping the plugin's gating env var is
+    the only fully hermetic bypass."""
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = dict(os.environ)
     env.setdefault("JAX_COMPILATION_CACHE_DIR",
                    os.path.join(repo, ".xla_cache"))
     env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+    if force_cpu or env.get("JAX_PLATFORMS", "").split(",")[0].strip() \
+            == "cpu":
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("PALLAS_AXON_POOL_IPS", None)
     return env
 
 
-def _run_config_subprocess(n, scale):
+def pin_platform():
+    """Honor a JAX_PLATFORMS=cpu request at the config level. The tunnel
+    plugin force-selects jax_platforms="axon,cpu" at interpreter start,
+    overriding the env var — only jax.config.update actually keeps JAX
+    off a (possibly dead) tunnel (the tests/conftest.py idiom). Call
+    after `import jax`, before the first dispatch."""
+    if os.environ.get("JAX_PLATFORMS", "").split(",")[0].strip() == "cpu":
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+
+def _run_config_subprocess(n, scale, force_cpu=False):
     """One config per subprocess. Two reasons: (a) the reference's own
     perf story is per-benchmark processes (`go test -bench` spawns a
     fresh process per package), and (b) the tunneled single-chip backend
@@ -603,7 +625,7 @@ def _run_config_subprocess(n, scale):
     # scale=None is resolved by the CHILD (where jax.devices() is safe);
     # resolving it here would initialize the backend in the parent and
     # block every child from acquiring the single tunneled chip
-    env = cache_env()
+    env = cache_env(force_cpu=force_cpu)
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True,
                               cwd=repo, timeout=SUBPROC_TIMEOUT, env=env)
@@ -616,13 +638,14 @@ def _run_config_subprocess(n, scale):
             f"rc={proc.returncode}: {proc.stderr.strip()[-400:]}"}
 
 
-def main(configs=None, scale=None, in_process=False):
+def main(configs=None, scale=None, in_process=False, force_cpu=False):
     if in_process:
         # only the in-process (child) path may touch the backend; the
         # subprocess orchestrator must stay off the chip entirely
         watchdog = _arm_init_watchdog(
             {"config": sorted(configs or CONFIGS)[0]})
         import jax
+        pin_platform()
         on_tpu = jax.devices()[0].platform != "cpu"
         watchdog.cancel()
         if scale is None:
@@ -632,7 +655,8 @@ def main(configs=None, scale=None, in_process=False):
         if in_process:
             results.append(CONFIGS[n](scale))
         else:
-            results.append(_run_config_subprocess(n, scale))
+            results.append(_run_config_subprocess(n, scale,
+                                                  force_cpu=force_cpu))
     return results
 
 

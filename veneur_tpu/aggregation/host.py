@@ -48,6 +48,10 @@ class SlotMeta:
     # imported mixed histos have no local scalars, so only percentiles
     # flush). Cleared on the first directly-sampled value.
     imported_only: bool = False
+    # the parser's precomputed MetricKey.JoinedTags, when the allocation
+    # site had it; lets flush labeling test for routing tags with ONE
+    # substring scan instead of per-tag startswith (None -> join lazily)
+    joined_tags: Optional[str] = None
     # flusher.generate_intermetrics cache: (tags list, sink route,
     # hostname) computed once per key per interval. The tags list is
     # SHARED by every InterMetric of the key — sinks must derive
@@ -135,7 +139,8 @@ class KeyTable:
         return t.slot_for(
             key, digest,
             lambda: SlotMeta(name=name, tags=tags, scope=scope, kind=kind,
-                             hostname=hostname, imported_only=imported))
+                             hostname=hostname, imported_only=imported,
+                             joined_tags=joined_tags))
 
     def get_meta(self, kind: str):
         """[(slot, SlotMeta)] in allocation order for flush labeling."""

@@ -18,7 +18,7 @@ STATUS = "status"
 SINK_ONLY_TAG_PREFIX = "veneursinkonly:"
 
 
-@dataclasses.dataclass
+@dataclasses.dataclass(slots=True)
 class InterMetric:
     name: str
     timestamp: int

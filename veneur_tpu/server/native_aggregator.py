@@ -73,7 +73,7 @@ class NativeKeyTable:
             # digest) agree with the reference either way.
             m = SlotMeta(name=name,
                          tags=tuple(joined.split(",")) if joined else (),
-                         scope=scope, kind=kind)
+                         scope=scope, kind=kind, joined_tags=joined)
             self.meta[tname].append((slot, m))
             self.by_slot[tname][slot] = m
 
@@ -96,7 +96,8 @@ class NativeKeyTable:
             # commas, which a joined-string round-trip would corrupt
             tname = self._TABLE(kind)
             m = SlotMeta(name=name, tags=tags, scope=scope, kind=kind,
-                         hostname=hostname, imported_only=imported)
+                         hostname=hostname, imported_only=imported,
+                         joined_tags=joined)
             self.meta[tname].append((slot, m))
             self.by_slot[tname][slot] = m
         return slot
