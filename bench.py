@@ -85,12 +85,12 @@ def main():
            "value": 0, "unit": "samples/sec", "vs_baseline": 0}
     from benchmarks.e2e import cache_env, parse_last_json_line
 
-    def run_kernel(force_cpu):
+    def run_kernel(force_cpu, timeout):
         try:
             proc = subprocess.run(
                 [sys.executable, os.path.join(here, "bench.py"),
                  "--kernel"],
-                capture_output=True, text=True, cwd=here, timeout=budget,
+                capture_output=True, text=True, cwd=here, timeout=timeout,
                 env=cache_env(force_cpu=force_cpu))
             parsed = parse_last_json_line(proc.stdout)
             if parsed is not None:
@@ -99,7 +99,7 @@ def main():
                                      f"{proc.stderr.strip()[-400:]}")}
         except subprocess.TimeoutExpired:
             return {"kernel_error":
-                    f"kernel stage timeout after {budget:.0f}s"}
+                    f"kernel stage timeout after {timeout:.0f}s"}
 
     def init_failed(r):
         # "backend init exceeded" = the child's init watchdog fired;
@@ -123,7 +123,15 @@ def main():
     attempts = 0
     while True:
         attempts += 1
-        res = run_kernel(force_cpu)
+        # a post-init wedge burns its whole subprocess timeout, so TPU
+        # attempts are clamped to the remaining retry budget (floor 120s
+        # for a fighting chance) — otherwise the stage could overrun its
+        # combined budgets by multiples and an outer job timeout would
+        # kill the orchestrator before it prints ANY artifact. The final
+        # CPU fallback gets the full budget; CPU cannot wedge.
+        t = budget if force_cpu else min(
+            budget, max(120.0, deadline - time.monotonic()))
+        res = run_kernel(force_cpu, t)
         if not (want_tpu and not force_cpu and init_failed(res)):
             break
         remaining = deadline - time.monotonic()

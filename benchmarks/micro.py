@@ -276,8 +276,70 @@ def bench_metric_extraction(seconds):
     return _timeit(run, seconds, batch=len(spans))
 
 
+def _label_fixture(n_counters=100_000, n_histos=10_000):
+    """Mixed live table + compact flush arrays for the labeling micros
+    (reference generateInterMetrics, flusher.go:225-298)."""
+    from veneur_tpu.aggregation.host import KeyTable
+    from veneur_tpu.aggregation.state import TableSpec
+    spec = TableSpec(counter_capacity=n_counters, gauge_capacity=64,
+                     status_capacity=64, set_capacity=64,
+                     histo_capacity=n_histos)
+    table = KeyTable(spec)
+    for i in range(n_counters):
+        table.slot_for("counter", f"svc.req.{i}", ("env:prod", "az:a"),
+                       0, i)
+    for i in range(n_histos):
+        table.slot_for("histogram", f"svc.lat.{i}", ("env:prod",), 0, i)
+    rng = np.random.default_rng(0)
+    flush = {
+        "counter": rng.uniform(1, 9, n_counters),
+        "gauge": np.zeros(64), "status": np.zeros(64),
+        "set_estimate": np.zeros(64),
+        "histo_quantiles": rng.uniform(0, 9, (n_histos, 3)),
+        "histo_count": np.ones(n_histos),
+        "histo_min": np.zeros(n_histos), "histo_max": np.ones(n_histos),
+        "histo_median": np.ones(n_histos), "histo_avg": np.ones(n_histos),
+        "histo_sum": np.ones(n_histos), "histo_hmean": np.ones(n_histos),
+    }
+    kw = dict(percentiles=[0.5, 0.9, 0.99],
+              aggregates=["min", "max", "count"], is_local=False,
+              timestamp=0, hostname="h")
+    n_metrics = n_counters + 6 * n_histos
+    return flush, table, kw, n_metrics
+
+
+def bench_flush_label_objects(seconds):
+    """Host flush labeling, per-metric InterMetric objects (110k live
+    keys -> 160k metrics per call; scales linearly to the 1M/10M-key
+    results quoted in PARITY.md). The per-key prep cache is cleared
+    inside the timed region: production builds a fresh KeyTable every
+    interval (aggregator.swap), so prep runs once per key per interval
+    and a cache-warm measurement would understate the real cost."""
+    from veneur_tpu.server.flusher import generate_intermetrics
+    flush, table, kw, n = _label_fixture()
+
+    def run():
+        for kind in ("counter", "histogram"):
+            for _s, m in table.get_meta(kind):
+                m._emit_prep = None
+        generate_intermetrics(flush, table, **kw)
+
+    return _timeit(run, seconds, batch=n)
+
+
+def bench_flush_label_frame(seconds):
+    """Columnar MetricFrame labeling — no per-metric objects (the 10M-key
+    path; flusher.MetricFrame)."""
+    from veneur_tpu.server.flusher import generate_frame
+    flush, table, kw, n = _label_fixture()
+    return _timeit(lambda: generate_frame(flush, table, **kw),
+                   seconds, batch=n)
+
+
 MICROS = {
     "parse_metric": bench_parse_metric,
+    "flush_label_objects": bench_flush_label_objects,
+    "flush_label_frame": bench_flush_label_frame,
     "parse_metric_native": bench_parse_metric_native,
     "parse_ssf": bench_parse_ssf,
     "worker_ingest": bench_worker_ingest,

@@ -40,6 +40,7 @@ class Aggregator:
         # stats (reference self-telemetry counters)
         self.processed = 0
         self.dropped_capacity = 0
+        self.h2d_bytes = 0  # packed ingest bytes shipped to the device
 
     def extra_parse_errors(self) -> int:
         """Parse errors counted below the Python layer (native engine)."""
@@ -50,10 +51,10 @@ class Aggregator:
         # one packed H2D transfer per step; compaction rides the same
         # program via the control word (step.py pack_batch rationale)
         self._steps += 1
+        flat = pack_batch(batch, self._steps % self.compact_every == 0)
+        self.h2d_bytes += flat.nbytes
         self.state = ingest_step_packed(
-            self.state,
-            pack_batch(batch, self._steps % self.compact_every == 0),
-            spec=self.spec, sizes=batch_sizes(batch))
+            self.state, flat, spec=self.spec, sizes=batch_sizes(batch))
 
     def process_metric(self, m: UDPMetric) -> None:
         """reference worker.go:344 ProcessMetric: switch on type+scope,
