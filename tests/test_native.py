@@ -352,3 +352,75 @@ def test_fuzz_multiline_packet_splitting_parity():
     assert n1 == n2
     for x, y in zip(a1, a2):
         np.testing.assert_array_equal(x, y)
+
+
+# -- documented native-path deviations, pinned -------------------------------
+# native_aggregator.py:14-27 documents two deliberate cross-stream
+# imprecisions. These tests FAIL if the documented behavior drifts, so a
+# regression (or an undocumented "fix") is visible.
+
+def _flush_names(agg, percentiles=(0.5,), is_local=False):
+    from veneur_tpu.server.flusher import generate_intermetrics
+    state, table = agg.swap()
+    flush, table = agg.compute_flush(state, table, list(percentiles))
+    return {m.name: m.value for m in generate_intermetrics(
+        flush, table, percentiles=list(percentiles),
+        aggregates=["min", "max", "count"], is_local=is_local,
+        timestamp=0)}
+
+
+def _small_native_agg():
+    from veneur_tpu.server.native_aggregator import NativeAggregator
+    spec = TableSpec(counter_capacity=64, gauge_capacity=64,
+                     status_capacity=16, set_capacity=16,
+                     histo_capacity=64)
+    return spec, NativeAggregator(spec, BatchSpec(
+        counter=128, gauge=64, status=16, set=64, histo=128))
+
+
+def test_deviation_imported_only_sticky_across_wire_hits():
+    """Import-then-wire histo keeps imported_only for the interval on the
+    NATIVE path (aggregates suppressed on a global tier, percentiles
+    flush) — while the pure-Python path clears it. Both halves pinned."""
+    import jax
+
+    m = parser.parse_metric(b"hdev:5|h")
+    payload = {"means": np.asarray([2.0, 4.0], np.float32),
+               "weights": np.asarray([1.0, 1.0], np.float32)}
+
+    spec, nat = _small_native_agg()
+    nat.import_metric("histogram", "hdev", (), m.scope, m.digest, payload)
+    nat.feed(b"hdev:5|h\n")          # direct wire hit, same key
+    got = _flush_names(nat)
+    assert "hdev.50percentile" in got          # percentiles always flush
+    assert "hdev.count" not in got, \
+        "native path now clears imported_only on wire hits — update " \
+        "native_aggregator.py:14-27 and this pin together"
+
+    from veneur_tpu.server.aggregator import Aggregator
+    py = Aggregator(spec, BatchSpec(counter=128, gauge=64, status=16,
+                                    set=64, histo=128))
+    py.import_metric("histogram", "hdev", (), m.scope, m.digest, payload)
+    py.process_metric(m)             # python path clears the flag
+    got = _flush_names(py)
+    assert "hdev.count" in got and got["hdev.count"] == 3.0
+    jax.block_until_ready(py.state)
+
+
+def test_deviation_gauge_lww_per_stream_not_arrival_ordered():
+    """Cross-stream gauge LWW: the Python-side batch emits after the
+    native staging at swap, so the Python write wins even when the wire
+    sample arrived LATER. Single-stream ordering stays exact."""
+    _spec, nat = _small_native_agg()
+    nat.process_metric(parser.parse_metric(b"gdev:1.0|g"))  # python stream
+    nat.feed(b"gdev:2.0|g\n")        # wire arrives after — but loses
+    got = _flush_names(nat)
+    assert got["gdev"] == 1.0, \
+        "cross-stream gauge LWW became arrival-ordered — update " \
+        "native_aggregator.py:14-27 and this pin together"
+
+    # single-stream (wire-only) stays arrival-ordered
+    _spec, nat2 = _small_native_agg()
+    nat2.feed(b"gdev:1.5|g\ngdev:3.5|g\n")
+    got = _flush_names(nat2)
+    assert got["gdev"] == 3.5
