@@ -184,3 +184,77 @@ def test_http_import_continues_forwarders_trace():
         assert found.parent_id == parent.id
     finally:
         srv.shutdown()
+
+
+# -- StartSpan references / baggage / finish options (opentracing.go:403) ----
+
+def test_start_span_child_of_span_and_context():
+    from veneur_tpu.trace.opentracing import (
+        OpenTracingTracer, SpanContext, span_context)
+    tr = OpenTracingTracer(service="svc")
+    root = tr.start_span_ot("root")
+    assert root.parent_id == 0 and root.name == "root"
+
+    child = tr.start_span_ot("c1", child_of=root)
+    assert child.trace_id == root.trace_id
+    assert child.parent_id == root.id
+
+    # a raw SpanContext works as the reference too
+    ctx = span_context(root)
+    child2 = tr.start_span_ot("c2", child_of=ctx)
+    assert child2.trace_id == root.trace_id
+    assert child2.parent_id == root.id
+
+
+def test_follows_from_treated_as_child_of():
+    """opentracing.go:430: FollowsFromRef falls through to ChildOfRef."""
+    from veneur_tpu.trace.opentracing import OpenTracingTracer
+    tr = OpenTracingTracer(service="svc")
+    root = tr.start_span_ot("root")
+    f = tr.start_span_ot("f", follows_from=root)
+    c = tr.start_span_ot("c", child_of=root)
+    assert (f.trace_id, f.parent_id) == (c.trace_id, c.parent_id)
+
+
+def test_start_span_name_tag_override_and_caller_fallback():
+    from veneur_tpu.trace.opentracing import OpenTracingTracer
+    tr = OpenTracingTracer(service="svc")
+    s = tr.start_span_ot("orig", tags={"name": "renamed", "k": "v"})
+    assert s.name == "renamed" and s.tags["k"] == "v"
+    anon = tr.start_span_ot("")
+    assert anon.name == \
+        "test_start_span_name_tag_override_and_caller_fallback"
+
+
+def test_baggage_propagates_to_children_not_identity():
+    from veneur_tpu.trace.opentracing import OpenTracingTracer
+    tr = OpenTracingTracer(service="svc")
+    root = tr.start_span_ot("root")
+    root.set_baggage_item("tenant", "t-9")
+    assert root.baggage_item("TENANT") == "t-9"   # case-insensitive read
+    child = tr.start_span_ot("c", child_of=root)
+    assert child.baggage_item("tenant") == "t-9"
+    # identity keys come from the span ids, never from baggage
+    assert child.trace_id == root.trace_id and child.parent_id == root.id
+
+
+def test_finish_with_options_and_log_records():
+    import time as _t
+    from veneur_tpu.trace.opentracing import OpenTracingTracer
+    tr = OpenTracingTracer(service="svc")
+    s = tr.start_span_ot("op", start_time_ns=1_000)
+    s.log_kv("event", "retry", "attempt", 2)
+    end = int(_t.time() * 1e9)
+    ssf = s.finish_with_options(finish_time_ns=end,
+                                log_records=[{"msg": "done"}])
+    assert ssf.start_timestamp == 1_000 and ssf.end_timestamp == end
+    # records retained but never serialized into SSF — the reference
+    # ignores log data on the wire (opentracing.go:312)
+    assert s.log_lines == [{"event": "retry", "attempt": 2},
+                           {"msg": "done"}]
+    assert not any("retry" in str(t) for t in ssf.tags.values())
+    # deprecated interface-compat no-ops exist and do nothing
+    s.log_event("x")
+    s.log_event_with_payload("x", {"y": 1})
+    s.log(None)
+    assert s.log_lines[-1] == {"msg": "done"}

@@ -1,0 +1,122 @@
+"""veneur-prometheus scrape transports + filter flags
+(reference cmd/veneur-prometheus: config.go newHTTPClient mTLS,
+unixtripper.go unix-socket transport, main.go prefix/ignore flags)."""
+
+import http.server
+import socketserver
+import ssl
+import subprocess
+import threading
+
+import pytest
+
+from veneur_tpu.cli.prometheus import (
+    Translator, make_fetcher, parse_exposition)
+
+EXPO = (b"# TYPE req_total counter\n"
+        b'req_total{az="a",secret_label="x"} 7\n'
+        b"# TYPE temp gauge\n"
+        b"temp 3.5\n"
+        b"# TYPE noisy_debug gauge\n"
+        b"noisy_debug 1\n")
+
+
+class _Handler(http.server.BaseHTTPRequestHandler):
+    def do_GET(self):
+        self.send_response(200)
+        self.send_header("Content-Type", "text/plain")
+        self.end_headers()
+        self.wfile.write(EXPO)
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture(scope="module")
+def certs(tmp_path_factory):
+    d = tmp_path_factory.mktemp("promtls")
+
+    def run(*args):
+        subprocess.run(args, check=True, capture_output=True, cwd=d)
+
+    run("openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+        "-keyout", "ca.key", "-out", "ca.crt", "-days", "1",
+        "-subj", "/CN=test-ca")
+    for name in ("server", "client"):
+        run("openssl", "req", "-newkey", "rsa:2048", "-nodes",
+            "-keyout", f"{name}.key", "-out", f"{name}.csr",
+            "-subj", f"/CN={name}", "-addext",
+            "subjectAltName=IP:127.0.0.1")
+        run("openssl", "x509", "-req", "-in", f"{name}.csr",
+            "-CA", "ca.crt", "-CAkey", "ca.key", "-CAcreateserial",
+            "-out", f"{name}.crt", "-days", "1",
+            "-copy_extensions", "copyall")
+    return d
+
+
+def test_mtls_scrape(certs):
+    """Server requires a client certificate; the fetcher presents one and
+    trusts only the test CA — the reference's mTLS contract."""
+    httpd = http.server.HTTPServer(("127.0.0.1", 0), _Handler)
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(certs / "server.crt", certs / "server.key")
+    ctx.load_verify_locations(certs / "ca.crt")
+    ctx.verify_mode = ssl.CERT_REQUIRED
+    httpd.socket = ctx.wrap_socket(httpd.socket, server_side=True)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        url = f"https://127.0.0.1:{httpd.server_address[1]}/metrics"
+        fetch = make_fetcher(url, cert=str(certs / "client.crt"),
+                             key=str(certs / "client.key"),
+                             cacert=str(certs / "ca.crt"))
+        types, samples = parse_exposition(fetch())
+        assert types["req_total"] == "counter"
+        assert ("temp", {}, 3.5) in samples
+
+        # without a client cert the handshake must fail
+        bare = make_fetcher(url, cacert=str(certs / "ca.crt"))
+        with pytest.raises(Exception):
+            bare()
+    finally:
+        httpd.shutdown()
+
+
+def test_unix_socket_scrape(tmp_path):
+    """HTTP scrape tunneled over a unix domain socket
+    (unixtripper.go): the URL keeps its path; the dial goes to the
+    socket."""
+    sock_path = str(tmp_path / "prom.sock")
+
+    class _UnixHTTPServer(socketserver.UnixStreamServer):
+        def get_request(self):
+            req, _ = super().get_request()
+            return req, ("127.0.0.1", 0)   # BaseHTTPRequestHandler wants a pair
+
+    httpd = _UnixHTTPServer(sock_path, _Handler)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        fetch = make_fetcher("http://prom.internal/metrics",
+                             socket_path=sock_path)
+        types, _samples = parse_exposition(fetch())
+        assert types["temp"] == "gauge"
+    finally:
+        httpd.shutdown()
+
+
+def test_prefix_and_ignore_filters():
+    """-prefix / -ignored-labels / -ignored-metrics (main.go:17-19)."""
+    types, samples = parse_exposition(EXPO.decode())
+    tr = Translator(prefix="svc.", ignored_labels=["^secret_"],
+                    ignored_metrics=["^noisy_"])
+    tr.translate(types, samples)          # prime the counter cache
+    samples2 = [(n, dict(l), v + (7 if n == "req_total" else 0))
+                for n, l, v in samples]
+    pkts = tr.translate(types, samples2)
+    joined = b"\n".join(pkts).decode()
+    assert "svc.req_total:7|c" in joined
+    assert "svc.temp:3.5|g" in joined
+    assert "secret_label" not in joined   # label dropped
+    assert "az:a" in joined               # other labels kept
+    assert "noisy_debug" not in joined    # metric skipped

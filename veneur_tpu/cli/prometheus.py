@@ -10,6 +10,13 @@ Translation rules (translate.go):
   _sum/_count deltas
 - summary -> quantile values as gauges tagged quantile=<q>, plus
   _sum/_count deltas
+
+Scrape transport (cmd/veneur-prometheus/config.go newHTTPClient):
+- `-cert`/`-key` present a client certificate (mTLS); `-cacert` trusts
+  ONLY the given CA for the server certificate (the reference builds a
+  dedicated x509.CertPool, not the system roots)
+- `-socket` tunnels the HTTP scrape over a unix domain socket
+  (unixtripper.go), for proxy-sidecar setups
 """
 
 from __future__ import annotations
@@ -61,23 +68,86 @@ def _series_key(name, labels):
     return (name, tuple(sorted(labels.items())))
 
 
+def make_fetcher(url, cert=None, key=None, cacert=None, socket_path=None,
+                 timeout=10.0):
+    """Build the scrape callable (config.go:42 newHTTPClient): plain
+    HTTP(S), mTLS with a dedicated trust pool, or HTTP over a unix
+    socket (unixtripper.go)."""
+    if socket_path:
+        import http.client
+        from urllib.parse import urlsplit
+        parts = urlsplit(url)
+        path = (parts.path or "/metrics") + \
+            (f"?{parts.query}" if parts.query else "")
+        host_hdr = parts.netloc or "localhost"
+
+        class _UnixConn(http.client.HTTPConnection):
+            def __init__(self):
+                super().__init__("localhost", timeout=timeout)
+
+            def connect(self):
+                s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                s.settimeout(timeout)
+                s.connect(socket_path)
+                self.sock = s
+
+        def fetch():
+            conn = _UnixConn()
+            try:
+                conn.request("GET", path, headers={"Host": host_hdr})
+                resp = conn.getresponse()
+                if resp.status != 200:
+                    raise RuntimeError(f"HTTP {resp.status} over unix "
+                                       f"socket {socket_path}")
+                return resp.read().decode()
+            finally:
+                conn.close()
+        return fetch
+
+    ctx = None
+    if url.startswith("https") or cert or cacert:
+        import ssl
+        # cafile given -> trust ONLY that CA (the reference's dedicated
+        # x509.NewCertPool); otherwise the default system roots
+        ctx = ssl.create_default_context(cafile=cacert or None)
+        if cert:
+            ctx.load_cert_chain(cert, key or None)
+
+    def fetch():
+        with urllib.request.urlopen(url, timeout=timeout,
+                                    context=ctx) as resp:
+            return resp.read().decode()
+    return fetch
+
+
 class Translator:
     """Stateful poll-to-statsd translation with the counter delta cache
     (translate.go cache semantics)."""
 
-    def __init__(self, added_tags=()):
+    def __init__(self, added_tags=(), prefix="", ignored_labels=(),
+                 ignored_metrics=()):
         self.cache = {}
         self.added_tags = list(added_tags)
+        # reference -p prefix ("include a trailing period") and the
+        # ignored-labels / ignored-metrics regex lists (main.go:17-19,
+        # prometheus.go:63 shouldExportMetric, translate.go:186)
+        self.prefix = prefix
+        self.ignored_labels = [re.compile(p) for p in ignored_labels]
+        self.ignored_metrics = [re.compile(p) for p in ignored_metrics]
         self.primed = False
 
+    def _ignored(self, name) -> bool:
+        return any(p.search(name) for p in self.ignored_metrics)
+
     def _tags(self, labels, extra=()):
-        tags = [f"{k}:{v}" for k, v in sorted(labels.items())]
+        tags = [f"{k}:{v}" for k, v in sorted(labels.items())
+                if not any(p.search(k) for p in self.ignored_labels)]
         tags += self.added_tags
         tags += list(extra)
         return tags
 
     def _pkt(self, name, value, mtype, tags):
-        s = f"{name}:{value}|{mtype}"
+        s = f"{self.prefix}{name}:{value}|{mtype}"
         if tags:
             s += "|#" + ",".join(tags)
         return s.encode()
@@ -97,6 +167,8 @@ class Translator:
                 if name.endswith(suffix) and base[:-len(suffix)] in types:
                     base = name[:-len(suffix)]
                     break
+            if self._ignored(base) or (base != name and self._ignored(name)):
+                continue
             mtype = types.get(name) or types.get(base, "untyped")
             if mtype == "counter":
                 d = self._delta(_series_key(name, labels), value)
@@ -134,6 +206,21 @@ def main(argv=None):
     ap.add_argument("-i", dest="interval", default="10s")
     ap.add_argument("-a", dest="added_tags", default="",
                     help="comma-separated tags added to every metric")
+    ap.add_argument("-prefix", default="",
+                    help="prefix for every emitted metric name; include "
+                         "the trailing period (reference -p)")
+    ap.add_argument("-ignored-labels", dest="ignored_labels", default="",
+                    help="comma-separated label-name regexes to drop")
+    ap.add_argument("-ignored-metrics", dest="ignored_metrics", default="",
+                    help="comma-separated metric-name regexes to skip")
+    ap.add_argument("-cert", default="",
+                    help="client cert to present (mTLS scrape)")
+    ap.add_argument("-key", default="",
+                    help="client private key for -cert")
+    ap.add_argument("-cacert", default="",
+                    help="CA cert that alone validates the server")
+    ap.add_argument("-socket", default="",
+                    help="unix socket path to tunnel the scrape through")
     ap.add_argument("-once", action="store_true",
                     help="poll once (two fetches for deltas) and exit")
     args = ap.parse_args(argv)
@@ -145,14 +232,18 @@ def main(argv=None):
     sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
     addr = (host, int(port or 8126))
 
-    tr = Translator([t for t in args.added_tags.split(",") if t])
+    tr = Translator(
+        [t for t in args.added_tags.split(",") if t],
+        prefix=args.prefix,
+        ignored_labels=[p for p in args.ignored_labels.split(",") if p],
+        ignored_metrics=[p for p in args.ignored_metrics.split(",") if p])
+    fetch = make_fetcher(args.prometheus_url, cert=args.cert or None,
+                         key=args.key or None, cacert=args.cacert or None,
+                         socket_path=args.socket or None)
     polls = 0
     while True:
         try:
-            with urllib.request.urlopen(args.prometheus_url,
-                                        timeout=10) as resp:
-                text = resp.read().decode()
-            types, samples = parse_exposition(text)
+            types, samples = parse_exposition(fetch())
             packets = tr.translate(types, samples)
             for p in packets:
                 sock.sendto(p, addr)

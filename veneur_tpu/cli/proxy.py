@@ -39,8 +39,13 @@ def main(argv=None):
     proxy = ProxyServer(disc, service=service or "static",
                         refresh_interval=refresh)
     proxy.start(cfg.grpc_address)
+    if cfg.http_address:
+        # v1 HTTP routing surface (reference proxy.go:518): POST /import
+        # consistent-hashes a JSONMetric array across the same ring
+        proxy.start_http(cfg.http_address)
     logging.getLogger("veneur_tpu").info(
-        "veneur-tpu-proxy listening on port %s", proxy.port)
+        "veneur-tpu-proxy listening on port %s (http %s)", proxy.port,
+        proxy.http_port)
 
     stop = threading.Event()
     signal.signal(signal.SIGINT, lambda *_: stop.set())

@@ -69,7 +69,9 @@ class ProxyServer:
         self._lock = threading.Lock()
         self._shutdown = threading.Event()
         self._grpc = None
+        self._http = None
         self.port = None
+        self.http_port = None
         self.forwarded = 0
         self.errors = 0
         self.refresh()
@@ -121,6 +123,118 @@ class ProxyServer:
                 self.errors += len(batch)
                 log.warning("proxy forward to %s failed: %s", dest, e)
 
+    # -- HTTP-era (v1) routing ----------------------------------------------
+    def handle_json(self, json_metrics: List[dict]) -> Dict[str, List[dict]]:
+        """Split a JSONMetric array by MetricKey over the ring
+        (proxy.go:580 ProxyMetrics: key = Name+Type+JoinedTags). Returns
+        the per-destination batches; callers POST each to <dest>/import."""
+        by_dest: Dict[str, List[dict]] = {}
+        with self._lock:
+            ring = self._ring
+        for jm in json_metrics:
+            key = (f"{jm.get('name', '')}{jm.get('type', '')}"
+                   f"{jm.get('tagstring', '')}").encode()
+            dest = ring.get(key)
+            if dest is None:
+                self.errors += 1
+                continue
+            by_dest.setdefault(dest, []).append(jm)
+        return by_dest
+
+    def _post_import(self, dest: str, batch: List[dict]) -> None:
+        """POST one batch to <dest>/import as deflate-compressed JSON
+        (the reference's vhttp.PostHelper with compress=true,
+        proxy.go:622 doPost). HTTPForwardClient owns scheme handling."""
+        from veneur_tpu.forward.rpc import HTTPForwardClient
+        HTTPForwardClient(dest).send_json(batch)
+
+    def proxy_json_metrics(self, json_metrics: List[dict]) -> None:
+        """ProxyMetrics (proxy.go:580): hash-split, then one POST per
+        destination, counting errors per batch like the gRPC path."""
+        for dest, batch in self.handle_json(json_metrics).items():
+            try:
+                self._post_import(dest, batch)
+                self.forwarded += len(batch)
+            except Exception as e:
+                self.errors += len(batch)
+                log.warning("proxy POST to %s failed: %s", dest, e)
+
+    def start_http(self, address: str = "127.0.0.1:0") -> int:
+        """The v1 proxy surface (proxy.go:518 mux): POST /import routes a
+        JSONMetric array across the ring; GET /healthcheck. Returns the
+        bound port. The 202 is sent BEFORE forwarding, matching the
+        reference ("the response has already been returned at this
+        point", proxy.go:607)."""
+        import http.server
+        import json as _json
+        import zlib
+        srv = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def _reply(self, code, body=b""):
+                self.send_response(code)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthcheck":
+                    self._reply(200, b"ok")
+                else:
+                    self._reply(404)
+
+            def do_POST(self):
+                if self.path != "/import":
+                    self._reply(404)
+                    return
+                body = self.rfile.read(
+                    int(self.headers.get("Content-Length", "0")))
+                if self.headers.get("Content-Encoding", "") == "deflate":
+                    try:
+                        body = zlib.decompress(body)
+                    except zlib.error:
+                        self._reply(400, b"bad deflate body")
+                        return
+                try:
+                    jms = _json.loads(body)
+                except ValueError:
+                    self._reply(400, b"bad JSON body")
+                    return
+                if not isinstance(jms, list) or not all(
+                        isinstance(jm, dict) for jm in jms):
+                    self._reply(400, b"bad JSONMetric array")
+                    return
+                # an empty array is a valid no-op, not an error
+                self._reply(202, b"accepted")
+                if jms:
+                    srv.proxy_json_metrics(jms)
+
+        # accept the same spellings the server's http_address does:
+        # optional tcp:// (or http://) scheme and bracketed IPv6 literals
+        if "://" in address:
+            address = address.partition("://")[2]
+        if address.startswith("["):
+            host, _, rest = address[1:].partition("]")
+            port = rest.lstrip(":")
+        else:
+            host, _, port = address.rpartition(":")
+            if not host:
+                host, port = port, ""
+        import socket as _socket
+
+        class _Server(http.server.ThreadingHTTPServer):
+            address_family = (_socket.AF_INET6 if ":" in host
+                              else _socket.AF_INET)
+
+        httpd = _Server((host, int(port or 0)), Handler)
+        self._http = httpd
+        self.http_port = httpd.server_address[1]
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        return self.http_port
+
     # -- lifecycle ----------------------------------------------------------
     def start(self, address: str = "127.0.0.1:0"):
         self._grpc, self.port = serve(self.handle, address)
@@ -136,6 +250,9 @@ class ProxyServer:
         self._shutdown.set()
         if self._grpc is not None:
             self._grpc.stop(grace=1.0)
+        if self._http is not None:
+            self._http.shutdown()
+            self._http.server_close()   # release the listening fd now
         with self._lock:
             for c in self._conns.values():
                 c.close()

@@ -61,8 +61,6 @@ class HTTPForwardClient:
     def send_metrics(self, metrics: List, timeout: float = 10.0,
                      parent_span=None) -> None:
         import json
-        import urllib.request
-        import zlib
 
         if self.json_body:
             from veneur_tpu.forward.jsonmetric import to_json_metrics
@@ -71,6 +69,22 @@ class HTTPForwardClient:
         else:
             body = fpb.MetricList(metrics=metrics).SerializeToString()
             ctype = "application/x-protobuf"
+        self._post(body, ctype, timeout, parent_span)
+
+    def send_json(self, json_metrics: List[dict],
+                  timeout: float = 10.0) -> None:
+        """POST an already-formed JSONMetric array unchanged — the proxy
+        re-routing path (proxy.go:622 doPost forwards the incoming
+        samplers.JSONMetric values verbatim)."""
+        import json
+        self._post(json.dumps(json_metrics).encode(), "application/json",
+                   timeout)
+
+    def _post(self, body: bytes, ctype: str, timeout: float,
+              parent_span=None) -> None:
+        import urllib.request
+        import zlib
+
         headers = {"Content-Type": ctype, "Content-Encoding": "deflate"}
         if parent_span is not None:
             # propagate the caller's flush trace like the reference's

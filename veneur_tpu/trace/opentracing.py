@@ -90,10 +90,15 @@ class SpanContext:
 
     @classmethod
     def from_span(cls, span: Span) -> "SpanContext":
-        return cls({"traceid": str(span.trace_id),
-                    "spanid": str(span.id),
-                    "parentid": str(span.parent_id),
-                    RESOURCE_KEY: span.tags.get(RESOURCE_KEY, "")})
+        bag = {"traceid": str(span.trace_id),
+               "spanid": str(span.id),
+               "parentid": str(span.parent_id),
+               RESOURCE_KEY: span.tags.get(RESOURCE_KEY, "")}
+        # span-level baggage rides into the context (opentracing.go:265
+        # contextAsParent + :324 SetBaggageItem); identity keys win
+        for k, v in getattr(span, "baggage", {}).items():
+            bag.setdefault(k, v)
+        return cls(bag)
 
 
 def span_context(span: Span) -> SpanContext:
@@ -104,6 +109,49 @@ def span_context(span: Span) -> SpanContext:
 class OpenTracingTracer(Tracer):
     """Tracer + carrier inject/extract. Subclasses the core tracer so
     the server's existing start_span surface is unchanged."""
+
+    def start_span_ot(self, operation_name: str = "", *, child_of=None,
+                      follows_from=None, tags: Optional[Dict] = None,
+                      start_time_ns: Optional[int] = None) -> Span:
+        """The reference's opentracing StartSpan (opentracing.go:403):
+
+        - no reference -> a new root trace;
+        - child_of / follows_from (a Span or SpanContext) -> a child of
+          the referenced context. FollowsFrom is treated IDENTICALLY to
+          ChildOf, as the reference does ("Datadog treats children and
+          follow-children the same way", opentracing.go:430);
+        - a `name` tag overrides the operation name (:466);
+        - an empty name falls back to the caller's function name (:473
+          runtime.Caller), so bare spans remain attributable;
+        - start_time_ns overrides the span clock (customSpanStart).
+        """
+        ref = child_of if child_of is not None else follows_from
+        if isinstance(ref, Span):
+            ref = SpanContext.from_span(ref)
+        if ref is not None:
+            span = Span(operation_name, service=self.service,
+                        trace_id=ref.trace_id or None,
+                        parent_id=ref.span_id or None)
+            if ref.resource:
+                span.set_tag(RESOURCE_KEY, ref.resource)
+            # parent baggage propagates to the child's context
+            for k, v in ref.baggage.items():
+                if k.lower() not in ("traceid", "spanid", "parentid",
+                                     RESOURCE_KEY):
+                    span.set_baggage_item(k, v)
+        else:
+            span = Span(operation_name, service=self.service)
+        for k, v in (tags or {}).items():
+            span.set_tag(k, v)
+            if k == "name":
+                span.name = str(v)
+        if not span.name:
+            import sys as _sys
+            frame = _sys._getframe(1)
+            span.name = frame.f_code.co_name
+        if start_time_ns is not None:
+            span.start_ns = start_time_ns
+        return span
 
     # -- carriers ------------------------------------------------------------
     def inject(self, ctx, carrier: Dict[str, str],

@@ -40,6 +40,7 @@ class Span:
         self.samples = []
         self.log_lines = []   # LogFields/LogKV records (stored, unsent —
         #                       matching opentracing.go:312 "ignored")
+        self.baggage: Dict[str, str] = {}
 
     def set_tag(self, k: str, v) -> "Span":
         self.tags[k] = v if isinstance(v, str) else repr(v)
@@ -57,6 +58,38 @@ class Span:
     def log_kv(self, *alternating) -> None:
         self.log_fields(**{str(alternating[i]): alternating[i + 1]
                            for i in range(0, len(alternating) - 1, 2)})
+
+    def log_event(self, event: str) -> None:
+        """Deprecated OpenTracing API — interface-compat no-op, exactly
+        like the reference (opentracing.go:341 LogEvent)."""
+
+    def log_event_with_payload(self, event: str, payload) -> None:
+        """Deprecated no-op (opentracing.go:346)."""
+
+    def log(self, data) -> None:
+        """Deprecated no-op (opentracing.go:351)."""
+
+    def set_baggage_item(self, key: str, value: str) -> "Span":
+        """Span-level baggage, carried into context()/child contexts
+        (opentracing.go:324 SetBaggageItem)."""
+        self.baggage[key] = value
+        return self
+
+    def baggage_item(self, key: str) -> str:
+        kl = key.lower()
+        for k, v in self.baggage.items():
+            if k.lower() == kl:
+                return v
+        return ""
+
+    def finish_with_options(self, finish_time_ns: Optional[int] = None,
+                            log_records=None) -> ssf_pb2.SSFSpan:
+        """FinishWithOptions (opentracing.go:236): explicit finish time;
+        log records are retained with the span's log lines but — like
+        the reference — never transmitted (BulkLogData deprecated)."""
+        if log_records:
+            self.log_lines.extend(log_records)
+        return self.finish(finish_time_ns)
 
     def context(self):
         from veneur_tpu.trace.opentracing import SpanContext
