@@ -674,7 +674,8 @@ def parse_last_json_line(stdout: str):
             try:
                 return json.loads(line)
             except ValueError:
-                return None  # truncated tail from a killed child
+                continue  # truncated tail from a killed child: the line
+                #           above may be a complete earlier checkpoint
     return None
 
 
@@ -761,7 +762,8 @@ def _run_config_subprocess(n, scale, force_cpu=False):
             f"rc={proc.returncode}: {proc.stderr.strip()[-400:]}"}
 
 
-def main(configs=None, scale=None, in_process=False, force_cpu=False):
+def main(configs=None, scale=None, in_process=False, force_cpu=False,
+         on_result=None):
     if in_process:
         # only the in-process (child) path may touch the backend; the
         # subprocess orchestrator must stay off the chip entirely
@@ -780,6 +782,8 @@ def main(configs=None, scale=None, in_process=False, force_cpu=False):
         else:
             results.append(_run_config_subprocess(n, scale,
                                                   force_cpu=force_cpu))
+        if on_result is not None:
+            on_result(results)   # caller checkpoints partial artifacts
     return results
 
 
