@@ -198,3 +198,32 @@ def test_parse_metric_ssf_scopes_and_set():
                            status=ssf_pb2.SSFSample.WARNING)
     m2 = parse_metric_ssf(s2)
     assert m2.value == int(ssf_pb2.SSFSample.WARNING)
+
+
+def test_key_cache_parity_and_bound(monkeypatch):
+    """The key-info cache must change throughput only: identical fields
+    cold vs warm, magic-tag scopes preserved, and a full cache clears
+    instead of growing."""
+    from veneur_tpu.samplers import parser as p
+
+    def snap(m):
+        return (m.name, m.type, m.value, m.digest, m.sample_rate, m.tags,
+                m.joined_tags, m.scope)
+
+    lines = [b"a.b:1|c|#z:1,a:2", b"a.b:2|c|#z:1,a:2", b"a.b:1|c",
+             b"x:3|ms|@0.5|#veneurlocalonly,k:v",
+             b"y:4|g|#veneurglobalonly"]
+    p._KEY_CACHE.clear()
+    cold = [snap(p.parse_metric(ln)) for ln in lines]
+    warm = [snap(p.parse_metric(ln)) for ln in lines]
+    assert cold == warm
+    # same key, different values share digest/tags; scopes survive caching
+    assert cold[0][3] == cold[1][3]
+    assert cold[3][7] == p.LOCAL_ONLY and cold[4][7] == p.GLOBAL_ONLY
+
+    monkeypatch.setattr(p, "_KEY_CACHE_MAX", 8)
+    p._KEY_CACHE.clear()
+    outs = [snap(p.parse_metric(b"n%d:1|c" % i)) for i in range(50)]
+    assert len(p._KEY_CACHE) <= 8
+    assert len({o[3] for o in outs}) == 50   # digests still per-key
+    p._KEY_CACHE.clear()

@@ -1036,7 +1036,13 @@ class Server:
         samples = [ssf_samples.timing("veneur.flush.total_duration_ns",
                                       flush_seconds),
                    ssf_samples.gauge("veneur.flush.metrics_total",
-                                     n_flushed)]
+                                     n_flushed),
+                   # 0 = pure-Python parse fallback (the .so failed to
+                   # build): ~40x slower per thread than the C++ engine.
+                   # A silent log-line was the only signal before; now
+                   # operators can alert on the gauge.
+                   ssf_samples.gauge("veneur.parse.native_engine",
+                                     1.0 if self._native else 0.0)]
         if self._unique_ts is not None:
             samples.append(ssf_samples.count(
                 "veneur.flush.unique_timeseries_total", self._unique_ts,
