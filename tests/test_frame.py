@@ -111,3 +111,48 @@ def test_frame_server_integration():
         assert got["fr.s"] == pytest.approx(1.0, abs=0.2)
     finally:
         srv.shutdown()
+
+
+def test_datadog_frame_flush_matches_object_flush():
+    """The datadog sink's columnar path must emit the same DDMetric series
+    as its object path across routing, prefix drops, per-prefix tag
+    excludes, rate conversion, and hostname fallbacks."""
+    from veneur_tpu.sinks.datadog import DatadogMetricSink
+
+    table, flush = _mk_table_and_flush()
+    kw = dict(percentiles=[0.5, 0.99], aggregates=["min", "max", "count"],
+              is_local=False, timestamp=777, hostname="host-y")
+    objs = generate_intermetrics(flush, table, **kw)
+    for kind in ("counter", "gauge", "status", "set", "histogram"):
+        for _s, m in table.get_meta(kind):
+            m._emit_prep = None
+    frame = generate_frame(flush, table, **kw)
+
+    def mk_sink():
+        s = DatadogMetricSink(
+            api_key="k", hostname="dd-host", api_url="http://x",
+            interval_s=10.0,
+            metric_name_prefix_drops=["g1"],
+            exclude_tags_prefix_by_prefix_metric={"h": ["az"]})
+        s.set_excluded_tags(["k"])
+        captured = []
+        s._post_series = captured.extend
+        return s, captured
+
+    s1, got_obj = mk_sink()
+    s1.flush(objs)
+    s2, got_frame = mk_sink()
+    s2.flush_frame(frame)
+
+    def key(dd):
+        return (dd["metric"], tuple(sorted(dd["tags"])), dd["type"],
+                dd.get("interval"), tuple(map(tuple, dd["points"])),
+                dd["host"])
+
+    assert len(got_obj) == len(got_frame) > 0
+    assert sorted(map(key, got_obj)) == sorted(map(key, got_frame))
+    # rate conversion actually happened for counters
+    assert any(dd["type"] == "rate" and dd.get("interval") == 10
+               for dd in got_frame)
+    # dropped prefix really dropped
+    assert not any(dd["metric"].startswith("g1") for dd in got_frame)

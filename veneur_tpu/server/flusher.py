@@ -117,28 +117,30 @@ class MetricFrame:
     def __len__(self):
         return sum(len(s.names) for s in self.segments)
 
-    def intermetrics(self) -> List[InterMetric]:
-        out: List[InterMetric] = []
-        app = out.append
-        ts = self.timestamp
+    def rows(self):
+        """Yield prepared (name, value, mtype, message, tags, sinks,
+        hostname) tuples — THE consumption surface for accepts_frames
+        sinks, so per-key prep (tag copy, sink routing, hostname
+        fallback) stays inside this module and every sink shares one
+        loop instead of reaching into SlotMeta internals."""
         hostname = self.hostname
         for seg in self.segments:
             vals = seg.values.tolist()
             mtype = seg.mtype
             metas = seg.metas
-            if seg.is_status:
-                for i, name in enumerate(seg.names):
-                    m = metas[i]
-                    p = m._emit_prep or _prep(m, hostname)
-                    app(InterMetric(name, ts, vals[i], p[0], mtype,
-                                    m.message, p[2], p[1]))
-            else:
-                for i, name in enumerate(seg.names):
-                    m = metas[i]
-                    p = m._emit_prep or _prep(m, hostname)
-                    app(InterMetric(name, ts, vals[i], p[0], mtype, "",
-                                    p[2], p[1]))
-        return out
+            is_status = seg.is_status
+            for i, name in enumerate(seg.names):
+                m = metas[i]
+                p = m._emit_prep or _prep(m, hostname)
+                yield (name, vals[i], mtype,
+                       m.message if is_status else "", p[0], p[1], p[2])
+
+    def intermetrics(self) -> List[InterMetric]:
+        ts = self.timestamp
+        return [InterMetric(name, ts, value, tags, mtype, message,
+                            host, sinks)
+                for name, value, mtype, message, tags, sinks, host
+                in self.rows()]
 
 
 def _simple_segment(metas, vals, mtype, is_local, *, skip_scope=None,

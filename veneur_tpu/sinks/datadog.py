@@ -41,37 +41,63 @@ class DatadogMetricSink(MetricSink):
             exclude_tags_prefix_by_prefix_metric or {})
 
     # -- serialization ------------------------------------------------------
-    def _dd_metric(self, m: InterMetric):
-        """InterMetric -> DDMetric dict (reference datadog.go:200-254
-        finalizeMetrics/ddMetricFromInterMetric)."""
-        tags = self.strip_excluded(m.tags)
+    def _dd_from(self, name, ts, value, mtype, tags, host):
+        """DDMetric dict (reference datadog.go:200-254 finalizeMetrics/
+        ddMetricFromInterMetric) — the ONE serialization both the object
+        and frame paths share."""
+        tags = self.strip_excluded(tags)
         for prefix, excludes in self.prefix_tag_excludes.items():
-            if m.name.startswith(prefix):
+            if name.startswith(prefix):
                 tags = [t for t in tags
                         if not any(t == e or t.startswith(e + ":")
                                    for e in excludes)]
-        host = m.hostname or self.hostname
         dd = {
-            "metric": m.name,
+            "metric": name,
             "type": "gauge",
-            "points": [[m.timestamp, m.value]],
-            "host": host,
+            "points": [[ts, value]],
+            "host": host or self.hostname,
             "tags": tags + self.tags,
         }
-        if m.type == COUNTER:
+        if mtype == COUNTER:
             # Datadog rates: value divided by the flush interval, with the
             # interval attached so count rollups reconstruct the original
             # (reference datadog.go:375 Interval)
             dd["type"] = "rate"
-            dd["points"] = [[m.timestamp, m.value / self.interval_s]]
+            dd["points"] = [[ts, value / self.interval_s]]
             dd["interval"] = int(self.interval_s)
         return dd
+
+    def _dd_metric(self, m: InterMetric):
+        return self._dd_from(m.name, m.timestamp, m.value, m.type,
+                             m.tags, m.hostname)
 
     # -- flush --------------------------------------------------------------
     def flush(self, metrics):
         metrics = filter_acceptable(metrics, self.name)
         series = [self._dd_metric(m) for m in metrics
                   if not any(m.name.startswith(p) for p in self.prefix_drops)]
+        self._post_series(series)
+
+    accepts_frames = True
+
+    def flush_frame(self, frame):
+        """Columnar flush: DDMetric dicts straight from the frame's
+        prepared rows — no InterMetric materialization between the
+        flusher and the JSON body (the per-object detour is ~2us/metric
+        at the 10M-key scale; see flusher.MetricFrame). Same emission
+        rules as flush(): sink routing, prefix drops, and _dd_from's
+        shared serialization."""
+        drops = self.prefix_drops
+        ts = frame.timestamp
+        series = [
+            self._dd_from(name, ts, value, mtype, tags, host)
+            for name, value, mtype, _msg, tags, sinks, host
+            in frame.rows()
+            if not (drops and any(name.startswith(p) for p in drops))
+            and (sinks is None or self.name in sinks)]
+        self._post_series(series)
+
+    def _post_series(self, series):
         if not series:
             return
         chunks = [series[i:i + self.flush_max_per_body]
