@@ -378,6 +378,12 @@ def main(argv=None):
     ap.add_argument("--seconds", type=float, default=1.0,
                     help="time budget per micro")
     args = ap.parse_args(argv)
+    # honor a JAX_PLATFORMS=cpu request at the CONFIG level before any
+    # device micro touches jax: the tunnel plugin force-selects its
+    # platform, and a down tunnel would hang the first jax.devices()
+    # (the e2e/bench children pin the same way)
+    from benchmarks.e2e import pin_platform
+    pin_platform()
     results = []
     for name in (args.only or sorted(MICROS)):
         out = MICROS[name](args.seconds)

@@ -156,3 +156,53 @@ def test_datadog_frame_flush_matches_object_flush():
                for dd in got_frame)
     # dropped prefix really dropped
     assert not any(dd["metric"].startswith("g1") for dd in got_frame)
+
+
+def test_signalfx_frame_flush_matches_object_flush():
+    """SignalFx columnar path parity: routing, vary-by token fan-out, tag
+    prefix drops, counter-vs-gauge kind split, hostname dimension."""
+    from veneur_tpu.sinks.signalfx import SignalFxMetricSink
+
+    table, flush = _mk_table_and_flush()
+    kw = dict(percentiles=[0.5, 0.99], aggregates=["min", "max", "count"],
+              is_local=False, timestamp=42, hostname="host-z")
+    objs = generate_intermetrics(flush, table, **kw)
+    for kind in ("counter", "gauge", "status", "set", "histogram"):
+        for _s, m in table.get_meta(kind):
+            m._emit_prep = None
+    frame = generate_frame(flush, table, **kw)
+
+    def mk_sink():
+        s = SignalFxMetricSink(
+            api_key="default-key", endpoint="http://x", hostname="sfx",
+            vary_key_by="k", per_tag_api_keys={"1": "key-one"},
+            metric_name_prefix_drops=["g2"],
+            metric_tag_prefix_drops=["az"])
+        posted = []
+        s._post = lambda token, body: posted.append((token, body))
+        return s, posted
+
+    s1, got_obj = mk_sink()
+    s1.flush(objs)
+    s2, got_frame = mk_sink()
+    s2.flush_frame(frame)
+
+    def norm(posted):
+        out = []
+        for token, body in posted:
+            for kind in ("counter", "gauge"):
+                for dp in body[kind]:
+                    out.append((token, kind, dp["metric"], dp["value"],
+                                dp["timestamp"],
+                                tuple(sorted(dp["dimensions"].items()))))
+        return sorted(out)
+
+    a, b = norm(got_obj), norm(got_frame)
+    assert a == b and len(a) > 0
+    # vary-by fan-out really split tokens; counters landed in the counter lane
+    assert {t for t, *_ in a} == {"default-key", "key-one"}
+    assert any(kind == "counter" for _t, kind, *_ in a)
+    # tag prefix drop removed az dims, name prefix drop removed g2
+    assert not any(any(k == "az" for k, _v in dims)
+                   for *_x, dims in a)
+    assert not any(name.startswith("g2") for _t, _k, name, *_y in a)

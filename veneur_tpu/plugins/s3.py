@@ -48,3 +48,9 @@ class S3Plugin:
                                        self.interval_s, compress=True)
         self.client.put_object(Bucket=self.bucket,
                                Key=self.s3_path(ts), Body=body)
+
+    # see LocalFilePlugin: materialize, but don't veto the frame path
+    accepts_frames = True
+
+    def flush_frame(self, frame):
+        self.flush(frame.intermetrics())

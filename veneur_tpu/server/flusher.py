@@ -113,6 +113,13 @@ class MetricFrame:
     timestamp: int
     hostname: str
     segments: List[FrameSegment]
+    # memoized intermetrics(): several materializing consumers (plugins,
+    # object-only sinks via the base-class default) may share one frame —
+    # each rebuilding ~per-metric objects would multiply the exact cost
+    # the frame exists to avoid. Benign race: concurrent builders produce
+    # equivalent lists, last write wins.
+    _materialized: object = dataclasses.field(
+        default=None, repr=False, compare=False)
 
     def __len__(self):
         return sum(len(s.names) for s in self.segments)
@@ -136,11 +143,14 @@ class MetricFrame:
                        m.message if is_status else "", p[0], p[1], p[2])
 
     def intermetrics(self) -> List[InterMetric]:
-        ts = self.timestamp
-        return [InterMetric(name, ts, value, tags, mtype, message,
+        if self._materialized is None:
+            ts = self.timestamp
+            self._materialized = [
+                InterMetric(name, ts, value, tags, mtype, message,
                             host, sinks)
                 for name, value, mtype, message, tags, sinks, host
                 in self.rows()]
+        return self._materialized
 
 
 def _simple_segment(metas, vals, mtype, is_local, *, skip_scope=None,

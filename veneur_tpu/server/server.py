@@ -930,9 +930,11 @@ class Server:
         # needs object lists, skip per-metric InterMetric construction
         # entirely (~20s of host time per interval at the 10M-key north
         # star; see flusher.MetricFrame)
-        if (self.metric_sinks and not self.plugins
+        if (self.metric_sinks
                 and all(getattr(s, "accepts_frames", False)
-                        for s in self.metric_sinks)):
+                        for s in self.metric_sinks)
+                and all(getattr(p, "accepts_frames", False)
+                        for p in self.plugins)):
             from veneur_tpu.server.flusher import generate_frame
             generate = generate_frame
         else:
@@ -990,9 +992,14 @@ class Server:
             sinks_span.client_finish(self.trace_client)
             # plugins run post-flush (flusher.go:117-131)
             psp = stage("plugins") if self.plugins else None
+            from veneur_tpu.server.flusher import MetricFrame
+            is_frame = isinstance(final, MetricFrame)
             for p in self.plugins:
                 try:
-                    p.flush(final)
+                    if is_frame:
+                        p.flush_frame(final)
+                    else:
+                        p.flush(final)
                 except Exception as e:
                     psp.error = True
                     log.warning("plugin %s flush failed: %s", p.name, e)

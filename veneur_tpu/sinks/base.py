@@ -16,11 +16,20 @@ from veneur_tpu.samplers.intermetric import InterMetric
 class MetricSink:
     name: str = "sink"
 
+    # Every sink can take a columnar flusher.MetricFrame: the default
+    # materializes (memoized on the frame, so N object-path sinks share
+    # ONE InterMetric list); high-volume sinks override flush_frame to
+    # consume frame.rows() directly and skip materialization.
+    accepts_frames = True
+
     def start(self) -> None:
         pass
 
     def flush(self, metrics: List[InterMetric]) -> None:
         raise NotImplementedError
+
+    def flush_frame(self, frame) -> None:
+        self.flush(frame.intermetrics())
 
     def flush_other_samples(self, samples: Iterable) -> None:
         """DogStatsD events / service checks as SSF samples

@@ -6,7 +6,6 @@ from veneur_tpu.sinks.base import MetricSink, SpanSink
 
 class BlackholeMetricSink(MetricSink):
     name = "blackhole"
-    accepts_frames = True
 
     def __init__(self):
         self.frames_rows = 0  # benchmark introspection

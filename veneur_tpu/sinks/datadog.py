@@ -78,8 +78,6 @@ class DatadogMetricSink(MetricSink):
                   if not any(m.name.startswith(p) for p in self.prefix_drops)]
         self._post_series(series)
 
-    accepts_frames = True
-
     def flush_frame(self, frame):
         """Columnar flush: DDMetric dicts straight from the frame's
         prepared rows — no InterMetric materialization between the

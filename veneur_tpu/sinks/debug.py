@@ -12,15 +12,12 @@ log = logging.getLogger("veneur_tpu.sinks.debug")
 
 class DebugMetricSink(MetricSink):
     name = "debug"
-    accepts_frames = True
 
     def __init__(self):
         self.flushed = []  # kept for tests/introspection, like channel sinks
 
-    def flush_frame(self, frame):
-        """Materialize the columnar frame — debug keeps full objects for
-        introspection, so it pays the conversion the frame path saves."""
-        self.flush(frame.intermetrics())
+    # frame flushes use the base default: materialize (memoized) — debug
+    # keeps full objects for introspection by design
 
     def flush(self, metrics):
         metrics = filter_acceptable(metrics, self.name)

@@ -53,3 +53,11 @@ class LocalFilePlugin:
                                        self.interval_s, self.delimiter)
         with open(self.path, "ab") as f:
             f.write(data)
+
+    # Plugins are file-bound and low-volume tiers: materializing is fine,
+    # but declaring frame support keeps the server's columnar fast path
+    # available when this plugin is configured alongside frame sinks.
+    accepts_frames = True
+
+    def flush_frame(self, frame):
+        self.flush(frame.intermetrics())

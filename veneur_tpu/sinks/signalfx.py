@@ -42,21 +42,26 @@ class SignalFxMetricSink(MetricSink):
         self.tag_prefix_drops = list(metric_tag_prefix_drops)
         self.common_tags = list(tags)
 
-    def _datapoint(self, m: InterMetric):
-        dims = {self.hostname_tag: m.hostname or self.hostname}
-        for t in self.strip_excluded(m.tags) + self.common_tags:
+    def _datapoint_from(self, name, ts, value, tags, host):
+        """The ONE datapoint serialization both flush paths share."""
+        dims = {self.hostname_tag: host or self.hostname}
+        for t in self.strip_excluded(tags) + self.common_tags:
             if any(t.startswith(p) for p in self.tag_prefix_drops):
                 continue
             k, _, v = t.partition(":")
             dims[k] = v
-        return {"metric": m.name, "value": m.value,
-                "timestamp": int(m.timestamp * 1000), "dimensions": dims}
+        return {"metric": name, "value": value,
+                "timestamp": int(ts * 1000), "dimensions": dims}
 
-    def _token_for(self, m: InterMetric) -> str:
+    def _datapoint(self, m: InterMetric):
+        return self._datapoint_from(m.name, m.timestamp, m.value, m.tags,
+                                    m.hostname)
+
+    def _token_for(self, tags) -> str:
         """vary-by token selection (signalfx.go client fan-out)."""
         if self.vary_key_by:
             prefix = self.vary_key_by + ":"
-            for t in m.tags:
+            for t in tags:
                 if t.startswith(prefix):
                     return self.per_tag_api_keys.get(t[len(prefix):],
                                                      self.api_key)
@@ -64,14 +69,30 @@ class SignalFxMetricSink(MetricSink):
 
     def flush(self, metrics):
         metrics = filter_acceptable(metrics, self.name)
+        self._flush_rows(
+            (m.name, m.timestamp, m.value, m.type, m.tags, m.hostname)
+            for m in metrics)
+
+    def flush_frame(self, frame):
+        """Columnar flush via frame.rows() — identical emission rules,
+        no InterMetric materialization (see flusher.MetricFrame)."""
+        ts = frame.timestamp
+        self._flush_rows(
+            (name, ts, value, mtype, tags, host)
+            for name, value, mtype, _msg, tags, sinks, host
+            in frame.rows()
+            if sinks is None or self.name in sinks)
+
+    def _flush_rows(self, rows):
         by_token: Dict[str, Dict[str, list]] = {}
-        for m in metrics:
-            if any(m.name.startswith(p) for p in self.prefix_drops):
+        for name, ts, value, mtype, tags, host in rows:
+            if any(name.startswith(p) for p in self.prefix_drops):
                 continue
-            kind = "counter" if m.type == COUNTER else "gauge"
-            body = by_token.setdefault(self._token_for(m),
+            kind = "counter" if mtype == COUNTER else "gauge"
+            body = by_token.setdefault(self._token_for(tags),
                                        {"counter": [], "gauge": []})
-            body[kind].append(self._datapoint(m))
+            body[kind].append(self._datapoint_from(name, ts, value, tags,
+                                                   host))
         for token, body in by_token.items():
             # chunk across BOTH kinds so one POST never exceeds
             # flush_max_per_body total points
