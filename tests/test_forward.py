@@ -282,3 +282,24 @@ def test_consul_discoverer_reference_fixtures():
     responses["next"] = "health_service_zero"
     p.refresh()
     assert p._ring.get(b"anything") is not None  # last good kept
+
+
+def test_import_nil_value_errors_and_is_counted():
+    """reference worker_test.go:327: importing a metric with no value set
+    must fail (and the server counts it), not silently no-op."""
+    import pytest
+    from veneur_tpu.aggregation.host import BatchSpec
+    from veneur_tpu.aggregation.state import TableSpec
+    from veneur_tpu.forward.convert import import_into
+    from veneur_tpu.proto import metricpb_pb2 as mpb
+    from veneur_tpu.server.aggregator import Aggregator
+
+    agg = Aggregator(TableSpec(counter_capacity=16, gauge_capacity=16,
+                               status_capacity=4, set_capacity=4,
+                               histo_capacity=16),
+                     BatchSpec(counter=32, gauge=16, status=4, set=8,
+                               histo=32))
+    bad = mpb.Metric(name="test", type=mpb.Histogram)  # no value oneof
+    with pytest.raises(ValueError):
+        import_into(agg, bad)
+    assert agg.processed == 0

@@ -166,3 +166,52 @@ def test_reuseport_reader_group_shares_one_port():
         assert n_udp == 4
     finally:
         srv.shutdown()
+
+
+def test_udp_toolong_datagram_dropped_and_counted():
+    """reference server_test.go:817 TestIgnoreLongUDPMetrics: a datagram
+    longer than metric_max_length is dropped WHOLE and counted, on both
+    the Python reader and (when built) the native reader group."""
+    import socket as socket_mod
+    import time
+
+    from veneur_tpu import native
+    from veneur_tpu.config import Config
+    from veneur_tpu.server.server import Server
+    from veneur_tpu.sinks.debug import DebugMetricSink
+
+    for native_ingest in ([False, True] if native.available()
+                          else [False]):
+        sink = DebugMetricSink()
+        srv = Server(Config(interval="600s", metric_max_length=31,
+                            native_ingest=native_ingest,
+                            statsd_listen_addresses=["udp://127.0.0.1:0"]),
+                     metric_sinks=[sink])
+        srv.start()
+        try:
+            s = socket_mod.socket(socket_mod.AF_INET,
+                                  socket_mod.SOCK_DGRAM)
+            # 39 bytes > 31: must be ignored entirely
+            s.sendto(b"foo.bar:1|c|#baz:gorch,long:tag,is:long",
+                     srv.local_addr(0))
+            # EXACTLY limit+1 (32 bytes): the boundary MSG_TRUNC alone
+            # would miss — both paths must drop it too
+            over = b"foo.baz:1|c|#aa:" + b"b" * 16
+            assert len(over) == 32
+            s.sendto(over, srv.local_addr(0))
+            # exactly at the limit (31 bytes): must pass
+            at = b"at.limit:1|c|#aaaaaa:" + b"b" * 10
+            assert len(at) == 31
+            s.sendto(at, srv.local_addr(0))
+            s.sendto(b"ok:1|c", srv.local_addr(0))   # under the limit
+            deadline = time.time() + 15
+            while time.time() < deadline and srv.aggregator.processed < 2:
+                time.sleep(0.05)
+            time.sleep(0.2)   # give the long packets time to (not) land
+            assert srv.aggregator.processed == 2, native_ingest
+            deadline = time.time() + 10
+            while time.time() < deadline and srv.packets_toolong < 2:
+                time.sleep(0.05)
+            assert srv.packets_toolong == 2, native_ingest
+        finally:
+            srv.shutdown()

@@ -238,3 +238,35 @@ def test_tagfreq_ingest_many_atomic_on_update_failure():
     assert sink.spans_seen == 8
     counts = dict(sink.hh.top(4))
     assert counts[b"customer:c0"] == 4.0 and counts[b"customer:c1"] == 4.0
+
+
+def test_indicator_objective_tag_override_and_empty_names():
+    """reference parser_test.go:295 TestParseSSFIndicatorObjectiveTag
+    (ssf_objective tag overrides the span name in the objective tag) and
+    :338 (no timer names configured -> no metrics)."""
+    from veneur_tpu.proto import ssf_pb2
+    from veneur_tpu.protocol.wire import parse_ssf
+    from veneur_tpu.samplers.parser import convert_indicator_metrics
+
+    span = ssf_pb2.SSFSpan(version=0, id=1, trace_id=5, name="foo",
+                           service="bar-srv", indicator=True,
+                           start_timestamp=int(1e9),
+                           end_timestamp=int(6e9))
+    span.tags["ssf_objective"] = "bar"
+    span.tags["this-tag"] = "ignored"
+    parsed = parse_ssf(span.SerializeToString())
+
+    ms = convert_indicator_metrics(parsed, "", "timer_name")
+    assert len(ms) == 1
+    m = ms[0]
+    # SSF timings parse as histograms, exactly as the reference test
+    # asserts (parser_test.go:283 `assert.Equal(t, "histogram", m.Type)`)
+    assert m.name == "timer_name" and m.type == "histogram"
+    assert "objective:bar" in m.tags          # tag wins over span name
+    assert "service:bar-srv" in m.tags and "error:false" in m.tags
+
+    del parsed.tags["ssf_objective"]
+    ms = convert_indicator_metrics(parsed, "", "timer_name")
+    assert "objective:foo" in ms[0].tags      # default: the span name
+
+    assert convert_indicator_metrics(parsed, "", "") == []

@@ -129,6 +129,10 @@ def import_into(aggregator, metric: mpb.Metric) -> None:
             "min": td.min, "max": td.max, "recip": td.reciprocalSum,
         }
     else:
-        return
+        # the reference ERRORS on a nil value (worker.go:441
+        # ImportMetricGRPC; worker_test.go:327) so the import server
+        # counts it — a silent return would hide malformed peers
+        raise ValueError(
+            f"metric {metric.name!r} has no value field set")
     aggregator.import_metric(kind, metric.name, tags, scope, digest,
                              payload)

@@ -261,11 +261,12 @@ class NativeIngest:
         """Live reader-group counters, callable from any thread."""
         r = getattr(self, "_readers", None)
         if not r:
-            return {"datagrams": 0, "ring_dropped": 0, "ring_depth": 0}
-        out = (ctypes.c_uint64 * 3)()
+            return {"datagrams": 0, "ring_dropped": 0, "ring_depth": 0,
+                    "toolong": 0}
+        out = (ctypes.c_uint64 * 4)()
         _lib.vr_counters(r, out)
         return {"datagrams": out[0], "ring_dropped": out[1],
-                "ring_depth": out[2]}
+                "ring_depth": out[2], "toolong": out[3]}
 
     def readers_stop(self) -> None:
         r = getattr(self, "_readers", None)

@@ -614,6 +614,7 @@ struct ReaderGroup {
   size_t ring_cap = 0;
   uint64_t ring_dropped = 0;      // guarded by mu
   uint64_t datagrams = 0;         // guarded by mu
+  uint64_t toolong = 0;           // guarded by mu; MSG_TRUNC drops
   // unconsumed remainder of a datagram whose parse hit a full lane
   std::string tail;
   size_t tail_off = 0;
@@ -651,6 +652,20 @@ void reader_main(ReaderGroup* g, int fd, int max_len) {
       std::lock_guard<std::mutex> lk(g->mu);
       for (int i = 0; i < n; i++) {
         g->datagrams++;
+        // buffers are sized metric_max_length+1: a datagram the kernel
+        // truncated (MSG_TRUNC) exceeded the configured limit — drop
+        // the whole packet and count it, like the reference's
+        // processMetricPacket "toolong" guard (server.go:1082)
+        // MSG_TRUNC only fires when the datagram EXCEEDS the buffer; a
+        // datagram of exactly max_len (= limit+1) bytes fits, so the
+        // length check catches the boundary case the flag misses —
+        // keeping this path byte-identical to the Python reader's
+        // `len(data) > limit`
+        if ((msgs[i].msg_hdr.msg_flags & MSG_TRUNC) ||
+            msgs[i].msg_len >= (unsigned)max_len) {
+          g->toolong++;
+          continue;
+        }
         if (g->ring.size() >= g->ring_cap) {
           g->ring_dropped++;  // kernel-rcvbuf-overflow analogue, counted
           continue;
@@ -734,13 +749,14 @@ int vr_pump(void* gp, int max_wait_ms, uint64_t* out) {
 }
 
 // Thread-safe counter snapshot (any thread): [0]=datagrams received,
-// [1]=ring_dropped, [2]=ring depth.
+// [1]=ring_dropped, [2]=ring depth, [3]=toolong drops.
 void vr_counters(void* gp, uint64_t* out) {
   auto* g = (ReaderGroup*)gp;
   std::lock_guard<std::mutex> lk(g->mu);
   out[0] = g->datagrams;
   out[1] = g->ring_dropped;
   out[2] = (uint64_t)g->ring.size();
+  out[3] = g->toolong;
 }
 
 void vr_stop(void* gp) {

@@ -233,6 +233,7 @@ class Server:
         self.import_errors = 0
         self._packets_received = 0
         self._packets_dropped_py = 0
+        self._packets_toolong_py = 0
         # orders shutdown's reader-counter fold against concurrent
         # packets_received/packets_dropped reads on the flush thread
         self._reader_fold_lock = threading.Lock()
@@ -379,6 +380,7 @@ class Server:
         stats = {
             "packets_received": self.packets_received,
             "packets_dropped": self.packets_dropped,
+            "packets_toolong": self.packets_toolong,
             "parse_errors": self.parse_errors
             + self.aggregator.extra_parse_errors(),
             "processed": self.aggregator.processed + 0,
@@ -435,7 +437,14 @@ class Server:
         self._unix_locks = []
 
     def _udp_reader(self, sock: socket.socket):
-        bufsize = max(self.cfg.metric_max_length, 65536)
+        # buffer is metric_max_length+1 so an over-limit datagram is
+        # detectable by length and dropped WHOLE with a counter — the
+        # reference's "toolong" guard (server.go:800 pool sizing,
+        # :1082 processMetricPacket). A directly-constructed Config
+        # (tests/embedding) leaves the field 0 — the YAML reader is what
+        # applies the 4096 default — so 0 means the UDP datagram bound.
+        limit = self.cfg.metric_max_length or 65536
+        bufsize = limit + 1
         sock.settimeout(0.5)  # lets readers observe shutdown and release fd
         while not self._shutdown.is_set():
             try:
@@ -445,6 +454,9 @@ class Server:
             except OSError:
                 return
             self._packets_received += 1
+            if len(data) > limit:
+                self._packets_toolong_py += 1
+                continue
             try:
                 self.packet_queue.put(data, timeout=1.0)
             except queue.Full:
@@ -468,6 +480,16 @@ class Server:
             n = self._packets_dropped_py
             if self._native_readers_active:
                 n += self.aggregator.reader_counters()["ring_dropped"]
+        return n
+
+    @property
+    def packets_toolong(self) -> int:
+        """Whole datagrams dropped for exceeding metric_max_length
+        (reference packet.error_total{reason:toolong})."""
+        with self._reader_fold_lock:
+            n = self._packets_toolong_py
+            if self._native_readers_active:
+                n += self.aggregator.reader_counters()["toolong"]
         return n
 
     def _ssf_udp_reader(self, sock: socket.socket):
@@ -723,9 +745,12 @@ class Server:
                 self._threads.append(lt)
 
         if native_reader_fds:
+            # +1 so the kernel flags (MSG_TRUNC) any datagram OVER the
+            # limit; the C++ reader drops it whole and counts toolong —
+            # the same guard as the Python reader / the reference
             self.aggregator.readers_start(
                 native_reader_fds,
-                max_len=max(self.cfg.metric_max_length, 65536))
+                max_len=(self.cfg.metric_max_length or 65536) + 1)
             self._native_readers_active = True
 
         # SSF span listeners (networking.go:198 StartSSF)
@@ -1031,6 +1056,8 @@ class Server:
         cur = {"veneur.packets_received_total": stats["packets_received"],
                "veneur.packets_dropped_total":
                    stats.get("packets_dropped", 0),
+               "veneur.packet.error_toolong_total":
+                   stats.get("packets_toolong", 0),
                "veneur.parse_errors_total": stats["parse_errors"],
                "veneur.worker.metrics_processed_total": stats["processed"],
                "veneur.worker.metrics_dropped_total": stats["dropped"],
@@ -1211,6 +1238,7 @@ class Server:
                 rc = self.aggregator.reader_counters()
                 self._packets_received += rc["datagrams"]
                 self._packets_dropped_py += rc["ring_dropped"]
+                self._packets_toolong_py += rc["toolong"]
             self._native_readers_active = False
         for s in self._sockets:
             try:
