@@ -303,3 +303,51 @@ def test_import_nil_value_errors_and_is_counted():
     with pytest.raises(ValueError):
         import_into(agg, bad)
     assert agg.processed == 0
+
+
+def test_forward_bad_address_never_blocks_local_flush():
+    """reference flusher_test.go:113 TestServerFlushGRPCBadAddress: a
+    local tier whose forward destination is unreachable must still flush
+    local metrics to its sinks, count the forward error, and surface
+    veneur.forward.error_total in self-telemetry."""
+    import time
+
+    from veneur_tpu.config import Config
+    from veneur_tpu.server.server import Server
+    from veneur_tpu.sinks.debug import DebugMetricSink
+
+    sink = DebugMetricSink()
+    srv = Server(Config(interval="600s", percentiles=[0.5],
+                        forward_address="127.0.0.1:1",  # nothing listens
+                        forward_use_grpc=True),
+                 metric_sinks=[sink])
+    srv.start()
+    try:
+        srv.packet_queue.put(b"local.c:7|c")       # mixed counter: local
+        srv.packet_queue.put(b"fwd.t:3|ms")        # mixed timer: forwarded
+        deadline = time.time() + 20
+        while time.time() < deadline and srv.aggregator.processed < 2:
+            time.sleep(0.05)
+        assert srv.trigger_flush(timeout=30)
+        got = {m.name: m.value for m in sink.flushed}
+        assert got.get("local.c") == 7.0           # local flush unharmed
+        deadline = time.time() + 20                # forward is fire+forget
+        while time.time() < deadline and srv.forward_errors < 1:
+            time.sleep(0.05)
+        assert srv.forward_errors >= 1
+        # the async error lands after interval 1's stats snapshot; the
+        # NEXT snapshot reports the delta into the pipeline, and the
+        # flush after whichever interval ingested it delivers to sinks —
+        # flush until it surfaces (bounded), since sample ingestion
+        # races the swap
+        got = {}
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            assert srv.trigger_flush(timeout=30)
+            got = {m.name: m.value for m in sink.flushed}
+            if got.get("veneur.forward.error_total"):
+                break
+            time.sleep(0.2)
+        assert got.get("veneur.forward.error_total", 0) >= 1.0
+    finally:
+        srv.shutdown()

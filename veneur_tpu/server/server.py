@@ -231,6 +231,7 @@ class Server:
         self.sink_flushes_skipped = 0
         self.parse_errors = 0
         self.import_errors = 0
+        self.forward_errors = 0
         self._packets_received = 0
         self._packets_dropped_py = 0
         self._packets_toolong_py = 0
@@ -386,6 +387,7 @@ class Server:
             "processed": self.aggregator.processed + 0,
             "dropped": self.aggregator.dropped_capacity,
             "import_errors": self.import_errors,
+            "forward_errors": self.forward_errors,
             "spans_received": self.span_pipeline.spans_received,
             "intervals_deferred": self.flush_intervals_deferred,
             "sink_flushes_skipped": self.sink_flushes_skipped,
@@ -1062,6 +1064,11 @@ class Server:
                "veneur.worker.metrics_processed_total": stats["processed"],
                "veneur.worker.metrics_dropped_total": stats["dropped"],
                "veneur.import.errors_total": stats["import_errors"],
+               # the reference tags forward.error_total with a cause
+               # (deadline_exceeded/post, flusher.go:512-524); the delta
+               # counter here is untagged — the log line carries the why
+               "veneur.forward.error_total":
+                   stats.get("forward_errors", 0),
                "veneur.flush.intervals_deferred_total":
                    stats["intervals_deferred"],
                "veneur.flush.sink_flushes_skipped_total":
@@ -1167,7 +1174,13 @@ class Server:
                 self._forward_client.send_metrics(
                     metrics, timeout=self.interval, parent_span=span)
         except Exception as e:
-            self.forward_errors = getattr(self, "forward_errors", 0) + 1
+            # concurrent forwards (one aux thread per interval; a slow
+            # failure can overlap the next interval's) make += lossy —
+            # serialize the counter under the existing fold lock
+            with self._reader_fold_lock:
+                self.forward_errors += 1
+            if span is not None:
+                span.error = True
             log.warning("forward failed: %s", e)
 
     def _flush_sink(self, sink, metrics, parent=None):
