@@ -1,0 +1,95 @@
+"""CSV/TSV plugin encoding — byte-compatible with the reference's rows
+(plugins/s3/csv_test.go CSVTestCases), so existing Redshift/S3 loaders
+keep working unchanged."""
+
+import gzip
+import time
+
+from veneur_tpu.samplers.intermetric import InterMetric
+from veneur_tpu.sinks.localfile import (
+    encode_intermetrics_csv, encode_row)
+
+PARTITION_TS = 1476119058.0
+
+
+def _partition():
+    return time.strftime("%Y%m%d", time.gmtime(PARTITION_TS))
+
+
+def _m(name, mtype, tags):
+    return InterMetric(name=name, timestamp=1476119058, value=100.0,
+                       tags=list(tags), type=mtype)
+
+
+def test_basic_gauge_row_matches_reference():
+    """csv_test.go BasicDDMetric: braced tags, gauge passthrough, the
+    Redshift 12-hour timestamp, flush-date partition."""
+    row = encode_intermetrics_csv(
+        [_m("a.b.c.max", "gauge", ["foo:bar", "baz:quz"])],
+        "testbox-c3eac9", 10, partition_ts=PARTITION_TS).decode()
+    assert row == ("a.b.c.max\t{foo:bar,baz:quz}\tgauge\ttestbox-c3eac9"
+                   f"\t10\t2016-10-10 05:04:18\t100\t{_partition()}\n")
+
+
+def test_counter_becomes_rate_divided_by_interval():
+    """csv_test.go MissingDeviceName: counters write type `rate` with the
+    value divided by the flush interval (100/10 -> 10)."""
+    row = encode_intermetrics_csv(
+        [_m("a.b.c.max", "counter", ["foo:bar", "baz:quz"])],
+        "testbox-c3eac9", 10, partition_ts=PARTITION_TS).decode()
+    assert row == ("a.b.c.max\t{foo:bar,baz:quz}\trate\ttestbox-c3eac9"
+                   f"\t10\t2016-10-10 05:04:18\t10\t{_partition()}\n")
+
+
+def test_tab_in_tag_is_quoted():
+    """csv_test.go TabTag: a tab inside a tag quotes the whole field."""
+    row = encode_intermetrics_csv(
+        [_m("a.b.c.count", "counter", ["foo:b\tar", "baz:quz"])],
+        "testbox-c3eac9", 10, partition_ts=PARTITION_TS).decode()
+    assert row == ("a.b.c.count\t\"{foo:b\tar,baz:quz}\"\trate"
+                   "\ttestbox-c3eac9\t10\t2016-10-10 05:04:18\t10"
+                   f"\t{_partition()}\n")
+
+
+def test_status_rows_skipped_not_fatal():
+    """Deliberate deviation from csv.go:72 (which aborts the whole flush
+    on the first unknown type): status rows are skipped and counted."""
+    body = encode_intermetrics_csv(
+        [_m("ok.gauge", "gauge", []), _m("st", "status", []),
+         _m("ok.counter", "counter", [])],
+        "h", 10, partition_ts=PARTITION_TS).decode()
+    lines = body.splitlines()
+    assert len(lines) == 2
+    assert lines[0].startswith("ok.gauge\t")
+    assert lines[1].startswith("ok.counter\t")
+
+
+def test_gzip_compression_roundtrip():
+    body = encode_intermetrics_csv(
+        [_m("z", "gauge", [])], "h", 10, compress=True,
+        partition_ts=PARTITION_TS)
+    assert gzip.decompress(body).decode().startswith("z\t{}")
+
+
+def test_zero_interval_and_nonfinite_values():
+    """A sub-second interval truncated to 0 must not abort the flush
+    (clamped to 1s), and non-finite values use Go's spellings."""
+    rows = encode_intermetrics_csv(
+        [_m("c", "counter", []),
+         InterMetric(name="g.nan", timestamp=1476119058,
+                     value=float("nan"), tags=[], type="gauge"),
+         InterMetric(name="g.inf", timestamp=1476119058,
+                     value=float("inf"), tags=[], type="gauge")],
+        "h", 0, partition_ts=PARTITION_TS).decode().splitlines()
+    assert rows[0].split("\t")[6] == "100"   # 100/1, not a crash
+    assert rows[1].split("\t")[6] == "NaN"
+    assert rows[2].split("\t")[6] == "+Inf"
+
+
+def test_header_row_option():
+    body = encode_intermetrics_csv(
+        [_m("h1", "gauge", [])], "h", 10, partition_ts=PARTITION_TS,
+        headers=True).decode().splitlines()
+    assert body[0] == ("Name\tTags\tMetricType\tVeneurHostname\tInterval"
+                       "\tTimestamp\tValue\tPartition")
+    assert body[1].startswith("h1\t")

@@ -213,3 +213,34 @@ def test_http_proxy_routes_jsonmetrics_across_ring():
         proxy.stop()
         for b in backends:
             b.shutdown()
+
+
+def test_import_gzip_body_is_415():
+    """reference http_test.go:139 TestServerImportGzip: only identity and
+    deflate encodings are accepted on /import; gzip gets 415 with the
+    encoding echoed."""
+    import gzip
+    import json
+    import urllib.error
+    import urllib.request
+
+    srv = Server(small_config(http_address="127.0.0.1:0"),
+                 metric_sinks=[DebugMetricSink()])
+    srv.start()
+    try:
+        body = gzip.compress(json.dumps(
+            [{"name": "x", "type": "counter", "tagstring": "",
+              "tags": [], "value": "AQAAAAAAAAA="}]).encode())
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.http_port}/import", data=body,
+            method="POST",
+            headers={"Content-Type": "application/json",
+                     "Content-Encoding": "gzip"})
+        try:
+            urllib.request.urlopen(req, timeout=10)
+            raise AssertionError("gzip body must be rejected")
+        except urllib.error.HTTPError as e:
+            assert e.code == 415
+            assert b"gzip" in e.read()
+    finally:
+        srv.shutdown()
