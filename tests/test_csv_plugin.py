@@ -6,8 +6,7 @@ import gzip
 import time
 
 from veneur_tpu.samplers.intermetric import InterMetric
-from veneur_tpu.sinks.localfile import (
-    encode_intermetrics_csv, encode_row)
+from veneur_tpu.sinks.localfile import encode_intermetrics_csv
 
 PARTITION_TS = 1476119058.0
 

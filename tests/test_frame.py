@@ -206,3 +206,62 @@ def test_signalfx_frame_flush_matches_object_flush():
     assert not any(any(k == "az" for k, _v in dims)
                    for *_x, dims in a)
     assert not any(name.startswith("g2") for _t, _k, name, *_y in a)
+
+
+def test_datadog_magic_tags_and_service_checks():
+    """reference datadog_test.go:76 TestHostMagicTag / :97
+    TestDeviceMagicTag / :374 TestDatadogFlushServiceCheck: host:/device:
+    tags override fields and are removed; STATUS metrics post to the
+    check_run API, on BOTH flush paths."""
+    from veneur_tpu.samplers.intermetric import InterMetric
+    from veneur_tpu.sinks.datadog import DatadogMetricSink
+
+    metrics = [
+        InterMetric("m.h", 100, 10.0, ["gorch:frobble", "host:abc123",
+                                       "x:e"], "counter"),
+        InterMetric("m.d", 100, 3.0, ["device:dev9", "x:e"], "gauge"),
+        InterMetric("svc.up", 100, 1.0, ["az:a"], "status",
+                    message="degraded", hostname="h-peer"),
+    ]
+
+    def run(flush_fn, arg):
+        s = DatadogMetricSink(api_key="k", hostname="badhostname",
+                              api_url="http://x", interval_s=10.0)
+        series_out, checks_out = [], []
+        s._post_series = series_out.extend
+        s._post_checks = checks_out.extend
+        flush_fn(s, arg)
+        return series_out, checks_out
+
+    # object path
+    series, checks = run(DatadogMetricSink.flush, metrics)
+
+    # frame path: wrap the same rows in segments
+    from veneur_tpu.aggregation.host import SlotMeta
+    from veneur_tpu.server.flusher import FrameSegment, MetricFrame
+    import numpy as np
+
+    def seg(m, is_status=False):
+        meta = SlotMeta(name=m.name, tags=tuple(m.tags), scope=0,
+                        kind=m.type, hostname=m.hostname,
+                        message=m.message)
+        return FrameSegment([m.name], np.asarray([m.value]), m.type,
+                            [meta], is_status)
+
+    frame = MetricFrame(100, "", [seg(metrics[0]), seg(metrics[1]),
+                                  seg(metrics[2], is_status=True)])
+    fseries, fchecks = run(DatadogMetricSink.flush_frame, frame)
+
+    for got_series, got_checks in ((series, checks), (fseries, fchecks)):
+        by_name = {dd["metric"]: dd for dd in got_series}
+        h = by_name["m.h"]
+        assert h["host"] == "abc123"            # magic tag wins
+        assert "host:abc123" not in h["tags"] and "x:e" in h["tags"]
+        d = by_name["m.d"]
+        assert d["device_name"] == "dev9"
+        assert "device:dev9" not in d["tags"]
+        assert "svc.up" not in by_name          # status is not a metric
+        (chk,) = got_checks
+        assert chk == {"check": "svc.up", "status": 1,
+                       "host_name": "h-peer", "timestamp": 100,
+                       "tags": ["az:a"], "message": "degraded"}

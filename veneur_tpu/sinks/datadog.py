@@ -17,7 +17,7 @@ import urllib.request
 import zlib
 from typing import List
 
-from veneur_tpu.samplers.intermetric import COUNTER, InterMetric
+from veneur_tpu.samplers.intermetric import COUNTER, STATUS, InterMetric
 from veneur_tpu.sinks.base import MetricSink, filter_acceptable
 
 log = logging.getLogger("veneur_tpu.sinks.datadog")
@@ -41,22 +41,50 @@ class DatadogMetricSink(MetricSink):
             exclude_tags_prefix_by_prefix_metric or {})
 
     # -- serialization ------------------------------------------------------
-    def _dd_from(self, name, ts, value, mtype, tags, host):
-        """DDMetric dict (reference datadog.go:200-254 finalizeMetrics/
-        ddMetricFromInterMetric) — the ONE serialization both the object
-        and frame paths share."""
-        tags = self.strip_excluded(tags)
+    def _add(self, series, checks, name, ts, value, mtype, tags, host,
+             message):
+        """The ONE serialization both flush paths share (reference
+        datadog.go:256 finalizeMetrics): `host:`/`device:` magic tags
+        override the metric's hostname / set device_name and are removed
+        from the tag list (checked BEFORE tag exclusions, like the
+        reference); STATUS metrics become Datadog service checks; counters
+        become rates divided by the flush interval. One deliberate
+        refinement over the reference (which only consults the sink-level
+        hostname): an InterMetric-carried hostname — a proxied peer's —
+        ranks between the magic tag and the sink default."""
+        magic_host = device = None
+        kept = []
+        for t in tags:
+            if t.startswith("host:"):
+                magic_host = t[5:]
+            elif t.startswith("device:"):
+                device = t[7:]
+            else:
+                kept.append(t)
+        kept = self.strip_excluded(kept)
         for prefix, excludes in self.prefix_tag_excludes.items():
             if name.startswith(prefix):
-                tags = [t for t in tags
+                kept = [t for t in kept
                         if not any(t == e or t.startswith(e + ":")
                                    for e in excludes)]
+        hostname = magic_host or host or self.hostname
+        all_tags = kept + self.strip_excluded(self.tags)
+        if mtype == STATUS:
+            # a non-finite status (unvalidated f32 lane) must degrade to
+            # UNKNOWN(3), not abort the whole interval's flush
+            status = int(value) if value == value and abs(value) != \
+                float("inf") else 3
+            checks.append({
+                "check": name, "status": status,
+                "host_name": hostname, "timestamp": ts,
+                "tags": all_tags, "message": message})
+            return
         dd = {
             "metric": name,
             "type": "gauge",
             "points": [[ts, value]],
-            "host": host or self.hostname,
-            "tags": tags + self.tags,
+            "host": hostname,
+            "tags": all_tags,
         }
         if mtype == COUNTER:
             # Datadog rates: value divided by the flush interval, with the
@@ -65,35 +93,56 @@ class DatadogMetricSink(MetricSink):
             dd["type"] = "rate"
             dd["points"] = [[ts, value / self.interval_s]]
             dd["interval"] = int(self.interval_s)
-        return dd
-
-    def _dd_metric(self, m: InterMetric):
-        return self._dd_from(m.name, m.timestamp, m.value, m.type,
-                             m.tags, m.hostname)
+        if device:
+            dd["device_name"] = device
+        series.append(dd)
 
     # -- flush --------------------------------------------------------------
     def flush(self, metrics):
         metrics = filter_acceptable(metrics, self.name)
-        series = [self._dd_metric(m) for m in metrics
-                  if not any(m.name.startswith(p) for p in self.prefix_drops)]
+        series, checks = [], []
+        for m in metrics:
+            if any(m.name.startswith(p) for p in self.prefix_drops):
+                continue
+            self._add(series, checks, m.name, m.timestamp, m.value,
+                      m.type, m.tags, m.hostname, m.message)
         self._post_series(series)
+        self._post_checks(checks)
 
     def flush_frame(self, frame):
         """Columnar flush: DDMetric dicts straight from the frame's
         prepared rows — no InterMetric materialization between the
         flusher and the JSON body (the per-object detour is ~2us/metric
         at the 10M-key scale; see flusher.MetricFrame). Same emission
-        rules as flush(): sink routing, prefix drops, and _dd_from's
-        shared serialization."""
+        rules as flush(): sink routing, prefix drops, shared _add."""
         drops = self.prefix_drops
         ts = frame.timestamp
-        series = [
-            self._dd_from(name, ts, value, mtype, tags, host)
-            for name, value, mtype, _msg, tags, sinks, host
-            in frame.rows()
-            if not (drops and any(name.startswith(p) for p in drops))
-            and (sinks is None or self.name in sinks)]
+        series, checks = [], []
+        for name, value, mtype, msg, tags, sinks, host in frame.rows():
+            if drops and any(name.startswith(p) for p in drops):
+                continue
+            if sinks is not None and self.name not in sinks:
+                continue
+            self._add(series, checks, name, ts, value, mtype, tags, host,
+                      msg)
         self._post_series(series)
+        self._post_checks(checks)
+
+    def _post_checks(self, checks):
+        """Service checks go to the check_run API (datadog.go:122)."""
+        if not checks:
+            return
+        body = zlib.compress(json.dumps(checks).encode())
+        url = f"{self.api_url}/api/v1/check_run?api_key={self.api_key}"
+        req = urllib.request.Request(
+            url, data=body, method="POST",
+            headers={"Content-Type": "application/json",
+                     "Content-Encoding": "deflate"})
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                resp.read()
+        except Exception as e:
+            log.error("datadog check_run flush failed: %s", e)
 
     def _post_series(self, series):
         if not series:
