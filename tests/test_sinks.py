@@ -195,3 +195,27 @@ lat_count 50
     second = [p.decode() for p in tr.translate(t2, s2)]
     assert "http_requests_total:30|c|#code:200,svc:web" in second
     assert any(p.startswith("lat_bucket:4|c|#le:0.1") for p in second)
+
+
+def test_signalfx_status_gauge_and_sinkonly_dim_stripped():
+    """reference signalfx_test.go:286 TestSignalFxFlushStatus: status
+    flushes as a gauge datapoint; the veneursinkonly routing tag never
+    becomes a dimension (signalfx.go:465); valueless tags keep an empty
+    dimension value."""
+    from veneur_tpu.samplers.intermetric import InterMetric
+    from veneur_tpu.sinks.signalfx import SignalFxMetricSink
+
+    s = SignalFxMetricSink(api_key="k", endpoint="http://x",
+                           hostname="glooblestoots", tags=["yay:pie"])
+    posted = []
+    s._post = lambda token, body: posted.append(body)
+    s.flush([InterMetric("a.b.c", 1476119058, 3.0,
+                         ["foo:bar", "baz:quz", "novalue",
+                          "veneursinkonly:signalfx"], "status")])
+    (body,) = posted
+    assert body["counter"] == []
+    (dp,) = body["gauge"]
+    assert dp["metric"] == "a.b.c" and dp["value"] == 3.0
+    dims = dp["dimensions"]
+    assert dims == {"host": "glooblestoots", "foo": "bar", "baz": "quz",
+                    "novalue": "", "yay": "pie"}

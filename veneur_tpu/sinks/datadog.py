@@ -42,7 +42,7 @@ class DatadogMetricSink(MetricSink):
 
     # -- serialization ------------------------------------------------------
     def _add(self, series, checks, name, ts, value, mtype, tags, host,
-             message):
+             message, sink_tags):
         """The ONE serialization both flush paths share (reference
         datadog.go:256 finalizeMetrics): `host:`/`device:` magic tags
         override the metric's hostname / set device_name and are removed
@@ -68,7 +68,7 @@ class DatadogMetricSink(MetricSink):
                         if not any(t == e or t.startswith(e + ":")
                                    for e in excludes)]
         hostname = magic_host or host or self.hostname
-        all_tags = kept + self.strip_excluded(self.tags)
+        all_tags = kept + sink_tags
         if mtype == STATUS:
             # a non-finite status (unvalidated f32 lane) must degrade to
             # UNKNOWN(3), not abort the whole interval's flush
@@ -101,11 +101,14 @@ class DatadogMetricSink(MetricSink):
     def flush(self, metrics):
         metrics = filter_acceptable(metrics, self.name)
         series, checks = [], []
+        # sink-level tags pass the operator's exclusions too (the
+        # reference filters dd.tags the same way) — invariant per flush
+        sink_tags = self.strip_excluded(self.tags)
         for m in metrics:
             if any(m.name.startswith(p) for p in self.prefix_drops):
                 continue
             self._add(series, checks, m.name, m.timestamp, m.value,
-                      m.type, m.tags, m.hostname, m.message)
+                      m.type, m.tags, m.hostname, m.message, sink_tags)
         self._post_series(series)
         self._post_checks(checks)
 
@@ -118,31 +121,39 @@ class DatadogMetricSink(MetricSink):
         drops = self.prefix_drops
         ts = frame.timestamp
         series, checks = [], []
+        sink_tags = self.strip_excluded(self.tags)
         for name, value, mtype, msg, tags, sinks, host in frame.rows():
             if drops and any(name.startswith(p) for p in drops):
                 continue
             if sinks is not None and self.name not in sinks:
                 continue
             self._add(series, checks, name, ts, value, mtype, tags, host,
-                      msg)
+                      msg, sink_tags)
         self._post_series(series)
         self._post_checks(checks)
 
-    def _post_checks(self, checks):
-        """Service checks go to the check_run API (datadog.go:122)."""
-        if not checks:
-            return
-        body = zlib.compress(json.dumps(checks).encode())
-        url = f"{self.api_url}/api/v1/check_run?api_key={self.api_key}"
+    def _post_json(self, path, payload, what):
+        """The one deflate-JSON POST used by series, checks and events;
+        errors are logged, never fatal."""
+        url = f"{self.api_url}{path}?api_key={self.api_key}"
         req = urllib.request.Request(
-            url, data=body, method="POST",
+            url, data=zlib.compress(json.dumps(payload).encode()),
+            method="POST",
             headers={"Content-Type": "application/json",
                      "Content-Encoding": "deflate"})
         try:
             with urllib.request.urlopen(req, timeout=10) as resp:
                 resp.read()
         except Exception as e:
-            log.error("datadog check_run flush failed: %s", e)
+            log.error("datadog %s flush failed: %s", what, e)
+
+    def _post_checks(self, checks):
+        """Service checks go to the check_run API (datadog.go:122),
+        chunked like series so one giant body can't be rejected whole."""
+        for i in range(0, len(checks), self.flush_max_per_body):
+            self._post_json("/api/v1/check_run",
+                            checks[i:i + self.flush_max_per_body],
+                            "check_run")
 
     def _post_series(self, series):
         if not series:
@@ -160,17 +171,7 @@ class DatadogMetricSink(MetricSink):
             t.join()
 
     def _post_chunk(self, series):
-        body = zlib.compress(json.dumps({"series": series}).encode())
-        url = f"{self.api_url}/api/v1/series?api_key={self.api_key}"
-        req = urllib.request.Request(
-            url, data=body, method="POST",
-            headers={"Content-Type": "application/json",
-                     "Content-Encoding": "deflate"})
-        try:
-            with urllib.request.urlopen(req, timeout=10) as resp:
-                resp.read()
-        except Exception as e:  # flush errors are counted, never fatal
-            log.error("datadog flush failed: %s", e)
+        self._post_json("/api/v1/series", {"series": series}, "series")
 
     def flush_other_samples(self, samples):
         """DogStatsD events → Datadog events API: the vdogstatsd_* conduit
@@ -197,16 +198,5 @@ class DatadogMetricSink(MetricSink):
                 if tags.get(tag_key):
                     ev[ev_key] = tags[tag_key]
             events.append(ev)
-        if not events:
-            return
-        body = zlib.compress(json.dumps({"events": events}).encode())
-        req = urllib.request.Request(
-            f"{self.api_url}/intake?api_key={self.api_key}", data=body,
-            method="POST",
-            headers={"Content-Type": "application/json",
-                     "Content-Encoding": "deflate"})
-        try:
-            with urllib.request.urlopen(req, timeout=10) as resp:
-                resp.read()
-        except Exception as e:
-            log.error("datadog event flush failed: %s", e)
+        if events:
+            self._post_json("/intake", {"events": events}, "event")

@@ -49,6 +49,9 @@ class SignalFxMetricSink(MetricSink):
             if any(t.startswith(p) for p in self.tag_prefix_drops):
                 continue
             k, _, v = t.partition(":")
+            if k == "veneursinkonly":
+                continue  # routing tag, never a dimension (signalfx.go:465
+                #           deletes exactly this dimension key)
             dims[k] = v
         return {"metric": name, "value": value,
                 "timestamp": int(ts * 1000), "dimensions": dims}
