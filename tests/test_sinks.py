@@ -156,7 +156,12 @@ def test_kafka_sink_with_injected_producer():
                             producer=producer)
     msink.flush([im("k1", 5.0)])
     assert sent[0][0] == "metrics"
-    assert json.loads(sent[0][2])["name"] == "k1"
+    # Go-default json.Marshal(InterMetric) schema (kafka.go:205):
+    # capitalized keys, numeric MetricType, Sinks null = every sink
+    body = json.loads(sent[0][2])
+    assert body["Name"] == "k1" and body["Value"] == 5.0
+    assert body["Type"] == 0 and body["Sinks"] is None
+    assert "Timestamp" in body and "HostName" in body
 
     ssink = KafkaSpanSink("broker:9092", span_topic="spans",
                           serialization="protobuf", producer=producer)

@@ -57,15 +57,31 @@ class KafkaMetricSink(MetricSink):
         self.produce = producer or _default_producer(broker)
         self.flushed = 0
 
+    # the reference produces json.Marshal(InterMetric) with NO field
+    # tags (kafka.go:205): Go-default capitalized keys, the MetricType
+    # iota as a NUMBER, and Sinks as a key-only map (null = every sink).
+    # Consumers built against that schema must keep working.
+    _TYPE_NUM = {"counter": 0, "gauge": 1, "status": 2}
+
     def flush(self, metrics):
+        import math
         for m in filter_acceptable(metrics, self.name):
+            if not math.isfinite(m.value):
+                # Go's json.Marshal errors on non-finite floats, so the
+                # reference drops the message (kafka.go:205-210); emitting
+                # Python's bare NaN literal would poison strict consumers
+                log.warning("kafka: dropping non-finite metric %s", m.name)
+                continue
             topic = (self.check_topic
                      if m.type == "status" and self.check_topic
                      else self.metric_topic)
             value = json.dumps({
-                "name": m.name, "timestamp": m.timestamp,
-                "value": m.value, "tags": m.tags, "type": m.type,
-                "hostname": m.hostname,
+                "Name": m.name, "Timestamp": m.timestamp,
+                "Value": m.value, "Tags": list(m.tags),
+                "Type": self._TYPE_NUM.get(m.type, 1),
+                "Message": m.message, "HostName": m.hostname,
+                "Sinks": ({s: {} for s in sorted(m.sinks)}
+                          if m.sinks is not None else None),
             }).encode()
             try:
                 self.produce(topic, m.name.encode(), value)

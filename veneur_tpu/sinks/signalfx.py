@@ -14,8 +14,13 @@ import logging
 import urllib.request
 from typing import Dict, List
 
-from veneur_tpu.samplers.intermetric import COUNTER, InterMetric
+from veneur_tpu.samplers.intermetric import (
+    COUNTER, SINK_ONLY_TAG_PREFIX, InterMetric)
 from veneur_tpu.sinks.base import MetricSink, filter_acceptable
+
+# the dimension KEY the routing tag produces ("veneursinkonly:x" and the
+# bare "veneursinkonly" both partition to this)
+_SINK_ONLY_KEY = SINK_ONLY_TAG_PREFIX.rstrip(":")
 
 log = logging.getLogger("veneur_tpu.sinks.signalfx")
 
@@ -49,7 +54,7 @@ class SignalFxMetricSink(MetricSink):
             if any(t.startswith(p) for p in self.tag_prefix_drops):
                 continue
             k, _, v = t.partition(":")
-            if k == "veneursinkonly":
+            if k == _SINK_ONLY_KEY:
                 continue  # routing tag, never a dimension (signalfx.go:465
                 #           deletes exactly this dimension key)
             dims[k] = v
