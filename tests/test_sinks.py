@@ -224,3 +224,36 @@ def test_signalfx_status_gauge_and_sinkonly_dim_stripped():
     dims = dp["dimensions"]
     assert dims == {"host": "glooblestoots", "foo": "bar", "baz": "quz",
                     "novalue": "", "yay": "pie"}
+
+
+def test_splunk_indicator_sampling_and_excluded_keys():
+    """reference splunk.go:449-495: indicators bypass trace sampling and
+    get partial:true when they would have been dropped; a span carrying
+    any excluded tag KEY is skipped whole."""
+    from veneur_tpu.sinks.splunk import SplunkSpanSink
+    from tests.test_spans import make_span
+
+    s = SplunkSpanSink("http://x", token="t", hostname="h",
+                       batch_size=100, sample_rate=10)
+    submitted = []
+    s._submit = submitted.extend
+    s.set_excluded_tags(["farts"])
+
+    sampled_out = make_span(trace_id=11, span_id=1)       # 11 % 10 != 0
+    s.ingest(sampled_out)
+    kept = make_span(trace_id=20, span_id=2)              # 20 % 10 == 0
+    s.ingest(kept)
+    ind = make_span(trace_id=13, span_id=3)               # would drop...
+    ind.indicator = True                                   # ...but indicator
+    s.ingest(ind)
+    excl = make_span(trace_id=30, span_id=4)
+    excl.tags["farts"] = "mandatory"
+    s.ingest(excl)
+    s.flush()
+
+    assert s.skipped == 1
+    ids = [e["event"]["id"] for e in submitted]
+    assert ids == [f"{2:016x}", f"{3:016x}"]              # excl skipped
+    by_id = {e["event"]["id"]: e["event"] for e in submitted}
+    assert by_id[f"{3:016x}"].get("partial") is True      # marked partial
+    assert "partial" not in by_id[f"{2:016x}"]

@@ -170,7 +170,6 @@ class Server:
         self.metric_sinks = list(metric_sinks or [])
         self.span_sinks = list(span_sinks or [])
         self.plugins = list(plugins or [])
-        self._wire_excluded_tags()
 
         # span pipeline: metric-extraction sink always first
         # (server.go:409, ssfmetrics always prepended)
@@ -204,6 +203,9 @@ class Server:
             capacity=cfg.span_channel_capacity or 100,
             num_workers=max(1, cfg.num_span_workers),
             common_tags=common_tags)
+        # after the span pipeline exists: exclusion rules wire BOTH sink
+        # kinds (server.go:1467 setSinkExcludedTags)
+        self._wire_excluded_tags()
 
         # self-telemetry: a channel trace client into our own span pipeline
         # (trace.NewChannelClient, server.go:309-313) — self-spans re-enter
@@ -267,6 +269,11 @@ class Server:
                     per_sink.setdefault(sink_name, []).append(parts[0])
         for sink in self.metric_sinks:
             sink.set_excluded_tags(base + per_sink.get(sink.name, []))
+        # span sinks that opt in get the same rules (server.go:1467
+        # setSinkExcludedTags wires BOTH sink kinds)
+        for sink in self.span_pipeline.span_sinks:
+            if hasattr(sink, "set_excluded_tags"):
+                sink.set_excluded_tags(base + per_sink.get(sink.name, []))
 
     # -- ingest path --------------------------------------------------------
     def handle_metric_packet(self, packet: bytes) -> None:

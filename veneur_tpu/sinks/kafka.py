@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import json
 import logging
+import math
 from typing import Callable, List, Optional
 
 from veneur_tpu.sinks.base import MetricSink, SpanSink, filter_acceptable
@@ -64,12 +65,14 @@ class KafkaMetricSink(MetricSink):
     _TYPE_NUM = {"counter": 0, "gauge": 1, "status": 2}
 
     def flush(self, metrics):
-        import math
         for m in filter_acceptable(metrics, self.name):
             if not math.isfinite(m.value):
-                # Go's json.Marshal errors on non-finite floats, so the
-                # reference drops the message (kafka.go:205-210); emitting
-                # Python's bare NaN literal would poison strict consumers
+                # Go's json.Marshal errors on non-finite floats, and the
+                # reference ABORTS the whole flush on that error
+                # (kafka.go:205-210). Deliberate deviation: drop only the
+                # bad message — one NaN must not wipe the interval's batch
+                # — while still never emitting Python's bare NaN literal,
+                # which strict consumers reject.
                 log.warning("kafka: dropping non-finite metric %s", m.name)
                 continue
             topic = (self.check_topic

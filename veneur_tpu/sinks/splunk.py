@@ -2,8 +2,11 @@
 
 Spans become JSON events streamed to the HTTP Event Collector
 (`/services/collector/event`, Authorization: Splunk <token>), batched to
-`hec_batch_size` with trace-id sampling (splunk.go sampling by trace id
-modulo) and `"partial":true` tagging for spans dropped from full batches.
+`hec_batch_size` with trace-id sampling (splunk.go: keep 1-in-N traces
+by trace-id modulo). Indicator spans are never sampled out; one that
+WOULD have been dropped is kept with `"partial": true` so indicator
+spans with full traces stay searchable (splunk.go:449-456, :490-495).
+A span carrying any excluded tag KEY is skipped whole.
 """
 
 from __future__ import annotations
@@ -42,6 +45,7 @@ class SplunkSpanSink(SpanSink):
         self._lock = threading.Lock()
         self.submitted = 0
         self.skipped = 0
+        self.excluded_tag_keys: set = set()
 
     def _event(self, span) -> dict:
         return {
@@ -64,12 +68,28 @@ class SplunkSpanSink(SpanSink):
             },
         }
 
+    def set_excluded_tags(self, tags) -> None:
+        """A span carrying ANY excluded tag KEY is skipped whole
+        (splunk.go:462-466) — span exclusion is by key, not prefix."""
+        self.excluded_tag_keys = set(tags)
+
     def ingest(self, span) -> None:
-        if self.sample_rate > 1 and span.trace_id % self.sample_rate != 0:
+        # trace-id sampling keeps 1-in-N traces, but INDICATOR spans are
+        # never sampled out — a would-drop indicator is kept and marked
+        # partial so full traces remain searchable (splunk.go:449-456,
+        # :490-495)
+        would_drop = (self.sample_rate > 1
+                      and span.trace_id % self.sample_rate != 0)
+        if would_drop and not span.indicator:
             self.skipped += 1
             return
+        if any(k in span.tags for k in self.excluded_tag_keys):
+            return
+        ev = self._event(span)
+        if would_drop:
+            ev["event"]["partial"] = True
         with self._lock:
-            self._buf.append(self._event(span))
+            self._buf.append(ev)
             if len(self._buf) >= self.batch_size:
                 batch, self._buf = self._buf, []
             else:
