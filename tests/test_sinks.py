@@ -230,9 +230,6 @@ def test_splunk_indicator_sampling_and_excluded_keys():
     """reference splunk.go:449-495: indicators bypass trace sampling and
     get partial:true when they would have been dropped; a span carrying
     any excluded tag KEY is skipped whole."""
-    from veneur_tpu.sinks.splunk import SplunkSpanSink
-    from tests.test_spans import make_span
-
     s = SplunkSpanSink("http://x", token="t", hostname="h",
                        batch_size=100, sample_rate=10)
     submitted = []
@@ -257,3 +254,32 @@ def test_splunk_indicator_sampling_and_excluded_keys():
     by_id = {e["event"]["id"]: e["event"] for e in submitted}
     assert by_id[f"{3:016x}"].get("partial") is True      # marked partial
     assert "partial" not in by_id[f"{2:016x}"]
+
+
+def test_xray_trace_id_stability_and_crc_sampling():
+    """reference xray.go:262 CalculateTraceID / :155 sampling: all
+    segments of a trace share one X-Ray trace id (root start when sent,
+    else the ~4.3min bucket), and the keep/drop decision is
+    CRC32(decimal trace id) vs pct-of-maxuint32 — identical on every
+    instance."""
+    s = XRaySpanSink(daemon_address="127.0.0.1:1", sample_percentage=50.0)
+    a = make_span(trace_id=4601851300195147788, span_id=1)
+    a.start_timestamp = 1518279577 * 10**9
+    b = make_span(trace_id=4601851300195147788, span_id=2)
+    b.start_timestamp = (1518279577 + 30) * 10**9   # 30s later, same trace
+    assert s.trace_id(a) == s.trace_id(b)
+    # root start, when present, pins the id exactly
+    a.root_start_timestamp = 1518279500 * 10**9
+    assert s.trace_id(a).startswith(f"1-{1518279500:08x}-")
+
+    # sampling is crc-hash-consistent, not modulo
+    kept = [i for i in range(1, 200)
+            if zlib.crc32(str(i).encode()) <= int(50.0 * 0xFFFFFFFF / 100)]
+    for i in (kept[0], kept[1]):
+        sp = make_span(trace_id=i, span_id=i)
+        s.ingest(sp)
+    dropped = next(i for i in range(1, 200)
+                   if zlib.crc32(str(i).encode())
+                   > int(50.0 * 0xFFFFFFFF / 100))
+    s.ingest(make_span(trace_id=dropped, span_id=9))
+    assert s.sent == 2 and s.skipped == 1

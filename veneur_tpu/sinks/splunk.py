@@ -70,7 +70,15 @@ class SplunkSpanSink(SpanSink):
 
     def set_excluded_tags(self, tags) -> None:
         """A span carrying ANY excluded tag KEY is skipped whole
-        (splunk.go:462-466) — span exclusion is by key, not prefix."""
+        (splunk.go:462-466) — span exclusion is by key, not prefix. A
+        value-qualified entry ("env:prod") can never match a tag KEY;
+        the reference silently no-ops there too, but warn so operators
+        don't believe an inert rule is active."""
+        for t in tags:
+            if ":" in t:
+                log.warning("splunk excluded tag %r is value-qualified; "
+                            "span exclusion matches tag KEYS only and "
+                            "this rule will never match", t)
         self.excluded_tag_keys = set(tags)
 
     def ingest(self, span) -> None:

@@ -12,6 +12,7 @@ from __future__ import annotations
 import json
 import logging
 import socket
+import zlib
 from typing import List
 
 from veneur_tpu.sinks.base import SpanSink
@@ -37,13 +38,27 @@ class XRaySpanSink(SpanSink):
 
     @staticmethod
     def trace_id(span) -> str:
-        """xray.go CalculateTraceID: 1-<8 hex epoch>-<24 hex from id>."""
-        epoch = span.start_timestamp // int(1e9)
-        return f"1-{epoch & 0xFFFFFFFF:08x}-{span.trace_id & ((1 << 96) - 1):024x}"
+        """xray.go:262 CalculateTraceID. X-Ray only assembles segments
+        sharing one trace id, so the epoch half comes from the trace's
+        ROOT start when the client sent it, else from the span start
+        bucketed to ~4.3 min (low byte cleared) so siblings within the
+        window agree. Same best-effort contract as the reference: traces
+        whose clients mix sending/omitting root_start, or whose spans
+        straddle a bucket boundary, can still shear — root_start from
+        every client is the reliable path."""
+        epoch = getattr(span, "root_start_timestamp", 0) // int(1e9)
+        if epoch == 0:
+            epoch = (span.start_timestamp // int(1e9)) & 0xFFFFFFFFFFFF00
+        return (f"1-{epoch & 0xFFFFFFFF:08x}-"
+                f"{span.trace_id & ((1 << 96) - 1):024x}")
 
     def ingest(self, span) -> None:
-        # % sampling keyed on trace id (xray.go sample decision)
-        if (span.trace_id % 100) >= self.sample_percentage:
+        # the sample decision hashes the DECIMAL trace id with CRC32
+        # against pct-of-maxuint32 (xray.go:155-160): every veneur
+        # instance keeps the SAME traces, so distributed traces stay
+        # complete — a plain modulo would shear them apart
+        hash_key = zlib.crc32(str(span.trace_id).encode()) & 0xFFFFFFFF
+        if hash_key > int(self.sample_percentage * 0xFFFFFFFF / 100):
             self.skipped += 1
             return
         annotations = {k: v for k, v in span.tags.items()
