@@ -83,3 +83,46 @@ def test_ssf_span_identity_flags():
     assert span.service == "svc-x" and span.name == "op" and span.error
     assert span.end_timestamp - span.start_timestamp == int(1e9)
     assert span.metrics[0].name == "op" if span.metrics else True
+
+
+def test_trace_identity_inferred_from_env(monkeypatch):
+    """reference main.go:401 inferTraceIDInt: unset flags read
+    VENEUR_EMIT_TRACE_ID / VENEUR_EMIT_PARENT_SPAN_ID; a set flag wins
+    over the env; a malformed env value errors only when the flag is
+    unset."""
+    import socket
+
+    from veneur_tpu.protocol.wire import parse_ssf
+
+    def run(extra, env, expect_rc=0):
+        recv = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        recv.bind(("127.0.0.1", 0))
+        recv.settimeout(5)
+        for k in ("VENEUR_EMIT_TRACE_ID", "VENEUR_EMIT_PARENT_SPAN_ID"):
+            monkeypatch.delenv(k, raising=False)
+        for k, v in env.items():
+            monkeypatch.setenv(k, v)
+        rc = emit_main(["-hostport",
+                        f"udp://127.0.0.1:{recv.getsockname()[1]}",
+                        "-ssf", "-name", "env.span", "-gauge", "1"]
+                       + extra)
+        assert rc == expect_rc
+        span = parse_ssf(recv.recv(65536)) if rc == 0 else None
+        recv.close()
+        return span
+
+    s = run([], {"VENEUR_EMIT_TRACE_ID": "77",
+                 "VENEUR_EMIT_PARENT_SPAN_ID": "55"})
+    assert s.trace_id == 77 and s.parent_id == 55
+
+    s = run(["-trace_id", "11"], {"VENEUR_EMIT_TRACE_ID": "99"})
+    assert s.trace_id == 11                     # flag beats env
+
+    s = run(["-trace_id", "11"], {"VENEUR_EMIT_TRACE_ID": "farts"})
+    assert s.trace_id == 11                     # bad env ignored: flag set
+
+    # malformed env with the flag unset: usage error rc 2, socket closed,
+    # no exception out of a programmatic main() call
+    assert run([], {"VENEUR_EMIT_TRACE_ID": "farts"}, expect_rc=2) is None
+    # Go ParseInt strictness: underscores are malformed, not 10
+    assert run([], {"VENEUR_EMIT_TRACE_ID": "1_0"}, expect_rc=2) is None

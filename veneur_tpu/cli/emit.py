@@ -7,6 +7,7 @@ benchmark mode (the traffic generator for BASELINE configs).
 from __future__ import annotations
 
 import argparse
+import os
 import random
 import socket
 import subprocess
@@ -228,6 +229,36 @@ def _emit_ssf(args, tags, kind, sock):
     from veneur_tpu.protocol.wire import write_ssf
     from veneur_tpu.samplers import ssf_samples
     from veneur_tpu.trace.tracer import Span
+
+    # flags unset -> trace identity is inferred from the environment
+    # (main.go:146,401 inferTraceIDInt): how nested `-command` spans in a
+    # shell pipeline join their parent's trace. 0 means unset exactly as
+    # the reference's `if existingID != 0` does (an explicit `-trace_id 0`
+    # is indistinguishable there too), and the accepted integer forms
+    # match Go's ParseInt — no underscores, whitespace, or leading '+'.
+    # A malformed env value errors ONLY when the flag didn't decide,
+    # following the module error contract: stderr + close + rc 2.
+    import re
+
+    def infer_id(existing: int, env_key: str) -> int:
+        if existing:
+            return existing
+        raw = os.environ.get(env_key)
+        if raw is None:
+            return 0
+        if not re.fullmatch(r"-?[0-9]+", raw):
+            raise ValueError(
+                f"bad integer in ${env_key}: {raw!r}")
+        return int(raw)
+
+    try:
+        args.trace_id = infer_id(args.trace_id, "VENEUR_EMIT_TRACE_ID")
+        args.parent_span_id = infer_id(args.parent_span_id,
+                                       "VENEUR_EMIT_PARENT_SPAN_ID")
+    except ValueError as e:
+        print(f"veneur-emit: {e}", file=sys.stderr)
+        sock.close()
+        return 2
 
     tag_map = dict(t.split(":", 1) if ":" in t else (t, "")
                    for t in tags)
