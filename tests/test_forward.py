@@ -351,3 +351,38 @@ def test_forward_bad_address_never_blocks_local_flush():
         assert got.get("veneur.forward.error_total", 0) >= 1.0
     finally:
         srv.shutdown()
+
+
+def test_e2e_forwarding_indicator_metrics():
+    """reference forward_test.go:100 TestE2EForwardingIndicatorMetrics:
+    an indicator span ingested on the LOCAL tier becomes an SLI timer
+    that forwards to the GLOBAL, which emits the configured percentiles
+    of indicator.span.timer."""
+    from veneur_tpu.proto import ssf_pb2
+
+    gsink = DebugMetricSink()
+    glob = Server(small_config(grpc_address="127.0.0.1:0"),
+                  metric_sinks=[gsink])
+    glob.start()
+    local = Server(small_config(
+        forward_address=f"127.0.0.1:{glob.grpc_port}",
+        indicator_span_timer_name="indicator.span.timer"),
+        metric_sinks=[DebugMetricSink()])
+    local.start()
+    try:
+        span = ssf_pb2.SSFSpan(version=0, id=5, trace_id=5, name="foo",
+                               service="indicator_testing", indicator=True,
+                               start_timestamp=int(1e9),
+                               end_timestamp=int(6e9))
+        local.span_pipeline.handle_span(span)
+        deadline = time.time() + 15
+        while time.time() < deadline and local.aggregator.processed < 1:
+            time.sleep(0.05)
+        _flush_through(local, glob)
+        names = {m.name for m in gsink.flushed}
+        for p in glob.cfg.percentiles:
+            assert f"indicator.span.timer.{int(p * 100)}percentile" \
+                in names, names
+    finally:
+        local.shutdown()
+        glob.shutdown()
