@@ -709,16 +709,24 @@ def cache_env(force_cpu: bool = False) -> dict:
     ultimately selected, so a wedged tunnel would hang `jax.devices()`
     regardless of JAX_PLATFORMS. Dropping the plugin's gating env var is
     the only fully hermetic bypass."""
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = dict(os.environ)
-    env.setdefault("JAX_COMPILATION_CACHE_DIR",
-                   os.path.join(repo, ".xla_cache"))
-    env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+    apply_cache_defaults(env)
     if force_cpu or env.get("JAX_PLATFORMS", "").split(",")[0].strip() \
             == "cpu":
         env["JAX_PLATFORMS"] = "cpu"
         env.pop("PALLAS_AXON_POOL_IPS", None)
     return env
+
+
+def apply_cache_defaults(env=None) -> None:
+    """THE persistent-XLA-cache location every harness entry point shares
+    (bench stages, e2e children, the driver's dryrun): one repo-root
+    cache, operator overrides win. Mutates `env` (default os.environ)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    target = os.environ if env is None else env
+    target.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.join(repo, ".xla_cache"))
+    target.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
 
 
 def pin_platform():
