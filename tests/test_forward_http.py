@@ -244,3 +244,52 @@ def test_import_gzip_body_is_415():
             assert b"gzip" in e.read()
     finally:
         srv.shutdown()
+
+
+def test_three_tier_http_local_proxy_globals():
+    """The complete v1 fleet path with real servers at every hop: a local
+    tier HTTP-forwards its JSONMetric array to the proxy's /import, which
+    consistent-hashes per metric and re-POSTs to two global tiers'
+    /import; the union of global flushes carries every key exactly once
+    (proxy.go:580 + handlers_global.go:115, composed)."""
+    from veneur_tpu.forward.discovery import StaticDiscoverer
+    from veneur_tpu.forward.proxysrv import ProxyServer
+
+    gsinks = [DebugMetricSink(), DebugMetricSink()]
+    globs = [Server(small_config(http_address="127.0.0.1:0"),
+                    metric_sinks=[gs]) for gs in gsinks]
+    for g in globs:
+        g.start()
+    proxy = ProxyServer(StaticDiscoverer(
+        [f"127.0.0.1:{g.http_port}" for g in globs]))
+    pport = proxy.start_http("127.0.0.1:0")
+    local = Server(small_config(
+        forward_address=f"http://127.0.0.1:{pport}"),
+        metric_sinks=[DebugMetricSink()])
+    local.start()
+    try:
+        lines = [f"v1.tiered.{i}:1|c|#veneurglobalonly".encode()
+                 for i in range(30)]
+        _send_udp(local.local_addr(), lines)
+        _wait_processed(local, 30)
+        local.trigger_flush()
+        deadline = time.time() + 15
+        while (time.time() < deadline
+               and (sum(g.aggregator.processed for g in globs) < 30
+                    or proxy.forwarded < 30)):   # proxy counts after POST
+            time.sleep(0.05)
+        for g in globs:
+            g.trigger_flush()
+        per_sink = [{m.name for m in gs.flushed
+                     if m.name.startswith("v1.tiered")} for gs in gsinks]
+        assert per_sink[0] | per_sink[1] == \
+            {f"v1.tiered.{i}" for i in range(30)}
+        # EXACTLY once: the ring must partition, never duplicate
+        assert not (per_sink[0] & per_sink[1])
+        assert all(per_sink)                      # both got a share
+        assert proxy.forwarded == 30
+    finally:
+        local.shutdown()
+        proxy.stop()
+        for g in globs:
+            g.shutdown()
