@@ -19,7 +19,6 @@ from veneur_tpu.aggregation.host import (
     KeyTable, SCOPE_GLOBAL, SCOPE_LOCAL)
 from veneur_tpu.ops import hll as hll_ops
 from veneur_tpu.proto import metricpb_pb2 as mpb
-from veneur_tpu.proto import tdigestpb_pb2 as tdpb
 from veneur_tpu.utils.hashing import fnv1a_32
 
 _KIND_TO_TYPE = {

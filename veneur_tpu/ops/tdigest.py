@@ -42,7 +42,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from veneur_tpu.utils.numerics import two_sum, twofloat_add, twofloat_merge
+from veneur_tpu.utils.numerics import twofloat_add, twofloat_merge
 
 DEFAULT_COMPRESSION = 100.0
 DEFAULT_CELLS_PER_K = 2

@@ -11,7 +11,7 @@ flush math runs on the detached state while new samples accumulate.
 from __future__ import annotations
 
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Tuple
 
 import numpy as np
 
@@ -19,7 +19,6 @@ from veneur_tpu.aggregation.host import Batcher, BatchSpec, KeyTable
 from veneur_tpu.aggregation.state import TableSpec, empty_state
 from veneur_tpu.aggregation.step import (
     batch_sizes, ingest_step_packed, pack_batch)
-from veneur_tpu.samplers import parser
 from veneur_tpu.samplers.parser import UDPMetric
 
 

@@ -19,7 +19,7 @@ about device scale-out, not host parse throughput).
 from __future__ import annotations
 
 from functools import partial
-from typing import Dict, List, Tuple
+from typing import List, Tuple
 
 import numpy as np
 

@@ -17,7 +17,7 @@ from __future__ import annotations
 import json
 import logging
 import math
-from typing import Callable, List, Optional
+from typing import Callable, Optional
 
 from veneur_tpu.sinks.base import MetricSink, SpanSink, filter_acceptable
 

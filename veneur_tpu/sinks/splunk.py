@@ -15,7 +15,7 @@ import json
 import logging
 import threading
 import urllib.request
-from typing import List, Optional
+from typing import List
 
 from veneur_tpu.sinks.base import SpanSink
 

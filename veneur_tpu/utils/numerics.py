@@ -32,7 +32,6 @@ tested in tests/test_aggregation.py::test_counter_exactness_envelope*):
 
 from __future__ import annotations
 
-import jax.numpy as jnp
 
 
 def two_sum(a, b):
