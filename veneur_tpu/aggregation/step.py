@@ -316,6 +316,16 @@ compact = partial(jax.jit, static_argnames=("spec",),
                   donate_argnames=("state",))(compact_core)
 
 
+def quantiles_with_median(table, qs):
+    """ONE quantile pass for (requested quantiles, median): the median
+    rides as an extra column instead of a second full per-row sort+cumsum
+    over the digest table — the flush program's dominant compute, which
+    XLA does not reliably CSE. Returns (quantiles[..., Q], median[...])."""
+    all_q = td.quantiles(
+        table, jnp.concatenate([qs, jnp.asarray([0.5], jnp.float32)]))
+    return all_q[..., :-1], all_q[..., -1]
+
+
 def flush_core(state: DeviceState, qs: jax.Array, *, spec: TableSpec):
     """Produce the final per-slot values the flusher turns into InterMetrics
     (reference flusher.go:225 generateInterMetrics), dense over capacity.
@@ -335,6 +345,7 @@ def flush_core(state: DeviceState, qs: jax.Array, *, spec: TableSpec):
     # interval would flush as 2^32). The host combines them in float64
     # (combine_flush_scalars) — device f64 is unavailable without
     # jax_enable_x64.
+    hq, hmed = quantiles_with_median(table, qs)
     return {
         "counter_hi": state.counter_hi,
         "counter_lo": state.counter_lo,
@@ -342,7 +353,7 @@ def flush_core(state: DeviceState, qs: jax.Array, *, spec: TableSpec):
         "status": state.status,
         "set_estimate": hll_ops.estimate(state.hll,
                                          precision=spec.hll_precision),
-        "histo_quantiles": td.quantiles(table, qs),
+        "histo_quantiles": hq,
         "histo_min": state.h_min,
         "histo_max": state.h_max,
         "histo_count_hi": state.h_count_hi,
@@ -351,7 +362,7 @@ def flush_core(state: DeviceState, qs: jax.Array, *, spec: TableSpec):
         "histo_sum_lo": state.h_sum_lo,
         "histo_recip_hi": state.h_recip_hi,
         "histo_recip_lo": state.h_recip_lo,
-        "histo_median": td.quantiles(table, jnp.asarray([0.5], jnp.float32))[..., 0],
+        "histo_median": hmed,
     }
 
 
@@ -384,6 +395,7 @@ def flush_live_core(state: DeviceState, qs: jax.Array, cidx, gidx, stidx,
         count_hi=chi, count_lo=clo, sum_hi=shi, sum_lo=slo,
         recip_hi=rhi, recip_lo=rlo)
     hll_rows = _take(state.hll, setidx)
+    hq, hmed = quantiles_with_median(table, qs)
     out = {
         "counter_hi": _take(state.counter_hi, cidx),
         "counter_lo": _take(state.counter_lo, cidx),
@@ -391,14 +403,13 @@ def flush_live_core(state: DeviceState, qs: jax.Array, cidx, gidx, stidx,
         "status": _take(state.status, stidx),
         "set_estimate": hll_ops.estimate(hll_rows,
                                          precision=spec.hll_precision),
-        "histo_quantiles": td.quantiles(table, qs),
+        "histo_quantiles": hq,
         "histo_min": mn,
         "histo_max": mx,
         "histo_count_hi": chi, "histo_count_lo": clo,
         "histo_sum_hi": shi, "histo_sum_lo": slo,
         "histo_recip_hi": rhi, "histo_recip_lo": rlo,
-        "histo_median": td.quantiles(
-            table, jnp.asarray([0.5], jnp.float32))[..., 0],
+        "histo_median": hmed,
     }
     if want_raw:
         # forwarding needs the mergeable sketch state of live rows
