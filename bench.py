@@ -89,7 +89,7 @@ def main():
     budget = float(os.environ.get("BENCH_KERNEL_TIMEOUT", "1500"))
     out = {"metric": "aggregation_samples_per_sec_per_chip_1M_keys",
            "value": 0, "unit": "samples/sec", "vs_baseline": 0}
-    from benchmarks.e2e import cache_env, parse_last_json_line
+    from benchmarks.e2e import cache_env, last_phase, parse_last_json_line
 
     def checkpoint():
         """Print the CUMULATIVE artifact after every stage. The driver
@@ -111,9 +111,10 @@ def main():
                 return parsed
             return {"kernel_error": (f"rc={proc.returncode}: "
                                      f"{proc.stderr.strip()[-400:]}")}
-        except subprocess.TimeoutExpired:
+        except subprocess.TimeoutExpired as e:
             return {"kernel_error":
-                    f"kernel stage timeout after {timeout:.0f}s"}
+                    f"kernel stage timeout after {timeout:.0f}s at "
+                    f"phase={last_phase(e.stderr)}"}
 
     # The accelerator tunnel is flaky at round boundaries; a single
     # 600s-watchdog attempt zeroed round 3's artifact. Strategy:
@@ -196,8 +197,9 @@ def main():
             out["pallas"] = parse_last_json_line(proc.stdout) or {
                 "error": f"rc={proc.returncode}: "
                          f"{proc.stderr.strip()[-300:]}"}
-        except subprocess.TimeoutExpired:
-            out["pallas"] = {"error": "pallas stage timeout after 600s"}
+        except subprocess.TimeoutExpired as e:
+            out["pallas"] = {"error": "pallas stage timeout after 600s "
+                                      f"at phase={last_phase(e.stderr)}"}
         checkpoint()
 
     if kernel_ok(out) \
@@ -228,13 +230,15 @@ def pallas_main():
     td.quantiles takes here, ops/tdigest.py:229), steady-state rows/sec
     for both, and parity. Reference contract: the Go digest's Quantile
     (tdigest/merging_digest.go:302) — the XLA path is the in-repo oracle."""
-    from benchmarks.e2e import _arm_init_watchdog, pin_platform
+    from benchmarks.e2e import _arm_init_watchdog, phase, pin_platform
     timer = _arm_init_watchdog({"stage": "pallas_quantile"})
+    phase("backend_init")
     import jax
     pin_platform()
     import jax.numpy as jnp
     dev = jax.devices()[0]
     timer.cancel()
+    phase(f"backend_up:{dev.platform}")
     out = {"stage": "pallas_quantile", "platform": dev.platform}
     from veneur_tpu.aggregation.state import TableSpec
     from veneur_tpu.ops import pallas_digest as pd
@@ -268,11 +272,14 @@ def pallas_main():
             n += 1
         return (time.perf_counter() - t0) / n, np.asarray(res)
 
+    phase("xla_quantile_compile")
     xla = jax.jit(jax.vmap(_quantiles_one, in_axes=(0, 0, 0, 0, None)))
     t_xla, ref = steady(xla)
+    phase("xla_quantile_done")
     out["rows"] = r
     out["xla_rows_per_sec"] = round(r / t_xla, 1)
     if out["pallas_enabled"]:
+        phase("pallas_quantile_compile")
         fused = jax.jit(pd.quantiles_rows)
         t_p, got = steady(fused)
         out["pallas_rows_per_sec"] = round(r / t_p, 1)
@@ -290,10 +297,11 @@ def kernel_main():
     # with a diagnostic line instead of hanging the driver (shared with
     # the e2e config children so the orchestrator's "backend init"
     # dead-tunnel detection matches both).
-    from benchmarks.e2e import _arm_init_watchdog, pin_platform
+    from benchmarks.e2e import _arm_init_watchdog, phase, pin_platform
     timer = _arm_init_watchdog({
         "metric": "aggregation_samples_per_sec_per_chip_1M_keys",
         "value": 0, "unit": "samples/sec", "vs_baseline": 0})
+    phase("backend_init")
     import jax
     pin_platform()
     import jax.numpy as jnp
@@ -304,6 +312,7 @@ def kernel_main():
 
     dev = jax.devices()[0]
     timer.cancel()   # backend is up; the run itself is bounded by steps
+    phase(f"backend_up:{dev.platform}")
     on_tpu = dev.platform != "cpu"
     if not on_tpu:
         # CPU smoke-mode: tiny shapes so the harness stays runnable anywhere
@@ -379,21 +388,31 @@ def kernel_main():
         uses[i % n_batches] += 1
         return state
 
+    phase("batches_packed")
     state = jax.device_put(empty_state(spec), dev)
     # warmup / compile EVERYTHING that runs inside the timed loop
+    phase("warmup_compile")   # first step pays the packed-program compile
     for i in range(2 * compact_every):
         state = run(state, i)
+        if i == 0:
+            jax.block_until_ready(state)
+            phase("ingest_compiled")
     state = fold_scalars(state)
     jax.block_until_ready(state)
+    phase("warmup_done")
 
     t0 = time.perf_counter()
     for i in range(steps):
         state = run(state, i)
+        if (i + 1) % 25 == 0:
+            phase(f"timed_loop:{i + 1}/{steps}")
     state = fold_scalars(state)
     jax.block_until_ready(state)
     dt = time.perf_counter() - t0
+    phase("timed_done")
 
     rate = per_step * steps / dt
+    phase("accuracy_flush")   # compiles the flush program (untimed)
     out = {
         "metric": "aggregation_samples_per_sec_per_chip_1M_keys",
         "value": round(rate, 1),

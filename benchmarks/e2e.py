@@ -107,12 +107,14 @@ def _warm(srv, lines, sinks=()):
     per-dispatch mode (see step.py ingest_step_packed). Each config's
     cycle 0 is untimed-in-spirit and absorbs every compile at the TRUE
     buckets; cycle 1 is the steady state."""
+    phase("warm_ingest")   # first sample compiles the ingest program
     base = srv.aggregator.processed
     for ln in lines:
         srv.packet_queue.put(ln)
     _drain(srv, base + len(lines), timeout=WARM_TIMEOUT)
     for s in sinks:
         s.flushed.clear()
+    phase("warm_done")
 
 
 def _flush_checked(srv, timeout=FLUSH_WAIT):
@@ -185,6 +187,7 @@ def config1_counter_replay(scale=1.0):
                 s.close()
 
         for cycle in range(2):
+            phase(f"cycle{cycle}")
             base = srv.aggregator.processed
             t0 = time.perf_counter()
             threads = [threading.Thread(
@@ -255,6 +258,7 @@ def config2_zipf_timers(scale=1.0):
     try:
         _warm(srv, [b"warm.t:1.0|ms"], sinks=[sink])
         for cycle in range(2):   # first cycle compiles the size bucket
+            phase(f"cycle{cycle}")
             sink.flushed.clear()
             base = srv.aggregator.processed
             t0 = time.perf_counter()
@@ -319,6 +323,7 @@ def config3_set_cardinality(scale=1.0):
     try:
         _warm(srv, [b"warm.s:uid-w|s"], sinks=[sink])
         for cycle in range(2):   # first cycle compiles the size bucket
+            phase(f"cycle{cycle}")
             sink.flushed.clear()
             base = srv.aggregator.processed
             t0 = time.perf_counter()
@@ -404,6 +409,7 @@ def config4_global_merge(scale=1.0):
         client = ForwardClient(f"127.0.0.1:{glob.grpc_port}")
         n_metrics = sum(len(e) for e in exports)
         for cycle in range(2):   # first cycle compiles the size bucket
+            phase(f"cycle{cycle}")
             sink.flushed.clear()
             t0 = time.perf_counter()
             for e in exports:
@@ -485,6 +491,7 @@ def config5_span_firehose(scale=1.0):
                                     service="svc", name="warm",
                                     start_timestamp=1, end_timestamp=2)
         warm_span.tags["customer"] = "warm"
+        phase("warm_ingest")   # first span compiles the count-min update
         handle(parse_ssf(warm_span.SerializeToString()))
         t1 = time.time()
         while srv.tag_frequency.spans_seen < 1 and \
@@ -492,16 +499,20 @@ def config5_span_firehose(scale=1.0):
             time.sleep(0.02)
         srv.tag_frequency.flush()
         base = srv.tag_frequency.spans_seen
+        phase("warm_done")
 
         t0 = time.perf_counter()
         dropped0 = srv.span_pipeline.spans_dropped
+        phase("span_feed")
         for p in payloads:
             while not handle(parse_ssf(p)):   # retry on full channel
                 time.sleep(0.001)
+        phase("span_drain")
         t1 = time.time()
         while srv.tag_frequency.spans_seen - base < spans and \
                 time.time() - t1 < FLUSH_WAIT:
             time.sleep(0.05)
+        phase("sketch_flush")
         samples = srv.tag_frequency.flush()
         dt = time.perf_counter() - t0
 
@@ -592,6 +603,7 @@ def config6_cardinality_stress(scale=1.0):
             jax.block_until_ready(jax.tree.leaves(srv.aggregator.state))
 
         for cycle in range(2):      # cycle 0 absorbs every compile
+            phase(f"cycle{cycle}")
             done0 = srv.aggregator.processed + srv.aggregator.dropped_capacity
             h2d0 = srv.aggregator.h2d_bytes
             t0 = time.perf_counter()
@@ -679,6 +691,28 @@ def parse_last_json_line(stdout: str):
     return None
 
 
+def phase(name: str) -> None:
+    """Progress marker on stderr (`BENCHPHASE <name>`). The subprocess
+    orchestrators scrape the LAST marker out of a timed-out child's
+    captured stderr, turning an opaque "timeout after 1500s" into
+    "timeout ... at phase=timed_loop step 40/100" — the difference
+    between a diagnosable slow-tunnel run and round 3's mystery zero.
+    Markers are cheap (one line per pipeline phase, not per step)."""
+    print(f"BENCHPHASE {name}", file=sys.stderr, flush=True)
+
+
+def last_phase(stderr) -> str:
+    """Extract the last BENCHPHASE marker from captured child stderr
+    (str, bytes, or None — subprocess.TimeoutExpired.stderr is bytes)."""
+    if not stderr:
+        return "none"
+    if isinstance(stderr, bytes):
+        stderr = stderr.decode("utf-8", "replace")
+    marks = [ln[len("BENCHPHASE "):].strip()
+             for ln in stderr.splitlines() if ln.startswith("BENCHPHASE ")]
+    return marks[-1] if marks else "none"
+
+
 def _arm_init_watchdog(diag: dict):
     """os._exit(2) with one JSON diagnostic line if the backend doesn't
     come up inside INIT_TIMEOUT. Returns the timer to cancel on success."""
@@ -761,8 +795,10 @@ def _run_config_subprocess(n, scale, force_cpu=False):
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True,
                               cwd=repo, timeout=SUBPROC_TIMEOUT, env=env)
-    except subprocess.TimeoutExpired:
-        return {"config": n, "error": f"timeout after {SUBPROC_TIMEOUT:.0f}s"}
+    except subprocess.TimeoutExpired as e:
+        return {"config": n, "error":
+                f"timeout after {SUBPROC_TIMEOUT:.0f}s at "
+                f"phase={last_phase(e.stderr)}"}
     parsed = parse_last_json_line(proc.stdout)
     if parsed is not None:
         return parsed
@@ -786,6 +822,7 @@ def main(configs=None, scale=None, in_process=False, force_cpu=False,
     results = []
     for n in sorted(configs or CONFIGS):
         if in_process:
+            phase(f"config{n}_start")
             results.append(CONFIGS[n](scale))
         else:
             results.append(_run_config_subprocess(n, scale,
