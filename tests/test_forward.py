@@ -169,6 +169,14 @@ def test_proxy_routes_to_globals():
         # both globals got a share
         assert all(g.aggregator.processed > 0 for g in globs)
         assert proxy.forwarded == 40
+        # per-destination accounting (proxysrv/server.go:300
+        # metrics_by_destination): every forwarded metric is attributed
+        assert sum(proxy.metrics_by_destination.values()) == 40
+        assert all(proto == "grpc"
+                   for _, proto in proxy.metrics_by_destination)
+        # the globals count the import server's intake
+        # (importsrv/server.go:130 import.metrics_total)
+        assert sum(g.imported_total for g in globs) == 40
     finally:
         local.shutdown()
         proxy.stop()
@@ -386,3 +394,49 @@ def test_e2e_forwarding_indicator_metrics():
     finally:
         local.shutdown()
         glob.shutdown()
+
+
+def test_proxy_runtime_and_stats_emission():
+    """Proxy self-telemetry (proxy.go:656 ReportRuntimeMetrics,
+    :213-217 veneur_proxy. statsd namespace): runtime gauges carry the
+    reference names, and the stats ticker's packet stream delivers
+    runtime gauges + per-destination delta counters over UDP."""
+    import socket as sock_mod
+
+    p = ProxyServer(StaticDiscoverer(["127.0.0.1:1"]))
+    try:
+        rt = dict((n, (v, t)) for n, v, t in p.runtime_metrics())
+        assert set(rt) == {"mem.heap_alloc_bytes", "gc.number",
+                           "gc.alloc_heap_bytes"}
+        assert all(t == "g" for _, t in rt.values())
+        assert rt["mem.heap_alloc_bytes"][0] > 0
+
+        rx = sock_mod.socket(sock_mod.AF_INET, sock_mod.SOCK_DGRAM)
+        rx.bind(("127.0.0.1", 0))
+        rx.settimeout(5.0)
+        # seed counters as the forward paths would
+        p._count_dest("127.0.0.1:1", "grpc", 7)
+        p._count_dest("127.0.0.1:1", "http", 3)
+        p.errors = 2
+        p.start_stats("127.0.0.1:%d" % rx.getsockname()[1], interval=3600)
+        p.emit_stats_once()
+        lines = rx.recv(65536).split(b"\n")
+        by_name = {}
+        for ln in lines:
+            name, _, rest = ln.partition(b":")
+            by_name.setdefault(name, []).append(rest)
+        assert b"veneur_proxy.mem.heap_alloc_bytes" in by_name
+        assert b"veneur_proxy.gc.number" in by_name
+        counters = by_name[b"veneur_proxy.metrics_by_destination"]
+        assert any(b"7.0|c|#destination:127.0.0.1:1,protocol:grpc" in c
+                   for c in counters)
+        assert any(b"3.0|c|#destination:127.0.0.1:1,protocol:http" in c
+                   for c in counters)
+        assert by_name[b"veneur_proxy.forward.error_total"] == [b"2.0|c"]
+        # second emission: deltas, so unchanged counters go quiet
+        p.emit_stats_once()
+        lines2 = rx.recv(65536).split(b"\n")
+        assert not any(b"metrics_by_destination" in ln for ln in lines2)
+        rx.close()
+    finally:
+        p.stop()

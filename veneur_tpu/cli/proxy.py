@@ -39,6 +39,12 @@ def main(argv=None):
     proxy = ProxyServer(disc, service=service or "static",
                         refresh_interval=refresh)
     proxy.start(cfg.grpc_address)
+    if cfg.stats_address:
+        # runtime-metrics ticker to an external statsd daemon
+        # (reference proxy.go:213-217, :354-365 ReportRuntimeMetrics)
+        proxy.start_stats(
+            cfg.stats_address,
+            parse_duration(cfg.runtime_metrics_interval or "10s"))
     if cfg.http_address:
         # v1 HTTP routing surface (reference proxy.go:518): POST /import
         # consistent-hashes a JSONMetric array across the same ring

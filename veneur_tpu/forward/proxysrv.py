@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import bisect
 import logging
+import socket
 import threading
 from typing import Dict, List, Optional
 
@@ -74,6 +75,15 @@ class ProxyServer:
         self.http_port = None
         self.forwarded = 0
         self.errors = 0
+        # per-(destination, protocol) forwarded-metric counts — the
+        # reference's metrics_by_destination self-metric
+        # (proxysrv/server.go:299-301 grpc, proxy.go:651-653 http). The
+        # reference samples these at 10%; exact counts are strictly
+        # better and cost one dict add per batch.
+        self.metrics_by_destination: Dict[tuple, int] = {}
+        self._stats_thread = None
+        self._stats_sock = None
+        self._stats_last: Dict[tuple, int] = {}
         self.refresh()
 
     # -- ring maintenance ---------------------------------------------------
@@ -119,9 +129,16 @@ class ProxyServer:
             try:
                 self._conn(dest).send_metrics(batch)
                 self.forwarded += len(batch)
+                self._count_dest(dest, "grpc", len(batch))
             except Exception as e:
                 self.errors += len(batch)
                 log.warning("proxy forward to %s failed: %s", dest, e)
+
+    def _count_dest(self, dest: str, protocol: str, n: int) -> None:
+        with self._lock:
+            key = (dest, protocol)
+            self.metrics_by_destination[key] = \
+                self.metrics_by_destination.get(key, 0) + n
 
     # -- HTTP-era (v1) routing ----------------------------------------------
     def handle_json(self, json_metrics: List[dict]) -> Dict[str, List[dict]]:
@@ -155,6 +172,7 @@ class ProxyServer:
             try:
                 self._post_import(dest, batch)
                 self.forwarded += len(batch)
+                self._count_dest(dest, "http", len(batch))
             except Exception as e:
                 self.errors += len(batch)
                 log.warning("proxy POST to %s failed: %s", dest, e)
@@ -223,17 +241,79 @@ class ProxyServer:
             host, _, port = address.rpartition(":")
             if not host:
                 host, port = port, ""
-        import socket as _socket
 
         class _Server(http.server.ThreadingHTTPServer):
-            address_family = (_socket.AF_INET6 if ":" in host
-                              else _socket.AF_INET)
+            address_family = (socket.AF_INET6 if ":" in host
+                              else socket.AF_INET)
 
         httpd = _Server((host, int(port or 0)), Handler)
         self._http = httpd
         self.http_port = httpd.server_address[1]
         threading.Thread(target=httpd.serve_forever, daemon=True).start()
         return self.http_port
+
+    # -- self-telemetry -----------------------------------------------------
+    def runtime_metrics(self) -> List[tuple]:
+        """Process runtime gauges, the role of proxy.go:656
+        ReportRuntimeMetrics. The Go fields map to their CPython
+        equivalents: HeapAlloc -> current resident set size (the
+        live-memory measure a CPython process has), NumGC -> total
+        collections across gc generations. Go's PauseTotalNs has no
+        CPython counterpart (collections are not stop-the-world-timed)
+        and is deliberately not faked; gc.alloc_heap_bytes mirrors
+        mem.heap_alloc_bytes exactly as the reference emits HeapAlloc
+        under both names. Returns (name, value, type_char) tuples."""
+        import gc
+
+        from veneur_tpu.utils.statsd_emit import current_rss_bytes
+        rss = current_rss_bytes()
+        ngc = sum(s["collections"] for s in gc.get_stats())
+        return [("mem.heap_alloc_bytes", rss, "g"),
+                ("gc.number", float(ngc), "g"),
+                ("gc.alloc_heap_bytes", rss, "g")]
+
+    def start_stats(self, stats_address: str, interval: float = 10.0):
+        """Emit veneur_proxy.-namespaced self-metrics to a statsd daemon
+        on a ticker (proxy.go:213-217 statsd.New + Namespace, :354-365
+        runtime ticker): runtime gauges each tick, plus
+        metrics_by_destination / forward.error_total deltas."""
+        from veneur_tpu.utils.statsd_emit import parse_addr
+        self._stats_dest = parse_addr(stats_address)
+        self._stats_sock = socket.socket(socket.AF_INET,
+                                         socket.SOCK_DGRAM)
+        self._stats_interval = interval
+        self._stats_thread = threading.Thread(target=self._stats_loop,
+                                              daemon=True)
+        self._stats_thread.start()
+
+    def _stats_loop(self):
+        while not self._shutdown.wait(self._stats_interval):
+            try:
+                self.emit_stats_once()
+            except OSError as e:
+                log.warning("proxy stats emit failed: %s", e)
+
+    def emit_stats_once(self):
+        from veneur_tpu.utils.statsd_emit import format_line, send_lines
+        lines = [format_line("veneur_proxy." + n, v, t)
+                 for n, v, t in self.runtime_metrics()]
+        with self._lock:
+            counts = dict(self.metrics_by_destination)
+            counts[("", "error")] = self.errors
+        for key, total in counts.items():
+            delta = total - self._stats_last.get(key, 0)
+            self._stats_last[key] = total
+            if delta <= 0:
+                continue
+            dest, proto = key
+            if proto == "error":
+                lines.append(format_line(
+                    "veneur_proxy.forward.error_total", delta, "c"))
+            else:
+                lines.append(format_line(
+                    "veneur_proxy.metrics_by_destination", delta, "c",
+                    tags=f"destination:{dest},protocol:{proto}"))
+        send_lines(self._stats_sock, self._stats_dest, lines)
 
     # -- lifecycle ----------------------------------------------------------
     def start(self, address: str = "127.0.0.1:0"):
@@ -248,6 +328,8 @@ class ProxyServer:
 
     def stop(self):
         self._shutdown.set()
+        if self._stats_sock is not None:
+            self._stats_sock.close()
         if self._grpc is not None:
             self._grpc.stop(grace=1.0)
         if self._http is not None:

@@ -233,6 +233,7 @@ class Server:
         self.sink_flushes_skipped = 0
         self.parse_errors = 0
         self.import_errors = 0
+        self.imported_total = 0
         self.forward_errors = 0
         self._packets_received = 0
         self._packets_dropped_py = 0
@@ -342,6 +343,10 @@ class Server:
             self._handle_flush_request(item)
         elif isinstance(item, _ImportBatch):
             from veneur_tpu.forward.convert import import_into
+            # counted here on the single pipeline thread, not in the
+            # multi-threaded gRPC handler, so concurrent imports can't
+            # lose increments (importsrv/server.go:130 import.metrics_total)
+            self.imported_total += len(item)
             for metric in item:
                 try:
                     import_into(self.aggregator, metric)
@@ -394,6 +399,7 @@ class Server:
             "processed": self.aggregator.processed + 0,
             "dropped": self.aggregator.dropped_capacity,
             "import_errors": self.import_errors,
+            "imported_total": self.imported_total,
             "forward_errors": self.forward_errors,
             "spans_received": self.span_pipeline.spans_received,
             "intervals_deferred": self.flush_intervals_deferred,
@@ -1071,6 +1077,7 @@ class Server:
                "veneur.worker.metrics_processed_total": stats["processed"],
                "veneur.worker.metrics_dropped_total": stats["dropped"],
                "veneur.import.errors_total": stats["import_errors"],
+               "veneur.import.metrics_total": stats.get("imported_total", 0),
                # the reference tags forward.error_total with a cause
                # (deadline_exceeded/post, flusher.go:512-524); the delta
                # counter here is untagged — the log line carries the why
@@ -1113,15 +1120,16 @@ class Server:
         if not self.cfg.stats_address:
             return
         from veneur_tpu.proto import ssf_pb2
-        type_ch = {ssf_pb2.SSFSample.COUNTER: b"c",
-                   ssf_pb2.SSFSample.GAUGE: b"g",
-                   ssf_pb2.SSFSample.HISTOGRAM: b"h"}
+        from veneur_tpu.utils.statsd_emit import (
+            format_line, parse_addr, send_lines)
+        type_ch = {ssf_pb2.SSFSample.COUNTER: "c",
+                   ssf_pb2.SSFSample.GAUGE: "g",
+                   ssf_pb2.SSFSample.HISTOGRAM: "h"}
         try:
             if self._stats_sock is None:
                 # resolve + create once (reference dials its statsd
                 # client at construction, server.go:297)
-                host, _, port = self.cfg.stats_address.rpartition(":")
-                self._stats_dest = (host or "127.0.0.1", int(port))
+                self._stats_dest = parse_addr(self.cfg.stats_address)
                 self._stats_sock = socket.socket(socket.AF_INET,
                                                  socket.SOCK_DGRAM)
             lines = []
@@ -1131,14 +1139,8 @@ class Server:
                     continue
                 tags = ",".join(f"{k}:{v}" if v else k
                                 for k, v in sorted(s.tags.items()))
-                line = b"%s:%s|%s" % (s.name.encode(),
-                                      repr(float(s.value)).encode(), ch)
-                if tags:
-                    line += b"|#" + tags.encode()
-                lines.append(line)
-            for i in range(0, len(lines), 25):
-                self._stats_sock.sendto(b"\n".join(lines[i:i + 25]),
-                                        self._stats_dest)
+                lines.append(format_line(s.name, s.value, ch, tags))
+            send_lines(self._stats_sock, self._stats_dest, lines)
         except (OSError, ValueError) as e:
             log.warning("stats_address emit failed: %s", e)
 
