@@ -86,7 +86,13 @@ def main():
         return
     import subprocess
     here = os.path.dirname(os.path.abspath(__file__))
-    budget = float(os.environ.get("BENCH_KERNEL_TIMEOUT", "1500"))
+    # Observed 2026-07-31: a healthy-but-slow tunnel ran the TPU kernel
+    # child >22 min (remote compiles + per-dispatch RTT) — a 25-min cap
+    # would kill a run that was about to report. 35 min per attempt keeps
+    # a real slow run alive; the CPU-smoke floor is already banked first,
+    # and every completed stage checkpoints, so the extra patience risks
+    # nothing on a dead tunnel.
+    budget = float(os.environ.get("BENCH_KERNEL_TIMEOUT", "2100"))
     out = {"metric": "aggregation_samples_per_sec_per_chip_1M_keys",
            "value": 0, "unit": "samples/sec", "vs_baseline": 0}
     from benchmarks.e2e import cache_env, last_phase, parse_last_json_line
@@ -139,7 +145,7 @@ def main():
 
     if want_tpu:   # even a failed CPU floor must not veto a healthy TPU
         retry_budget = float(os.environ.get("BENCH_TUNNEL_RETRY_BUDGET",
-                                            "1800"))
+                                            "2400"))
         retry_sleep = float(os.environ.get("BENCH_TUNNEL_RETRY_SLEEP",
                                            "120"))
         deadline = time.monotonic() + retry_budget

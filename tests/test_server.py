@@ -99,7 +99,7 @@ def test_udp_ingest_to_flush(server):
     sink.flushed.clear()
     srv.trigger_flush()
     assert not [m for m in sink.flushed
-                if not (m.name.startswith("veneur.")
+                if not (m.name.startswith(("veneur.", "sink.", "worker."))
                         or m.name == "ssf.names_unique")]
 
 
@@ -455,3 +455,49 @@ def test_synchronized_ticker_aligns_first_flush():
         assert frac < 0.25 or frac > 0.75, frac
     finally:
         srv.shutdown()
+
+
+def test_sink_flush_conventions_reported():
+    """The per-sink conventions of sinks/sinks.go:11-29 — measured
+    centrally by the flush fan-out and the span worker, so no sink can
+    forget them: sink.metrics_flushed_total + flush duration per metric
+    sink, spans_flushed/ingest-duration per span sink, all tagged
+    sink:<name> and mirrored to stats_address."""
+    ext = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    ext.bind(("127.0.0.1", 0))
+    ext.settimeout(5.0)
+    from veneur_tpu.sinks.debug import DebugSpanSink
+    ssink = DebugSpanSink()
+    srv = Server(small_config(
+        stats_address=f"127.0.0.1:{ext.getsockname()[1]}"),
+        metric_sinks=[DebugMetricSink()], span_sinks=[ssink])
+    srv.start()
+    try:
+        _send_udp(srv.local_addr(), [b"conv.count:1|c"])
+        _wait_processed(srv, 1)
+        from veneur_tpu.proto import ssf_pb2
+        span = ssf_pb2.SSFSpan(version=0, id=3, trace_id=3, name="s",
+                               service="svc", start_timestamp=1,
+                               end_timestamp=2)
+        srv.span_pipeline.handle_span(span)
+        deadline = time.time() + 10
+        while time.time() < deadline and not ssink.spans:
+            time.sleep(0.02)
+        assert srv.trigger_flush()
+        got = b""
+        deadline = time.time() + 10
+        want = (b"sink.metrics_flushed_total", b"sink:debug",
+                b"sink.metric_flush_total_duration_ns",
+                b"sink.spans_flushed_total",
+                b"worker.span.flush_duration_ns",
+                b"sink.span_ingest_total_duration_ns")
+        while time.time() < deadline and not all(w in got for w in want):
+            try:
+                got += ext.recv(65536) + b"\n"
+            except socket.timeout:
+                break
+        for w in want:
+            assert w in got, (w, got[-1500:])
+    finally:
+        srv.shutdown()
+        ext.close()

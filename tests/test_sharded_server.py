@@ -62,7 +62,7 @@ def test_sharded_flush_resets(sharded_server):
     # back through the span pipeline and may sample ssf.names_unique); only
     # app metrics must be gone after a flush.
     assert not [x for x in sink.flushed
-                if not (x.name.startswith("veneur.")
+                if not (x.name.startswith(("veneur.", "sink.", "worker."))
                         or x.name == "ssf.names_unique")]
 
 

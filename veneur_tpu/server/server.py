@@ -202,7 +202,8 @@ class Server:
             [extraction] + self.span_sinks,
             capacity=cfg.span_channel_capacity or 100,
             num_workers=max(1, cfg.num_span_workers),
-            common_tags=common_tags)
+            common_tags=common_tags,
+            report_samples=self._report_span_worker_samples)
         # after the span pipeline exists: exclusion rules wire BOTH sink
         # kinds (server.go:1467 setSinkExcludedTags)
         self._wire_excluded_tags()
@@ -234,6 +235,10 @@ class Server:
         self.parse_errors = 0
         self.import_errors = 0
         self.imported_total = 0
+        # per-metric-sink flush accounting for the sink.* conventions
+        # (sinks/sinks.go:11-29), accumulated by sink flush threads
+        self._sink_stats_lock = threading.Lock()
+        self._sink_flush_stats: dict = {}
         self.forward_errors = 0
         self._packets_received = 0
         self._packets_dropped_py = 0
@@ -242,8 +247,22 @@ class Server:
         # packets_received/packets_dropped reads on the flush thread
         self._reader_fold_lock = threading.Lock()
         self._shutdown = threading.Event()
+        # created eagerly when configured: _emit_stats_address is called
+        # from both the flush worker and the span-flush thread, and a
+        # lazy-init race would leak a socket
         self._stats_sock: Optional[socket.socket] = None
         self._stats_dest = None
+        if cfg.stats_address:
+            from veneur_tpu.utils.statsd_emit import parse_addr
+            try:
+                self._stats_dest = parse_addr(cfg.stats_address)
+                self._stats_sock = socket.socket(socket.AF_INET,
+                                                 socket.SOCK_DGRAM)
+            except ValueError as e:
+                # a typo'd stats_address degrades the mirror, never the
+                # server (the lazy path tolerated this; keep that)
+                log.warning("bad stats_address %r: %s; stats mirror "
+                            "disabled", cfg.stats_address, e)
         self._unix_locks: List[tuple] = []   # (lock_fd, lock_path, sock_path)
         self._threads: List[threading.Thread] = []
         self._pipeline_thread: Optional[threading.Thread] = None
@@ -402,6 +421,7 @@ class Server:
             "imported_total": self.imported_total,
             "forward_errors": self.forward_errors,
             "spans_received": self.span_pipeline.spans_received,
+            "span_chan_cap_hits": self.span_pipeline.chan_cap_hits,
             "intervals_deferred": self.flush_intervals_deferred,
             "sink_flushes_skipped": self.sink_flushes_skipped,
         }
@@ -1087,7 +1107,9 @@ class Server:
                    stats["intervals_deferred"],
                "veneur.flush.sink_flushes_skipped_total":
                    stats.get("sink_flushes_skipped", 0),
-               "veneur.spans_received_total": stats["spans_received"]}
+               "veneur.spans_received_total": stats["spans_received"],
+               "veneur.worker.span.hit_chan_cap":
+                   stats.get("span_chan_cap_hits", 0)}
         samples = [ssf_samples.timing("veneur.flush.total_duration_ns",
                                       flush_seconds),
                    ssf_samples.gauge("veneur.flush.metrics_total",
@@ -1103,6 +1125,19 @@ class Server:
                 "veneur.flush.unique_timeseries_total", self._unique_ts,
                 {"global_veneur": str(not self.cfg.is_local).lower()}))
             self._unique_ts = None
+        # per-metric-sink conventions, measured centrally by the fan-out
+        # (sinks/sinks.go:11-24; the previous interval's threads that
+        # outlived the barrier settle into the NEXT interval's report)
+        with self._sink_stats_lock:
+            sink_stats, self._sink_flush_stats = self._sink_flush_stats, {}
+        for name, (rows, total_ns) in sink_stats.items():
+            tags = {"sink": name}
+            if rows:
+                samples.append(ssf_samples.count(
+                    "sink.metrics_flushed_total", rows, tags))
+            samples.append(ssf_samples.timing(
+                "sink.metric_flush_total_duration_ns", total_ns / 1e9,
+                tags))
         for name, total in cur.items():
             delta = total - self._last_stats.get(name, 0)
             self._last_stats[name] = total
@@ -1112,26 +1147,30 @@ class Server:
         report_batch(self.trace_client, samples)
         self._emit_stats_address(samples)
 
+    def _report_span_worker_samples(self, samples) -> None:
+        """Span-worker per-sink telemetry (worker.go:706-713), reported
+        through the same normalize → pipeline → stats-mirror path as the
+        flush self-metrics. Called from the flush worker's span-flush
+        thread; everything downstream is thread-safe (channel client,
+        UDP sendto)."""
+        from veneur_tpu.trace.client import report_batch
+        self._normalize_self_samples(samples)
+        report_batch(self.trace_client, samples)
+        self._emit_stats_address(samples)
+
     def _emit_stats_address(self, samples) -> None:
         """Mirror self-metrics to an external statsd daemon when
         stats_address is configured (reference server.go:297 statsd.New +
         scopedstatsd — operators often point this at a plain DogStatsD
         agent, separate from the in-pipeline loop-back)."""
-        if not self.cfg.stats_address:
-            return
+        if self._stats_sock is None:   # unconfigured, bad address, or
+            return                     # already closed by shutdown
         from veneur_tpu.proto import ssf_pb2
-        from veneur_tpu.utils.statsd_emit import (
-            format_line, parse_addr, send_lines)
+        from veneur_tpu.utils.statsd_emit import format_line, send_lines
         type_ch = {ssf_pb2.SSFSample.COUNTER: "c",
                    ssf_pb2.SSFSample.GAUGE: "g",
                    ssf_pb2.SSFSample.HISTOGRAM: "h"}
         try:
-            if self._stats_sock is None:
-                # resolve + create once (reference dials its statsd
-                # client at construction, server.go:297)
-                self._stats_dest = parse_addr(self.cfg.stats_address)
-                self._stats_sock = socket.socket(socket.AF_INET,
-                                                 socket.SOCK_DGRAM)
             lines = []
             for s in samples:
                 ch = type_ch.get(s.metric)
@@ -1196,6 +1235,8 @@ class Server:
         """metrics is a List[InterMetric] or a flusher.MetricFrame —
         frames only reach sinks that declared accepts_frames."""
         span = parent.child(f"flush.sink.{sink.name}") if parent else None
+        t0 = time.perf_counter_ns()
+        ok = True
         try:
             from veneur_tpu.server.flusher import MetricFrame
             if isinstance(metrics, MetricFrame):
@@ -1203,10 +1244,21 @@ class Server:
             else:
                 sink.flush(metrics)
         except Exception as e:
+            ok = False
             if span is not None:
                 span.error = True
             log.warning("sink %s flush failed: %s", sink.name, e)
         finally:
+            # the centrally-measured sink.* conventions
+            # (sinks/sinks.go:11-24: metrics_flushed_total +
+            # metric_flush_total_duration_ns, tagged sink:<name>) — the
+            # fan-out wraps every sink, so no sink can forget to emit
+            ns = time.perf_counter_ns() - t0
+            with self._sink_stats_lock:
+                rows, total_ns = self._sink_flush_stats.get(
+                    sink.name, (0, 0))
+                self._sink_flush_stats[sink.name] = (
+                    rows + (len(metrics) if ok else 0), total_ns + ns)
             if span is not None:
                 span.client_finish(self.trace_client)
 
@@ -1279,6 +1331,9 @@ class Server:
         # /import, gRPC import
         self.trace_client.close()
         self.span_pipeline.stop()
+        if self._stats_sock is not None:
+            self._stats_sock.close()   # eagerly created in __init__
+            self._stats_sock = None
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()  # release the listening fd

@@ -12,7 +12,8 @@ from __future__ import annotations
 import logging
 import queue
 import threading
-from typing import List
+import time
+from typing import Callable, List, Optional
 
 from veneur_tpu.protocol.wire import valid_trace
 
@@ -21,16 +22,29 @@ log = logging.getLogger("veneur_tpu.server.spans")
 
 class SpanPipeline:
     def __init__(self, span_sinks: List, capacity: int = 100,
-                 num_workers: int = 1, common_tags=None):
+                 num_workers: int = 1, common_tags=None,
+                 report_samples: Optional[Callable] = None):
+        """report_samples: callable taking a list of SSFSamples — the span
+        worker reports its own per-sink telemetry at flush, exactly as the
+        reference's SpanWorker.Flush does through its statsd client
+        (worker.go:698-713)."""
         self.span_sinks = list(span_sinks)
         self.chan: "queue.Queue" = queue.Queue(maxsize=capacity)
         self.num_workers = max(1, num_workers)
         self.common_tags = dict(common_tags or {})
+        self.report_samples = report_samples
         self.spans_received = 0
         self.spans_dropped = 0
+        self.chan_cap_hits = 0
         self.sink_errors = 0
         self._threads: List[threading.Thread] = []
         self._stop = object()
+        # per-sink ingest accounting since the last flush, accumulated by
+        # worker threads under a lock (the reference uses per-sink atomics,
+        # worker.go:617-690 cumulativeTimes)
+        self._stats_lock = threading.Lock()
+        self._ingest_ns: dict = {}
+        self._ingested: dict = {}
 
     # -- intake (server.go:1022 handleSSF) ----------------------------------
     def handle_span(self, span) -> bool:
@@ -42,6 +56,7 @@ class SpanPipeline:
             return True
         except queue.Full:
             self.spans_dropped += 1
+            self.chan_cap_hits += 1   # worker.go:717 hit_chan_cap
             return False
 
     # -- workers (worker.go:611 SpanWorker.Work) ----------------------------
@@ -88,11 +103,13 @@ class SpanPipeline:
             if not spans:
                 continue
             for sink in self.span_sinks:
+                t0 = time.perf_counter_ns()
                 many = getattr(sink, "ingest_many", None)
+                delivered = False
                 if many is not None:
                     try:
                         many(spans)
-                        continue
+                        delivered = True
                     except Exception as e:
                         # fall through to per-span delivery so one bad
                         # span can't take the other 255 with it;
@@ -101,21 +118,65 @@ class SpanPipeline:
                         # stay exactly-once
                         log.warning("span sink %s ingest_many failed, "
                                     "retrying per-span: %s", sink.name, e)
-                for span in spans:
-                    try:
-                        sink.ingest(span)
-                    except Exception as e:
-                        self.sink_errors += 1
-                        log.warning("span sink %s ingest failed: %s",
-                                    sink.name, e)
+                if delivered:
+                    ok_spans = len(spans)
+                else:
+                    ok_spans = 0
+                    for span in spans:
+                        try:
+                            sink.ingest(span)
+                            ok_spans += 1
+                        except Exception as e:
+                            self.sink_errors += 1
+                            log.warning("span sink %s ingest failed: %s",
+                                        sink.name, e)
+                with self._stats_lock:
+                    self._ingest_ns[sink.name] = (
+                        self._ingest_ns.get(sink.name, 0)
+                        + time.perf_counter_ns() - t0)
+                    # only successfully-ingested spans count toward
+                    # sink.spans_flushed_total — a dead sink must not
+                    # look healthy on dashboards
+                    self._ingested[sink.name] = (
+                        self._ingested.get(sink.name, 0) + ok_spans)
 
     def flush(self):
-        """worker.go:698 SpanWorker.Flush: flush every span sink."""
+        """worker.go:698 SpanWorker.Flush: flush every span sink, timing
+        each, then report the per-sink conventions the reference's span
+        worker emits (worker.go:706-713): worker.span.flush_duration_ns,
+        sink.span_ingest_total_duration_ns (cumulative since last flush),
+        and sink.spans_flushed_total (measured centrally as spans
+        delivered to the sink — a sampling sink may send fewer downstream,
+        which its own telemetry covers)."""
+        with self._stats_lock:
+            ing_ns, self._ingest_ns = self._ingest_ns, {}
+            ing_n, self._ingested = self._ingested, {}
+        samples = []
         for sink in self.span_sinks:
+            t0 = time.perf_counter_ns()
             try:
                 sink.flush()
             except Exception as e:
                 log.warning("span sink %s flush failed: %s", sink.name, e)
+            if self.report_samples is None:
+                continue
+            from veneur_tpu.samplers import ssf_samples
+            tags = {"sink": sink.name}
+            samples.append(ssf_samples.timing(
+                "worker.span.flush_duration_ns",
+                (time.perf_counter_ns() - t0) / 1e9, tags))
+            samples.append(ssf_samples.timing(
+                "sink.span_ingest_total_duration_ns",
+                ing_ns.get(sink.name, 0) / 1e9, tags))
+            n = ing_n.get(sink.name, 0)
+            if n:
+                samples.append(ssf_samples.count(
+                    "sink.spans_flushed_total", n, tags))
+        if samples and self.report_samples is not None:
+            try:
+                self.report_samples(samples)
+            except Exception as e:
+                log.warning("span worker self-report failed: %s", e)
 
     def stop(self):
         for _ in self._threads:
