@@ -34,6 +34,20 @@ def _timeit(fn, seconds: float, batch: int = 1):
     return ops, dt / ops * 1e9
 
 
+def _warm_through_dispatch(agg, fn, calls: int):
+    """Warm a staging-path micro PAST its first device dispatch: a single
+    warmup call stages samples but doesn't fill a batch, so the first
+    dispatch — and its XLA compile, seconds on a cold process — would
+    otherwise land inside the timed loop (measured 60x inflation on
+    worker_ingest at a 0.5s budget). `calls` must stage more than one
+    full batch; the barrier then forces the compile+execute to finish
+    before timing starts."""
+    for _ in range(calls):
+        fn()
+    import jax
+    jax.block_until_ready(jax.tree.leaves(agg.state))
+
+
 # -- parse (parser_test.go:818 BenchmarkParseMetric / :805 ParseSSF) ---------
 
 def bench_parse_metric(seconds):
@@ -123,6 +137,9 @@ def bench_worker_ingest(seconds):
         for m in metrics:
             agg.process_metric(m)
 
+    # counter batch cap is 2^14; 17 calls x 1000 forces the first
+    # dispatch (+ compile) before the clock starts
+    _warm_through_dispatch(agg, run, 17)
     return _timeit(run, seconds, batch=len(metrics))
 
 
@@ -218,6 +235,11 @@ def bench_import_metrics(seconds):
         for m in exported:
             import_into(dst, m)
 
+    # 45 calls x 200 counters = 9000 > the 2^13 counter lane on its own
+    # (the histo lane, bulk-staging k cells per timer, fills earlier
+    # still) — warmup must force a dispatch regardless of which lane
+    # wins, so first-dispatch compiles precede the clock
+    _warm_through_dispatch(dst, run, 45)
     return _timeit(run, seconds, batch=len(exported))
 
 
