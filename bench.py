@@ -175,8 +175,21 @@ def main():
                 host["error"] = (f"rc={proc.returncode}: "
                                  f"{proc.stderr.strip()[-200:]}")
             out["host_micro_ops_per_sec"] = host
-        except subprocess.TimeoutExpired:
-            out["host_micro_ops_per_sec"] = {"error": "timeout after 420s"}
+        except subprocess.TimeoutExpired as e:
+            # completed micros already printed their rows — keep them
+            # next to the error (partial beats none, as everywhere here)
+            host = {"error": "timeout after 420s"}
+            stdout = e.stdout or ""
+            if isinstance(stdout, bytes):
+                stdout = stdout.decode("utf-8", "replace")
+            for line in stdout.splitlines():
+                try:
+                    row = json.loads(line)
+                except ValueError:
+                    continue
+                if "ops_per_sec" in row:
+                    host[row["bench"]] = row["ops_per_sec"]
+            out["host_micro_ops_per_sec"] = host
         checkpoint()
 
     if want_tpu:   # even a failed CPU floor must not veto a healthy TPU

@@ -137,9 +137,10 @@ def bench_worker_ingest(seconds):
         for m in metrics:
             agg.process_metric(m)
 
-    # counter batch cap is 2^14; 17 calls x 1000 forces the first
+    # enough calls to overfill the counter batch lane, forcing the first
     # dispatch (+ compile) before the clock starts
-    _warm_through_dispatch(agg, run, 17)
+    _warm_through_dispatch(agg, run,
+                           agg.bspec.counter // len(metrics) + 2)
     return _timeit(run, seconds, batch=len(metrics))
 
 
@@ -217,7 +218,8 @@ def bench_import_metrics(seconds):
     bspec = BatchSpec(counter=1 << 13, histo=1 << 13)
     src = Aggregator(spec, bspec)
     rng = np.random.default_rng(0)
-    for c in range(200):
+    n_counters = 200
+    for c in range(n_counters):
         src.process_metric(parser.parse_metric(
             b"i.c.%d:%d|c|#veneurglobalonly" % (c, c)))
     for h in range(50):
@@ -235,11 +237,12 @@ def bench_import_metrics(seconds):
         for m in exported:
             import_into(dst, m)
 
-    # 45 calls x 200 counters = 9000 > the 2^13 counter lane on its own
-    # (the histo lane, bulk-staging k cells per timer, fills earlier
-    # still) — warmup must force a dispatch regardless of which lane
-    # wins, so first-dispatch compiles precede the clock
-    _warm_through_dispatch(dst, run, 45)
+    # overfill the counter lane on its own (the histo lane, bulk-staging
+    # k cells per timer, fills earlier still) — warmup must force a
+    # dispatch regardless of which lane wins, so first-dispatch compiles
+    # precede the clock; derived from the spec so a BatchSpec change
+    # can't silently re-admit the compile into the timed loop
+    _warm_through_dispatch(dst, run, dst.bspec.counter // n_counters + 2)
     return _timeit(run, seconds, batch=len(exported))
 
 

@@ -32,6 +32,8 @@ import time
 from typing import Optional, Union
 
 from veneur_tpu.proto import ssf_pb2
+from veneur_tpu.protocol.wire import valid_trace
+from veneur_tpu.samplers import ssf_samples
 from veneur_tpu.utils.hashing import FNV32_OFFSET, FNV32_PRIME
 
 # MetricScope (reference parser.go:66-70)
@@ -111,6 +113,9 @@ def _strip_magic_tags(tags: list) -> tuple:
 # costs a re-warm, not memory.
 _KEY_CACHE: dict = {}
 _KEY_CACHE_MAX = 1 << 16
+# same idea for the SSF converter (parse_metric_ssf): digest keyed by
+# (name, type, joined_tags), bounded by wholesale clear
+_SSF_DIGEST_CACHE: dict = {}
 
 
 def _key_info(name_b: bytes, mtype: str, tags_chunk):
@@ -397,8 +402,6 @@ def parse_metric_ssf(sample: ssf_pb2.SSFSample) -> UDPMetric:
     if mtype is None:
         raise ParseError("invalid type for metric")
     m = UDPMetric(type=mtype, name=sample.name)
-    h = _fnv_add(FNV32_OFFSET, sample.name.encode("utf-8", "surrogateescape"))
-    h = _fnv_add(h, mtype.encode())
 
     if sample.metric == ssf_pb2.SSFSample.SET:
         m.value = sample.message
@@ -425,7 +428,20 @@ def parse_metric_ssf(sample: ssf_pb2.SSFSample) -> UDPMetric:
     tags.sort()
     m.tags = tuple(tags)
     m.joined_tags = ",".join(tags)
-    h = _fnv_add(h, m.joined_tags.encode("utf-8", "surrogateescape"))
+    # the three sequential per-byte FNV passes dominate this converter's
+    # pure-Python cost (the dogstatsd text path caches the same way,
+    # _key_info above); extraction workloads repeat (name, type, tags)
+    # shapes heavily — SLI timers vary only by service/error tags
+    ck = (m.name, mtype, m.joined_tags)
+    h = _SSF_DIGEST_CACHE.get(ck)
+    if h is None:
+        h = _fnv_add(FNV32_OFFSET,
+                     m.name.encode("utf-8", "surrogateescape"))
+        h = _fnv_add(h, mtype.encode())
+        h = _fnv_add(h, m.joined_tags.encode("utf-8", "surrogateescape"))
+        if len(_SSF_DIGEST_CACHE) >= _KEY_CACHE_MAX:
+            _SSF_DIGEST_CACHE.clear()
+        _SSF_DIGEST_CACHE[ck] = h
     m.digest = h
     return m
 
@@ -459,9 +475,6 @@ def convert_indicator_metrics(span, indicator_timer_name: str,
     service/error, and an objective timer additionally tagged with the
     span name (overridable via the ssf_objective tag) and
     veneurglobalonly."""
-    from veneur_tpu.protocol.wire import valid_trace
-    from veneur_tpu.samplers import ssf_samples
-
     if not span.indicator or not valid_trace(span):
         return []
     duration_s = (span.end_timestamp - span.start_timestamp) / 1e9
@@ -485,8 +498,6 @@ def convert_indicator_metrics(span, indicator_timer_name: str,
 def convert_span_uniqueness_metrics(span, rate: float = 0.01):
     """Unique span-name Sets per service at a sampling rate (reference
     parser.go:187 ConvertSpanUniquenessMetrics)."""
-    from veneur_tpu.samplers import ssf_samples
-
     if not span.service:
         return []
     samples = ssf_samples.randomly_sample(
