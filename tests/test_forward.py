@@ -14,7 +14,8 @@ from veneur_tpu.forward.proxysrv import HashRing, ProxyServer
 from veneur_tpu.server.server import Server
 from veneur_tpu.sinks.debug import DebugMetricSink
 
-from tests.test_server import by_name, small_config, _send_udp, _wait_processed
+from tests.test_server import (by_name, small_config, _send_udp,
+                               _wait_processed, _wait_until)
 
 
 @pytest.fixture
@@ -36,9 +37,8 @@ def tier():
 
 def _flush_through(local, glob):
     local.trigger_flush()
-    deadline = time.time() + 10
-    while time.time() < deadline and glob.aggregator.processed == 0:
-        time.sleep(0.05)
+    _wait_until(lambda: glob.aggregator.processed > 0,
+                what="global import of forwarded metrics")
     glob.trigger_flush()
 
 
@@ -118,9 +118,8 @@ def test_two_locals_merge_on_global():
         for srv in locals_:
             srv.trigger_flush()
         # each local forwards one counter + one timer import
-        deadline = time.time() + 10
-        while time.time() < deadline and glob.aggregator.processed < 4:
-            time.sleep(0.05)
+        _wait_until(lambda: glob.aggregator.processed >= 4,
+                    what="global import of 4 forwarded metrics")
         glob.trigger_flush()
         g = by_name(gsink.flushed)
         assert g["multi.count"].value == 200.0  # 2*50 per local, 2 locals
@@ -156,10 +155,9 @@ def test_proxy_routes_to_globals():
         _send_udp(local.local_addr(), lines)
         _wait_processed(local, 40)
         local.trigger_flush()
-        deadline = time.time() + 10
-        while (time.time() < deadline
-               and sum(g.aggregator.processed for g in globs) < 40):
-            time.sleep(0.05)
+        _wait_until(
+            lambda: sum(g.aggregator.processed for g in globs) >= 40,
+            what="40 forwarded metrics across the global ring")
         for g in globs:
             g.trigger_flush()
         names = set()
@@ -333,16 +331,14 @@ def test_forward_bad_address_never_blocks_local_flush():
     try:
         srv.packet_queue.put(b"local.c:7|c")       # mixed counter: local
         srv.packet_queue.put(b"fwd.t:3|ms")        # mixed timer: forwarded
-        deadline = time.time() + 20
-        while time.time() < deadline and srv.aggregator.processed < 2:
-            time.sleep(0.05)
+        _wait_until(lambda: srv.aggregator.processed >= 2,
+                    what="2 mixed-scope metrics processed")
         assert srv.trigger_flush(timeout=30)
         got = {m.name: m.value for m in sink.flushed}
         assert got.get("local.c") == 7.0           # local flush unharmed
-        deadline = time.time() + 20                # forward is fire+forget
-        while time.time() < deadline and srv.forward_errors < 1:
-            time.sleep(0.05)
-        assert srv.forward_errors >= 1
+        # forward is fire-and-forget; the error lands asynchronously
+        _wait_until(lambda: srv.forward_errors >= 1,
+                    what="async forward error recorded")
         # the async error lands after interval 1's stats snapshot; the
         # NEXT snapshot reports the delta into the pipeline, and the
         # flush after whichever interval ingested it delivers to sinks —

@@ -12,7 +12,8 @@ from veneur_tpu.config import Config
 from veneur_tpu.server.server import Server
 from veneur_tpu.sinks.debug import DebugMetricSink
 
-from tests.test_server import by_name, small_config, _wait_processed
+from tests.test_server import (by_name, small_config, _wait_processed,
+                               _wait_until)
 
 
 def _statsd_server(addr, **kw):
@@ -140,12 +141,9 @@ def test_ssf_unixgram_and_stream(tmp_path):
         c.sendall(buf.getvalue())
         c.close()
 
-        deadline = time.time() + 10
-        while time.time() < deadline:
-            if {s_.name for s_ in ssink.spans} >= {"op1", "op2"}:
-                break
-            time.sleep(0.05)
-        assert {s_.name for s_ in ssink.spans} >= {"op1", "op2"}
+        _wait_until(
+            lambda: {s_.name for s_ in ssink.spans} >= {"op1", "op2"},
+            what="both spans through datagram+stream listeners")
     finally:
         srv.shutdown()
 
@@ -204,14 +202,12 @@ def test_udp_toolong_datagram_dropped_and_counted():
             assert len(at) == 31
             s.sendto(at, srv.local_addr(0))
             s.sendto(b"ok:1|c", srv.local_addr(0))   # under the limit
-            deadline = time.time() + 15
-            while time.time() < deadline and srv.aggregator.processed < 2:
-                time.sleep(0.05)
+            _wait_until(lambda: srv.aggregator.processed >= 2,
+                        what=f"2 short packets (native={native_ingest})")
             time.sleep(0.2)   # give the long packets time to (not) land
             assert srv.aggregator.processed == 2, native_ingest
-            deadline = time.time() + 10
-            while time.time() < deadline and srv.packets_toolong < 2:
-                time.sleep(0.05)
+            _wait_until(lambda: srv.packets_toolong >= 2,
+                        what=f"2 toolong drops (native={native_ingest})")
             assert srv.packets_toolong == 2, native_ingest
         finally:
             srv.shutdown()

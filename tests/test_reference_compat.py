@@ -15,7 +15,6 @@ import base64
 import gzip
 import json
 import os
-import time
 import urllib.error
 import urllib.request
 import zlib
@@ -139,9 +138,9 @@ def test_http_import_reference_body_end_to_end(http_server):
     url = f"http://127.0.0.1:{srv.http_port}/import"
     assert _post(url, fixture("import.uncompressed")) == 202
     assert _post(url, fixture("import.deflate"), "deflate") == 202
-    deadline = time.time() + 10
-    while time.time() < deadline and srv.aggregator.processed < 2:
-        time.sleep(0.05)
+    from tests.test_server import _wait_until
+    _wait_until(lambda: srv.aggregator.processed >= 2,
+                what="import of 2 fixture metrics")
     assert srv.trigger_flush()
     by_name = {m.name: m.value for m in sink.flushed}
     # two identical digests merged: count 10, p50 by midpoint convention
@@ -199,7 +198,7 @@ def test_http_forward_json_gob_sketches_end_to_end():
     HTTPForwardClient): digests and HLLs must survive the gob/axiomhq
     round-trip into a global and flush correct percentiles/estimates."""
     from tests.test_server import (
-        by_name, small_config, _send_udp, _wait_processed)
+        by_name, small_config, _send_udp, _wait_processed, _wait_until)
     from veneur_tpu.server.server import Server
     from veneur_tpu.sinks.debug import DebugMetricSink
 
@@ -221,9 +220,8 @@ def test_http_forward_json_gob_sketches_end_to_end():
                   + [b"jg.count:9|c|#veneurglobalonly"])
         _wait_processed(local, 141)
         assert local.trigger_flush()
-        deadline = time.time() + 10
-        while time.time() < deadline and glob.aggregator.processed < 3:
-            time.sleep(0.05)
+        _wait_until(lambda: glob.aggregator.processed >= 3,
+                    what="global import of 3 forwarded metrics")
         assert glob.trigger_flush()
         g = by_name(gsink.flushed)
         assert g["jg.count"].value == 9.0

@@ -48,7 +48,7 @@ def _total_parse_errors(srv):
     return srv.parse_errors + srv.aggregator.extra_parse_errors()
 
 
-def _wait_processed(srv, n, timeout=10.0):
+def _wait_processed(srv, n, timeout=60.0):
     t0 = time.time()
     while time.time() - t0 < timeout:
         if srv.aggregator.processed + _total_parse_errors(srv) >= n:
@@ -56,6 +56,19 @@ def _wait_processed(srv, n, timeout=10.0):
         time.sleep(0.02)
     raise TimeoutError(
         f"only {srv.aggregator.processed} processed after {timeout}s")
+
+
+def _wait_until(cond, timeout=60.0, what="condition"):
+    """Poll until cond() holds; raise a diagnosable TimeoutError instead
+    of letting the caller proceed into an opaque assert. Timeouts are
+    sized for a loaded host (a sharded flush can pay a fresh mesh
+    compile); a passing run exits as soon as the condition holds."""
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        if cond():
+            return
+        time.sleep(0.05)
+    raise TimeoutError(f"{what} not reached after {timeout}s")
 
 
 def by_name(metrics):

@@ -1,8 +1,6 @@
 """Live server on the sharded multi-device backend (8 virtual CPU devices,
 tpu_n_shards=8): ingest, scope semantics, forwarding, accuracy."""
 
-import time
-
 import numpy as np
 import pytest
 
@@ -10,7 +8,8 @@ from veneur_tpu.server.server import Server
 from veneur_tpu.server.sharded_aggregator import ShardedAggregator
 from veneur_tpu.sinks.debug import DebugMetricSink
 
-from tests.test_server import by_name, small_config, _send_udp, _wait_processed
+from tests.test_server import (by_name, small_config, _send_udp,
+                               _wait_processed, _wait_until)
 
 
 def sharded_config(**kw):
@@ -147,9 +146,8 @@ def test_native_sharded_python_paths():
                   + [f"nsp.timer:{v}|ms".encode() for v in vals])
         _wait_processed(local, 41)
         assert local.trigger_flush()
-        deadline = time.time() + 10
-        while time.time() < deadline and glob.aggregator.processed < 3:
-            time.sleep(0.05)
+        _wait_until(lambda: glob.aggregator.processed >= 3,
+                    what="global import of 3 forwarded metrics")
         assert glob.trigger_flush()
         g = by_name(gsink.flushed)
         assert g["nsp.check"].value == 1.0
@@ -179,9 +177,8 @@ def test_sharded_local_forwards_to_single_device_global():
                   + [f"shf.timer:{v}|ms".encode() for v in vals])
         _wait_processed(local, 51)
         local.trigger_flush()
-        deadline = time.time() + 10
-        while time.time() < deadline and glob.aggregator.processed < 2:
-            time.sleep(0.05)
+        _wait_until(lambda: glob.aggregator.processed >= 2,
+                    what="global import of 2 forwarded metrics")
         glob.trigger_flush()
         g = by_name(gsink.flushed)
         assert g["shf.count"].value == 3.0

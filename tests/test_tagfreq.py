@@ -11,7 +11,7 @@ from veneur_tpu.server.server import Server
 from veneur_tpu.sinks.debug import DebugMetricSink
 from veneur_tpu.sinks.tagfreq import TagFrequencySink
 
-from tests.test_server import by_name, small_config
+from tests.test_server import by_name, small_config, _wait_until
 
 
 def span_with_tags(tags, trace_id=1, span_id=2):
@@ -82,12 +82,10 @@ def test_server_reports_top_tags_through_metric_pipeline():
             srv.span_pipeline.handle_span(span_with_tags(
                 {"customer": "hot" if i % 2 == 0 else f"cold{i}"},
                 trace_id=i + 1, span_id=i + 2))
-        deadline = time.time() + 10
-        while (srv.tag_frequency.spans_seen < 120
-               and time.time() < deadline):
-            time.sleep(0.05)
+        _wait_until(lambda: srv.tag_frequency.spans_seen >= 120,
+                    what="120 spans through the tag-frequency sketch")
         srv.trigger_flush()     # flushes span sinks, reports via loop-back
-        deadline = time.time() + 10
+        deadline = time.time() + 60
         while time.time() < deadline:
             srv.trigger_flush()  # loop-back lands in a later interval
             m = by_name(msink.flushed)
