@@ -10,9 +10,10 @@ reduction is one kernel: load a tile of rows, sort each row's
 digest's cell count is compile-time), cumsum, and evaluate the midpoint
 interpolation for every requested quantile without ever leaving VMEM.
 
-The sort is the standard vectorized bitonic network expressed with
-reshape-based compare-exchange (no dynamic indexing — Pallas/TPU wants
-static addressing), ~log²(C)/2 vectorized passes over the tile.
+The sort is the standard vectorized bitonic network, its
+compare-exchange expressed with static circular shifts + iota masks
+(no dynamic indexing — Pallas/TPU wants static addressing),
+~log²(C)/2 vectorized passes over the tile.
 Interpolation avoids gathers entirely: for each quantile, every
 adjacent centroid interval computes its candidate value and a one-hot
 interval mask selects the right one (VPU-friendly mask+reduce).
@@ -23,6 +24,17 @@ experimental; a probe failure falls back to the XLA path rather than
 breaking every flush). Force with VENEUR_TPU_PALLAS=1/0. Parity with
 the XLA path is asserted bit-tolerantly in tests/test_pallas_digest.py
 using interpret mode, which runs the same kernel on CPU.
+
+Mosaic-lowering status (probed live on the tunneled chip, 2026-07-31):
+this kernel now contains only primitives Mosaic accepts — jnp.cumsum
+has no TC lowering (replaced by _prefix_sum_last) and the textbook
+[..., C/2j, 2, j] compare-exchange reshape is rejected as an
+interleaved vector reshape (replaced by rot+mask exchange). The dev
+tunnel's verdict stays `false` for a different reason: its Pallas
+compile service never returned within 400s even for a minimal
+elementwise kernel, so the probe's 60s budget correctly degrades
+production to the XLA path there. On a directly-attached TPU the
+lowering blockers are gone.
 
 Reference behavioral contract: merging_digest.go:302 Quantile (midpoint
 interpolation between centroid masses, min/max endpoints).
@@ -54,36 +66,56 @@ def _next_pow2(n: int) -> int:
 def _bitonic_sort_pairs(key, val):
     """Sort (key, val) rows ascending by key along the last axis with a
     bitonic network. Static shapes only: last dim must be a power of two.
-    key/val: f32[..., C]."""
+    key/val: f32[..., C].
+
+    The compare-exchange is expressed with static circular shifts plus
+    iota masks rather than the textbook reshape to [..., C/2j, 2, j]:
+    Mosaic rejects those interleaved vector reshapes on real TPU
+    (`tpu.reshape vector<256x128xf32> -> vector<256x64x2x1xf32>`), while
+    concat-slices and elementwise selects lower cleanly. Each position i
+    fetches its partner i^j via a shift of +-j (partner pairs never
+    wrap: i|j < C), then keeps min or max per the block direction."""
     c = key.shape[-1]
-    lead = key.shape[:-1]
+    pos = jax.lax.broadcasted_iota(jnp.int32, key.shape, key.ndim - 1)
+
+    def rot(x, j):
+        # circular left shift by j: position i sees x[(i+j) % C]
+        return jnp.concatenate([x[..., j:], x[..., :j]], axis=-1)
+
     k = 2
     while k <= c:
+        log2k = k.bit_length() - 1
         j = k // 2
         while j >= 1:
-            # partner exchange at distance j via reshape [..., C/2j, 2, j]
-            ks = key.reshape(lead + (c // (2 * j), 2, j))
-            vs = val.reshape(lead + (c // (2 * j), 2, j))
-            lo_k, hi_k = ks[..., 0, :], ks[..., 1, :]
-            lo_v, hi_v = vs[..., 0, :], vs[..., 1, :]
-            # ascending blocks of size k: direction flips per k-block;
-            # base = the pair's flat index with the partner bit clear
-            base = jax.lax.broadcasted_iota(
-                jnp.int32, (c // (2 * j), j), 0) * (2 * j) \
-                + jax.lax.broadcasted_iota(jnp.int32, (c // (2 * j), j), 1)
-            asc = ((base // k) % 2) == 0          # [C/2j, j]
-            swap = jnp.where(asc, lo_k > hi_k, lo_k < hi_k)
-            new_lo_k = jnp.where(swap, hi_k, lo_k)
-            new_hi_k = jnp.where(swap, lo_k, hi_k)
-            new_lo_v = jnp.where(swap, hi_v, lo_v)
-            new_hi_v = jnp.where(swap, lo_v, hi_v)
-            key = jnp.stack([new_lo_k, new_hi_k], axis=-2).reshape(
-                lead + (c,))
-            val = jnp.stack([new_lo_v, new_hi_v], axis=-2).reshape(
-                lead + (c,))
+            is_lo = (pos & j) == 0                # partner is at i + j
+            pk = jnp.where(is_lo, rot(key, j), rot(key, c - j))
+            pv = jnp.where(is_lo, rot(val, j), rot(val, c - j))
+            asc = ((pos >> log2k) & 1) == 0       # direction per k-block
+            keep_min = asc == is_lo
+            take = jnp.where(keep_min, pk < key, pk > key)
+            key = jnp.where(take, pk, key)
+            val = jnp.where(take, pv, val)
             j //= 2
         k *= 2
     return key, val
+
+
+def _prefix_sum_last(x):
+    """Inclusive prefix sum along the last axis via log-step shift-adds
+    (Hillis-Steele): ceil(log2 C) static concat+slice passes instead of
+    jnp.cumsum,
+    whose primitive has no Mosaic TPU lowering (the probe used to die
+    with `Unimplemented primitive ... cumsum`). Shapes are static, so
+    every shift is a compile-time slice the VPU vectorizes."""
+    c = x.shape[-1]
+    zeros = jnp.zeros_like(x)
+    d = 1
+    while d < c:
+        shifted = jnp.concatenate(
+            [zeros[..., :d], x[..., :c - d]], axis=-1)
+        x = x + shifted
+        d *= 2
+    return x
 
 
 def _quantile_kernel(qs_ref, m_ref, w_ref, mn_ref, mx_ref, out_ref,
@@ -96,7 +128,7 @@ def _quantile_kernel(qs_ref, m_ref, w_ref, mn_ref, mx_ref, out_ref,
     key = jnp.where(live, m, jnp.float32(jnp.inf))
     skey, sw = _bitonic_sort_pairs(key, jnp.where(live, w, 0.0))
     tot = jnp.sum(sw, axis=-1, keepdims=True)        # [T, 1]
-    cum = jnp.cumsum(sw, axis=-1)
+    cum = _prefix_sum_last(sw)
     mid = cum - 0.5 * sw
     # breakpoints: xs = [0, mid_0..mid_{C-1}, tot], ys = [min, mean.., max]
     # (empty cells collapse onto (tot, max): identical to the XLA path)
