@@ -556,6 +556,17 @@ def config6_cardinality_stress(scale=1.0):
     n_g = int(names_total * 0.25)
     n_t = int(names_total * 0.10)
     n_s = names_total - n_c - n_g - n_t
+    # HBM guard: each set row is a 16KB HLL register block (2^14
+    # registers — the reference's precision, samplers.go:372), so the
+    # natural 5% set share would alone claim 8GB of a 16GB chip at the
+    # full 10M-name scale. Cap set rows and shift the excess names to
+    # counters (the cheapest rows): total unique-name cardinality — the
+    # thing this config stresses — is preserved, and the report carries
+    # the actual mix.
+    set_row_cap = 150_000
+    if n_s > set_row_cap:
+        n_c += n_s - set_row_cap
+        n_s = set_row_cap
     cap_c = int(n_c * 0.9)   # deliberate 10% counter saturation
 
     def build_payloads():
@@ -644,6 +655,8 @@ def config6_cardinality_stress(scale=1.0):
         return {
             "config": 6, "name": "cardinality_10M_stress",
             "names": names_total, "live_keys": live,
+            "mix": {"counter": n_c, "gauge": n_g, "timer": n_t,
+                    "set": n_s},
             "samples_per_sec": round(
                 2 * names_total / (stats["t_alloc"] + stats["t_hit"]), 1),
             "alloc_keys_per_sec": round(live / stats["t_alloc"], 1),
@@ -679,7 +692,11 @@ SUBPROC_TIMEOUT = float(os.environ.get("E2E_CONFIG_TIMEOUT", "1500"))
 
 
 def _config_budget(n: int) -> float:
-    return SUBPROC_TIMEOUT * (2.0 if n == 6 else 1.0)
+    # config 6's parent budget must DOMINATE the sum of its child's
+    # sanctioned waits (init 600s + cycle-0 flush 1800s + cycle-1 flush
+    # 300s + the 10M-name feed passes), or the parent kills the child in
+    # exactly the slow-flush scenario the child budget tolerates
+    return SUBPROC_TIMEOUT * (3.0 if n == 6 else 1.0)
 # Backend-init budget inside each child (mirrors bench.py's kernel-stage
 # watchdog): a wedged accelerator tunnel hangs client creation forever;
 # fail fast with a diagnostic instead of burning SUBPROC_TIMEOUT x 5.
