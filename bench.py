@@ -369,6 +369,7 @@ def kernel_main():
     timer.cancel()   # backend is up; the run itself is bounded by steps
     phase(f"backend_up:{dev.platform}")
     on_tpu = dev.platform != "cpu"
+    mult = 1   # applied (and recorded) only on the TPU branch
     if not on_tpu:
         # CPU smoke-mode: tiny shapes so the harness stays runnable anywhere
         spec = TableSpec(counter_capacity=1 << 12, gauge_capacity=1 << 10,
@@ -387,7 +388,7 @@ def kernel_main():
         # cardinality — the lever for separating chip compute from
         # per-dispatch tunnel RTT (0.46 ms/step at mult=1 in the r04
         # capture suggests dispatch latency, not the MXU, is the cap)
-        mult = max(1, int(os.environ.get("BENCH_BATCH_MULT", "1")))
+        mult = max(1, int(os.environ.get("BENCH_BATCH_MULT", "1") or 1))
         b = dict(counter=mult << 18, gauge=mult << 14, status=mult << 8,
                  set=mult << 14, histo=mult << 16)
 
@@ -483,11 +484,10 @@ def kernel_main():
         "digest_accuracy": digest_accuracy(
             jnp, state, spec, batches, uses, flush_compute),
     }
-    mult = int(os.environ.get("BENCH_BATCH_MULT", "1"))
     if mult != 1:
         # an experiment run, not the standard artifact: record the lever
-        # so numbers at different multipliers are never read as chip-
-        # speed changes
+        # ACTUALLY APPLIED (the CPU branch ignores it) so numbers at
+        # different multipliers are never read as chip-speed changes
         out["batch_mult"] = mult
 
     print(json.dumps(out))

@@ -75,7 +75,10 @@ def _mk_server(metric_sinks, span_sinks=(), udp=False, **cfg_kw):
     return srv
 
 
-def _drain(srv, want_processed, timeout=600.0):
+DRAIN_TIMEOUT = 600.0
+
+
+def _drain(srv, want_processed, timeout=DRAIN_TIMEOUT):
     """Wait until the pipeline has consumed `want_processed` samples (or
     the packet queue is empty and counts stopped moving)."""
     t0 = time.time()
@@ -693,10 +696,15 @@ SUBPROC_TIMEOUT = float(os.environ.get("E2E_CONFIG_TIMEOUT", "1500"))
 
 def _config_budget(n: int) -> float:
     # config 6's parent budget must DOMINATE the sum of its child's
-    # sanctioned waits (init 600s + cycle-0 flush 1800s + cycle-1 flush
-    # 300s + the 10M-name feed passes), or the parent kills the child in
-    # exactly the slow-flush scenario the child budget tolerates
-    return SUBPROC_TIMEOUT * (3.0 if n == 6 else 1.0)
+    # sanctioned waits — which are absolute constants, NOT scaled by
+    # E2E_CONFIG_TIMEOUT — or the parent kills the child in exactly the
+    # slow-flush scenario the child budgets tolerate: init + cycle-0
+    # flush compile + cycle-1 flush + the four 10M-name feed passes.
+    if n != 6:
+        return SUBPROC_TIMEOUT
+    child_waits = INIT_TIMEOUT + 3 * WARM_TIMEOUT + 300.0 \
+        + 4 * DRAIN_TIMEOUT  # feed/drain passes (2 cycles x 2 passes)
+    return max(SUBPROC_TIMEOUT * 3.0, child_waits + 300.0)
 # Backend-init budget inside each child (mirrors bench.py's kernel-stage
 # watchdog): a wedged accelerator tunnel hangs client creation forever;
 # fail fast with a diagnostic instead of burning SUBPROC_TIMEOUT x 5.

@@ -134,3 +134,37 @@ def test_empty_row_nan_under_jit_vmap():
     live[1, 2] = True
     assert np.isnan(got[~live]).all()
     np.testing.assert_allclose(got[1, 2], [1.0], rtol=1e-6)
+
+
+def test_prefix_sum_matches_cumsum():
+    """_prefix_sum_last replaced jnp.cumsum (no Mosaic TC lowering); the
+    log-step scan must agree with numpy over every power-of-two width
+    the kernel can see, including weights with empty runs."""
+    from veneur_tpu.ops.pallas_digest import _prefix_sum_last
+    rng = np.random.default_rng(5)
+    for c in (1, 2, 4, 128, 256):
+        x = (rng.uniform(0, 3, (5, c))
+             * (rng.uniform(size=(5, c)) < 0.6)).astype(np.float32)
+        got = np.asarray(_prefix_sum_last(jnp.asarray(x)))
+        np.testing.assert_allclose(got, np.cumsum(x, axis=-1),
+                                   rtol=1e-6, atol=1e-6)
+
+
+def test_bitonic_sort_with_inf_and_duplicate_keys():
+    """The kernel sorts dead cells to the tail as +inf keys and real
+    digests carry duplicate means; the rot+mask compare-exchange must
+    keep (key, val) pairs together in both regimes."""
+    rng = np.random.default_rng(6)
+    c = 128
+    key = rng.choice(np.asarray([1.0, 2.0, 2.0, 3.0, np.inf],
+                                np.float32), size=(9, c))
+    val = rng.uniform(0.5, 2.0, (9, c)).astype(np.float32)
+    sk, sv = _bitonic_sort_pairs(jnp.asarray(key), jnp.asarray(val))
+    sk, sv = np.asarray(sk), np.asarray(sv)
+    # keys are sorted (<= comparison: inf-inf diffs would be nan)
+    assert (sk[:, :-1] <= sk[:, 1:]).all()
+    # the (key, val) multiset is preserved: same pairs, just reordered
+    for r in range(9):
+        want = sorted(zip(key[r], val[r]))
+        got = sorted(zip(sk[r], sv[r]))
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
