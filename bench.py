@@ -431,7 +431,8 @@ def kernel_main():
     # 0 = never compact (the pure-ingest ceiling, r01/r02's program);
     # otherwise clamped to the step count so the timed loop always
     # contains at least one compaction at the labeled cadence.
-    compact_every = int(os.environ.get("BENCH_COMPACT_EVERY", "8") or 8)
+    compact_every = max(0, int(os.environ.get("BENCH_COMPACT_EVERY", "8")
+                               or 8))
     if compact_every > 0:
         compact_every = min(compact_every, max(1, steps))
     no_compact = compact_every <= 0
