@@ -16,11 +16,12 @@ import sys
 import time
 
 import numpy as np
+import pytest
 
 from veneur_tpu.server.server import Server
 from veneur_tpu.sinks.debug import DebugMetricSink
 
-from tests.test_server import small_config
+from tests.test_server import small_config, _wait_processed
 
 
 def test_soak_many_intervals_exact_and_leak_free():
@@ -174,3 +175,47 @@ print("CLEAN-EXIT", flush=True)
                           timeout=150)
     assert proc.returncode == 0, (proc.returncode, proc.stderr[-500:])
     assert "CLEAN-EXIT" in proc.stdout
+
+
+def test_soak_sharded_mesh_all_types():
+    """The soak story on the production multi-device path: a sharded
+    (replica, shard) mesh server over the virtual 8-device CPU mesh,
+    every metric type live, 4 intervals of rotating keys — exactness
+    for counters/gauges, estimate envelopes for sets/timers, and a
+    clean table reset every interval (the worker.go:498 contract on the
+    shard_map backend)."""
+    from tests.test_sharded_server import sharded_config
+
+    sink = DebugMetricSink()
+    srv = Server(sharded_config(interval="600s"), metric_sinks=[sink])
+    srv.start()
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        addr = srv.local_addr()
+        rng = np.random.default_rng(11)
+        for it in range(4):
+            sink.flushed.clear()
+            base = srv.aggregator.processed
+            vals = rng.uniform(1, 100, 48)
+            lines = ([b"sk%d.c.%d:3|c" % (it, i) for i in range(24)]
+                     + [f"sk{it}.t:{v:.3f}|ms".encode() for v in vals]
+                     + [b"sk%d.s:u%d|s" % (it, i) for i in range(20)]
+                     + [b"sk%d.g:%d|g" % (it, it + 7)])
+            for i in range(0, len(lines), 20):
+                s.sendto(b"\n".join(lines[i:i + 20]), addr)
+            _wait_processed(srv, base + len(lines))
+            assert srv.trigger_flush(timeout=180)
+            m = {x.name: x for x in sink.flushed
+                 if x.name.startswith("sk")}
+            # this interval's keys ONLY — carry-over shows as sk<it-1> keys
+            assert all(k.startswith(f"sk{it}.") for k in m), sorted(m)[:6]
+            for i in range(24):
+                assert m[f"sk{it}.c.{i}"].value == 3.0
+            assert m[f"sk{it}.g"].value == it + 7.0
+            assert m[f"sk{it}.t.count"].value == 48.0
+            assert m[f"sk{it}.s"].value == pytest.approx(20, abs=3)
+            p50 = m[f"sk{it}.t.50percentile"].value
+            assert abs(p50 - np.percentile(vals, 50)) / 100.0 < 0.05
+    finally:
+        s.close()
+        srv.shutdown()
