@@ -74,10 +74,9 @@ class _KindTable:
         self.next_free = [0] * n_shards
         self.dropped = 0
 
-    def slot_for(self, key, digest: int, make_meta) -> Optional[int]:
-        slot = self.by_key.get(key)
-        if slot is not None:
-            return slot
+    def alloc(self, key, digest: int, meta) -> Optional[int]:
+        """Allocate a slot for a new key (callers check by_key first —
+        KeyTable.slot_for owns the hit path)."""
         shard = digest % self.n_shards
         nxt = self.next_free[shard]
         if nxt >= self.per_shard:
@@ -86,9 +85,8 @@ class _KindTable:
         self.next_free[shard] = nxt + 1
         slot = shard * self.per_shard + nxt
         self.by_key[key] = slot
-        m = make_meta()
-        self.meta.append((slot, m))
-        self.by_slot[slot] = m
+        self.meta.append((slot, meta))
+        self.by_slot[slot] = meta
         return slot
 
     def reset(self):
@@ -136,11 +134,17 @@ class KeyTable:
         if joined_tags is None:
             joined_tags = ",".join(tags)
         key = (kind, name, joined_tags)
-        return t.slot_for(
+        # steady-state hit path: ONE dict probe and nothing else —
+        # constructing the SlotMeta (or even a closure to defer it) per
+        # call cost ~25% of the whole staging hot loop
+        slot = t.by_key.get(key)
+        if slot is not None:
+            return slot
+        return t.alloc(
             key, digest,
-            lambda: SlotMeta(name=name, tags=tags, scope=scope, kind=kind,
-                             hostname=hostname, imported_only=imported,
-                             joined_tags=joined_tags))
+            SlotMeta(name=name, tags=tags, scope=scope, kind=kind,
+                     hostname=hostname, imported_only=imported,
+                     joined_tags=joined_tags))
 
     def get_meta(self, kind: str):
         """[(slot, SlotMeta)] in allocation order for flush labeling."""

@@ -85,10 +85,13 @@ class NativeKeyTable:
             # C++ engine's keybuf (reference MetricKey.JoinedTags)
             key = (kind, name, joined_tags if joined_tags is not None
                    else ",".join(tags))
-            return self.status.slot_for(
+            slot = self.status.by_key.get(key)
+            if slot is not None:
+                return slot
+            return self.status.alloc(
                 key, digest,
-                lambda: SlotMeta(name=name, tags=tags, scope=scope,
-                                 kind=kind, hostname=hostname))
+                SlotMeta(name=name, tags=tags, scope=scope,
+                         kind=kind, hostname=hostname))
         joined = joined_tags if joined_tags is not None else ",".join(tags)
         slot, was_new = self.eng.slot_for(kind, name, joined, scope, digest)
         if slot is not None and was_new:
