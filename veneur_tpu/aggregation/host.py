@@ -74,14 +74,23 @@ class _KindTable:
         self.next_free = [0] * n_shards
         self.dropped = 0
 
-    def alloc(self, key, digest: int, meta) -> Optional[int]:
+    def alloc(self, key, digest: int, name: str, tags: tuple, scope: int,
+              kind: str, hostname: str = "", imported: bool = False,
+              joined_tags=None) -> Optional[int]:
         """Allocate a slot for a new key (callers check by_key first —
-        KeyTable.slot_for owns the hit path)."""
+        KeyTable.slot_for owns the hit path). Takes the SlotMeta FIELDS
+        so the capacity check runs before any construction: during a
+        cardinality explosion every re-arrival of a never-admitted key
+        lands here, and paying a dataclass build per dropped sample is
+        a regression at exactly the wrong time."""
         shard = digest % self.n_shards
         nxt = self.next_free[shard]
         if nxt >= self.per_shard:
             self.dropped += 1
             return None
+        meta = SlotMeta(name=name, tags=tags, scope=scope, kind=kind,
+                        hostname=hostname, imported_only=imported,
+                        joined_tags=joined_tags)
         self.next_free[shard] = nxt + 1
         slot = shard * self.per_shard + nxt
         self.by_key[key] = slot
@@ -140,11 +149,9 @@ class KeyTable:
         slot = t.by_key.get(key)
         if slot is not None:
             return slot
-        return t.alloc(
-            key, digest,
-            SlotMeta(name=name, tags=tags, scope=scope, kind=kind,
-                     hostname=hostname, imported_only=imported,
-                     joined_tags=joined_tags))
+        return t.alloc(key, digest, name, tags, scope, kind,
+                       hostname=hostname, imported=imported,
+                       joined_tags=joined_tags)
 
     def get_meta(self, kind: str):
         """[(slot, SlotMeta)] in allocation order for flush labeling."""

@@ -105,6 +105,16 @@ class DeviceState(NamedTuple):
     h_recip_lo: jax.Array
 
 
+def empty_state_compiled(spec: TableSpec) -> DeviceState:
+    """ONE compiled program materializing the whole empty state. The
+    eager version dispatches ~20 distinct fill executables (one per
+    array shape) — on the tunneled dev backend, where a process
+    degrades to slow per-dispatch mode past a couple of resident
+    executables (step.py ingest_step_packed), the per-interval swap
+    must not be the thing that pushes it over."""
+    return _empty_state_jit(spec=spec)
+
+
 def empty_state(spec: TableSpec) -> DeviceState:
     f = jnp.float32
     kc, kg, kst = spec.counter_capacity, spec.gauge_capacity, spec.status_capacity
@@ -123,3 +133,6 @@ def empty_state(spec: TableSpec) -> DeviceState:
         h_sum_acc=z((kh,), f), h_sum_hi=z((kh,), f), h_sum_lo=z((kh,), f),
         h_recip_acc=z((kh,), f), h_recip_hi=z((kh,), f), h_recip_lo=z((kh,), f),
     )
+
+
+_empty_state_jit = jax.jit(empty_state, static_argnames=("spec",))

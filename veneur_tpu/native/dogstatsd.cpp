@@ -13,7 +13,7 @@
 //   - set members hashed MetroHash64 seed 1337 (utils/hashing.py
 //     hll_reg_rho; the reference sketch's member hash)
 //   - slot = shard*per_shard + next_free[shard], shard = digest % n_shards
-//     (aggregation/host.py _KindTable.slot_for)
+//     (aggregation/host.py KeyTable.slot_for / _KindTable.alloc)
 //
 // Events (_e{) and service checks (_sc) are rare; they are handed back to
 // Python verbatim (vt_next_special).

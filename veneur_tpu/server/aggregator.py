@@ -16,7 +16,7 @@ from typing import Dict, List, Tuple
 import numpy as np
 
 from veneur_tpu.aggregation.host import Batcher, BatchSpec, KeyTable
-from veneur_tpu.aggregation.state import TableSpec, empty_state
+from veneur_tpu.aggregation.state import (TableSpec, empty_state_compiled)
 from veneur_tpu.aggregation.step import (
     batch_sizes, ingest_step_packed, pack_batch)
 from veneur_tpu.samplers.parser import UDPMetric
@@ -31,7 +31,7 @@ class Aggregator:
         self.compact_every = compact_every
         self.table = KeyTable(spec, n_shards)
         self.batcher = Batcher(spec, bspec, on_batch=self._on_batch)
-        self.state = empty_state(spec)
+        self.state = empty_state_compiled(spec)
         self._steps = 0
         # staged HLL import rows (merged via ops.hll.merge_rows)
         self._hll_slots: list = []
@@ -167,7 +167,7 @@ class Aggregator:
         while self._hll_slots:
             self._flush_hll_imports()
         state, table = self.state, self.table
-        self.state = empty_state(self.spec)
+        self.state = empty_state_compiled(self.spec)
         self.table = KeyTable(self.spec, self.n_shards)
         self._steps = 0
         return state, table

@@ -88,10 +88,8 @@ class NativeKeyTable:
             slot = self.status.by_key.get(key)
             if slot is not None:
                 return slot
-            return self.status.alloc(
-                key, digest,
-                SlotMeta(name=name, tags=tags, scope=scope,
-                         kind=kind, hostname=hostname))
+            return self.status.alloc(key, digest, name, tags, scope, kind,
+                                     hostname=hostname)
         joined = joined_tags if joined_tags is not None else ",".join(tags)
         slot, was_new = self.eng.slot_for(kind, name, joined, scope, digest)
         if slot is not None and was_new:
