@@ -169,3 +169,105 @@ def test_debug_pprof_profile_rejects_bad_paths_and_nan(http_server):
         with pytest.raises(urllib.error.HTTPError) as e:
             _get(srv, path)
         assert e.value.code in (400, 404), path
+
+
+def test_traced_post_connection_event_span_chain():
+    """Outbound forward POSTs must emit the reference's httptrace span
+    chain (http/http.go:55-129): resolvingDNS -> connecting ->
+    gotConnection.new (+ connections_used_total count sample) ->
+    finishedHeaders -> finishedWrite -> gotFirstByte, all children of a
+    roundtrip span tagged with the action, itself a child of the
+    caller's flush span."""
+    import http.server
+    import threading
+
+    from veneur_tpu.forward.tracedhttp import traced_post
+
+    class H(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            self.rfile.read(int(self.headers["Content-Length"]))
+            self.send_response(202)
+            self.end_headers()
+            self.wfile.write(b"ok")
+
+        def log_message(self, *a):
+            pass
+
+    httpd = http.server.HTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+
+    recorded = []
+
+    class ChanClient:
+        def record(self, ssf_span):
+            recorded.append(ssf_span)
+            return True
+
+    try:
+        parent = Span("flush.forward", service="t")
+        status, data = traced_post(
+            f"http://127.0.0.1:{httpd.server_port}/import", b"xyz",
+            {"Content-Type": "application/json"}, parent_span=parent,
+            trace_client=ChanClient(), action="forward")
+        assert status == 202 and data == b"ok"
+        names = [s.name for s in recorded]
+        assert names == ["http.resolvingDNS", "http.connecting",
+                         "http.gotConnection.new", "http.finishedHeaders",
+                         "http.finishedWrite", "http.gotFirstByte",
+                         "http.post"]
+        rt = recorded[-1]
+        assert rt.tags["action"] == "forward"
+        assert rt.parent_id == parent.id
+        # every phase is a child of the roundtrip span, on one timeline
+        assert all(s.parent_id == rt.id for s in recorded[:-1])
+        conn_span = recorded[2]
+        assert conn_span.tags["was_idle"] == "false"
+        counts = [m for m in conn_span.metrics
+                  if m.name == "forward.connections_used_total"]
+        assert len(counts) == 1 and counts[0].tags["state"] == "new"
+        # phases tile the timeline: each ends before the next begins
+        for a, b in zip(recorded[:-2], recorded[1:-1]):
+            assert a.end_timestamp <= b.start_timestamp
+
+        # no-trace mode: same POST, no spans, no crash
+        recorded.clear()
+        status, _ = traced_post(
+            f"http://127.0.0.1:{httpd.server_port}/import", b"xyz", {})
+        assert status == 202 and recorded == []
+    finally:
+        httpd.shutdown()
+
+
+def test_traced_post_raises_and_marks_error_on_4xx():
+    import http.server
+    import threading
+
+    from veneur_tpu.forward.tracedhttp import traced_post
+
+    class H(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            self.send_response(400)
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    httpd = http.server.HTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    recorded = []
+
+    class ChanClient:
+        def record(self, s):
+            recorded.append(s)
+            return True
+
+    try:
+        parent = Span("flush.forward", service="t")
+        with pytest.raises(RuntimeError):
+            traced_post(f"http://127.0.0.1:{httpd.server_port}/x", b"b",
+                        {}, parent_span=parent, trace_client=ChanClient())
+        rt = [s for s in recorded if s.name == "http.post"]
+        assert len(rt) == 1 and rt[0].error
+    finally:
+        httpd.shutdown()

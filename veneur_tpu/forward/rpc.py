@@ -33,10 +33,10 @@ class ForwardClient:
             response_deserializer=empty_pb2.Empty.FromString)
 
     def send_metrics(self, metrics: List, timeout: float = 10.0,
-                     parent_span=None) -> None:
-        # parent_span accepted for interface parity with the HTTP client;
-        # the reference's gRPC forward doesn't propagate trace headers
-        # either (flusher.go:474 forwardGRPC has no Inject)
+                     parent_span=None, trace_client=None) -> None:
+        # parent_span/trace_client accepted for interface parity with the
+        # HTTP client; the reference's gRPC forward doesn't propagate
+        # trace headers either (flusher.go:474 forwardGRPC has no Inject)
         self._send(fpb.MetricList(metrics=metrics), timeout=timeout)
 
     def close(self):
@@ -59,7 +59,7 @@ class HTTPForwardClient:
             self.address = "http://" + self.address
 
     def send_metrics(self, metrics: List, timeout: float = 10.0,
-                     parent_span=None) -> None:
+                     parent_span=None, trace_client=None) -> None:
         import json
 
         if self.json_body:
@@ -69,7 +69,7 @@ class HTTPForwardClient:
         else:
             body = fpb.MetricList(metrics=metrics).SerializeToString()
             ctype = "application/x-protobuf"
-        self._post(body, ctype, timeout, parent_span)
+        self._post(body, ctype, timeout, parent_span, trace_client)
 
     def send_json(self, json_metrics: List[dict],
                   timeout: float = 10.0) -> None:
@@ -81,8 +81,7 @@ class HTTPForwardClient:
                    timeout)
 
     def _post(self, body: bytes, ctype: str, timeout: float,
-              parent_span=None) -> None:
-        import urllib.request
+              parent_span=None, trace_client=None) -> None:
         import zlib
 
         headers = {"Content-Type": ctype, "Content-Encoding": "deflate"}
@@ -92,11 +91,11 @@ class HTTPForwardClient:
             # global's /import child spans join the local's flush tree
             from veneur_tpu.trace.opentracing import GLOBAL_TRACER
             GLOBAL_TRACER.inject_header(parent_span, headers)
-        req = urllib.request.Request(
-            f"{self.address}/import", data=zlib.compress(body),
-            method="POST", headers=headers)
-        with urllib.request.urlopen(req, timeout=timeout) as resp:
-            resp.read()
+        # per-connection-event span chain (http/http.go TraceRoundTripper)
+        from veneur_tpu.forward.tracedhttp import traced_post
+        traced_post(f"{self.address}/import", zlib.compress(body), headers,
+                    timeout=timeout, parent_span=parent_span,
+                    trace_client=trace_client, action="forward")
 
     def close(self):
         pass

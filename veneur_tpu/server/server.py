@@ -1220,7 +1220,8 @@ class Server:
                 hll_precision=self.aggregator.spec.hll_precision)
             if metrics:
                 self._forward_client.send_metrics(
-                    metrics, timeout=self.interval, parent_span=span)
+                    metrics, timeout=self.interval, parent_span=span,
+                    trace_client=self.trace_client)
         except Exception as e:
             # concurrent forwards (one aux thread per interval; a slow
             # failure can overlap the next interval's) make += lossy —
