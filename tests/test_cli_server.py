@@ -1,0 +1,103 @@
+"""The operator surface as real processes: `python -m
+veneur_tpu.cli.server -f config.yaml` + `cli.emit`, end to end through
+the flush ticker and the localfile plugin — the reference's
+cmd/veneur/main.go usage (README Quickstart). Everything else tests the
+Server class in-process; this is the one place the actual daemon
+entrypoint, YAML file, ticker, signal handling, and emit binary
+compose."""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def free_udp_port() -> int:
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def cpu_env():
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    return env
+
+
+def write_config(tmp_path, port, interval="2s"):
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text(
+        f'interval: "{interval}"\n'
+        f'statsd_listen_addresses: ["udp://127.0.0.1:{port}"]\n'
+        'percentiles: [0.5]\n'
+        'aggregates: ["count"]\n'
+        f'flush_file: "{tmp_path}/out.tsv"\n')
+    return str(cfg)
+
+
+def test_validate_config_modes(tmp_path):
+    cfg = write_config(tmp_path, 8126)
+    ok = subprocess.run(
+        [sys.executable, "-m", "veneur_tpu.cli.server", "-f", cfg,
+         "-validate-config"], capture_output=True, text=True,
+        env=cpu_env(), timeout=120)
+    assert ok.returncode == 0 and "config valid" in ok.stdout
+
+    bad = tmp_path / "bad.yaml"
+    bad.write_text('interval: "10s"\nnot_a_real_key: 1\n')
+    strict = subprocess.run(
+        [sys.executable, "-m", "veneur_tpu.cli.server", "-f", str(bad),
+         "-validate-config-strict"], capture_output=True, text=True,
+        env=cpu_env(), timeout=120)
+    assert strict.returncode == 1
+    assert "not_a_real_key" in strict.stderr
+
+
+def test_daemon_emit_ticker_flush_and_graceful_exit(tmp_path):
+    port = free_udp_port()
+    cfg = write_config(tmp_path, port)
+    env = cpu_env()
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "veneur_tpu.cli.server", "-f", cfg],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env)
+    tsv = tmp_path / "out.tsv"
+    try:
+        # keep emitting until the 2s ticker lands our metric in the TSV
+        # (daemon startup pays the first JAX compiles on this 1-core
+        # host, so the loop tolerates minutes of warm-up)
+        deadline = time.time() + 300
+        while time.time() < deadline:
+            if proc.poll() is not None:
+                raise AssertionError(
+                    f"daemon exited early rc={proc.returncode}:\n"
+                    f"{proc.stdout.read()[-2000:]}")
+            rc = subprocess.run(
+                [sys.executable, "-m", "veneur_tpu.cli.emit",
+                 "-hostport", f"udp://127.0.0.1:{port}",
+                 "-name", "cli.e2e", "-count", "7",
+                 "-tag", "src:clitest"],
+                capture_output=True, env=env, timeout=60).returncode
+            assert rc == 0, "emit CLI failed"
+            if tsv.exists() and "cli.e2e" in tsv.read_text():
+                break
+            time.sleep(2)
+        body = tsv.read_text() if tsv.exists() else ""
+        assert "cli.e2e" in body, "ticker never flushed the emitted metric"
+        row = next(ln for ln in body.splitlines() if "cli.e2e" in ln)
+        assert "src:clitest" in row
+        # SIGTERM = drain and exit 0 (reference graceful semantics)
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=120) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)

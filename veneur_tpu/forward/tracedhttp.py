@@ -66,8 +66,9 @@ def traced_post(url: str, body: bytes, headers: Dict[str, str],
     """POST `body` to `url`, emitting the reference's connection-event
     span chain as children of a roundtrip span under `parent_span`
     (no-ops when parent_span/trace_client are None). Returns
-    (status, response body); raises on connection errors and on
-    HTTP status >= 400."""
+    (status, response body); raises on connection errors and on any
+    non-2xx status — redirects are never followed (a followed 301
+    would silently drop the forward body)."""
     u = urlparse(url)
     host = u.hostname or ""
     tls = u.scheme == "https"
@@ -90,7 +91,20 @@ def traced_post(url: str, body: bytes, headers: Dict[str, str],
         try:
             req = urllib.request.Request(url, data=body, method="POST",
                                          headers=headers)
-            with urllib.request.urlopen(req, timeout=timeout) as resp:
+            # refuse redirects: urllib's default handler would reissue
+            # a 301 as a bodyless GET and report success — the same
+            # silent forward drop the direct path's non-2xx guard
+            # prevents. Returning None makes 3xx raise HTTPError.
+            class _NoRedirect(urllib.request.HTTPRedirectHandler):
+                def redirect_request(self, *a, **k):
+                    return None
+
+            opener = urllib.request.build_opener(
+                urllib.request.ProxyHandler(proxies), _NoRedirect())
+            with opener.open(req, timeout=timeout) as resp:
+                if resp.status >= 300:
+                    raise RuntimeError(
+                        f"POST {url} -> {resp.status}")
                 return resp.status, resp.read()
         except Exception:
             if rt is not None:
