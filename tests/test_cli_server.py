@@ -65,10 +65,14 @@ def test_daemon_emit_ticker_flush_and_graceful_exit(tmp_path):
     port = free_udp_port()
     cfg = write_config(tmp_path, port)
     env = cpu_env()
+    # daemon output to a FILE, not a pipe: an undrained 64KB pipe buffer
+    # would block the daemon's logging (2s-interval flush lines add up)
+    # and wedge the test on daemon behavior unrelated to the assertion
+    log_path = tmp_path / "daemon.log"
+    log_f = open(log_path, "w")
     proc = subprocess.Popen(
         [sys.executable, "-m", "veneur_tpu.cli.server", "-f", cfg],
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-        env=env)
+        stdout=log_f, stderr=subprocess.STDOUT, text=True, env=env)
     tsv = tmp_path / "out.tsv"
     try:
         # keep emitting until the 2s ticker lands our metric in the TSV
@@ -79,7 +83,7 @@ def test_daemon_emit_ticker_flush_and_graceful_exit(tmp_path):
             if proc.poll() is not None:
                 raise AssertionError(
                     f"daemon exited early rc={proc.returncode}:\n"
-                    f"{proc.stdout.read()[-2000:]}")
+                    f"{log_path.read_text()[-2000:]}")
             rc = subprocess.run(
                 [sys.executable, "-m", "veneur_tpu.cli.emit",
                  "-hostport", f"udp://127.0.0.1:{port}",
@@ -101,3 +105,4 @@ def test_daemon_emit_ticker_flush_and_graceful_exit(tmp_path):
         if proc.poll() is None:
             proc.kill()
             proc.wait(timeout=30)
+        log_f.close()

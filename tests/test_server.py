@@ -514,3 +514,28 @@ def test_sink_flush_conventions_reported():
     finally:
         srv.shutdown()
         ext.close()
+
+
+def test_per_flush_runtime_gauges(server):
+    """flusher.go:36-43: every flush reports span-chan depth/capacity,
+    GC count, heap bytes, and the flush timestamp through the
+    self-telemetry loop (they land via the span pipeline in a later
+    interval's flush)."""
+    srv, sink = server
+    srv.trigger_flush()           # interval 1 emits the gauges
+    want = {"veneur.worker.span_chan.total_elements",
+            "veneur.worker.span_chan.total_capacity",
+            "veneur.gc.number", "veneur.mem.heap_alloc_bytes",
+            "veneur.flush.flush_timestamp_ns"}
+    deadline = time.time() + 30
+    got = {}
+    while time.time() < deadline:
+        srv.trigger_flush()       # loop-back lands in a later interval
+        got = {m.name: m.value for m in sink.flushed if m.name in want}
+        if want <= set(got):
+            break
+        time.sleep(0.1)
+    assert want <= set(got), sorted(got)
+    assert got["veneur.worker.span_chan.total_capacity"] == 100.0
+    assert got["veneur.mem.heap_alloc_bytes"] > 1e6
+    assert got["veneur.flush.flush_timestamp_ns"] > 1e18

@@ -263,13 +263,10 @@ class ProxyServer:
         and is deliberately not faked; gc.alloc_heap_bytes mirrors
         mem.heap_alloc_bytes exactly as the reference emits HeapAlloc
         under both names. Returns (name, value, type_char) tuples."""
-        import gc
-
-        from veneur_tpu.utils.statsd_emit import current_rss_bytes
-        rss = current_rss_bytes()
-        ngc = sum(s["collections"] for s in gc.get_stats())
+        from veneur_tpu.utils.statsd_emit import runtime_gauges
+        rss, ngc = runtime_gauges()
         return [("mem.heap_alloc_bytes", rss, "g"),
-                ("gc.number", float(ngc), "g"),
+                ("gc.number", ngc, "g"),
                 ("gc.alloc_heap_bytes", rss, "g")]
 
     def start_stats(self, stats_address: str, interval: float = 10.0):

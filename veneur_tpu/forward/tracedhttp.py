@@ -101,10 +101,9 @@ def traced_post(url: str, body: bytes, headers: Dict[str, str],
 
             opener = urllib.request.build_opener(
                 urllib.request.ProxyHandler(proxies), _NoRedirect())
+            # non-2xx (3xx included, via _NoRedirect) raises HTTPError
+            # from opener.open — no status check needed here
             with opener.open(req, timeout=timeout) as resp:
-                if resp.status >= 300:
-                    raise RuntimeError(
-                        f"POST {url} -> {resp.status}")
                 return resp.status, resp.read()
         except Exception:
             if rt is not None:

@@ -1110,10 +1110,24 @@ class Server:
                "veneur.spans_received_total": stats["spans_received"],
                "veneur.worker.span.hit_chan_cap":
                    stats.get("span_chan_cap_hits", 0)}
+        # per-flush runtime gauges (flusher.go:36-43: span-chan depth,
+        # GC count, heap bytes, flush timestamp)
+        from veneur_tpu.utils.statsd_emit import runtime_gauges
+        rss, ngc = runtime_gauges()
         samples = [ssf_samples.timing("veneur.flush.total_duration_ns",
                                       flush_seconds),
                    ssf_samples.gauge("veneur.flush.metrics_total",
                                      n_flushed),
+                   ssf_samples.gauge(
+                       "veneur.worker.span_chan.total_elements",
+                       float(self.span_pipeline.chan.qsize())),
+                   ssf_samples.gauge(
+                       "veneur.worker.span_chan.total_capacity",
+                       float(self.span_pipeline.chan.maxsize)),
+                   ssf_samples.gauge("veneur.gc.number", ngc),
+                   ssf_samples.gauge("veneur.mem.heap_alloc_bytes", rss),
+                   ssf_samples.gauge("veneur.flush.flush_timestamp_ns",
+                                     float(time.time() * 1e9)),
                    # 0 = pure-Python parse fallback (the .so failed to
                    # build): ~40x slower per thread than the C++ engine.
                    # A silent log-line was the only signal before; now

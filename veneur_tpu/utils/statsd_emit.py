@@ -55,3 +55,14 @@ def current_rss_bytes() -> float:
         ru = resource.getrusage(resource.RUSAGE_SELF)
         scale = 1 if sys.platform == "darwin" else 1024
         return float(ru.ru_maxrss * scale)
+
+
+def runtime_gauges() -> tuple:
+    """(rss_bytes, total_gc_collections) — the ONE place the "CPython
+    equivalent of Go's HeapAlloc/NumGC" mapping lives (reference
+    flusher.go:36-43 and proxy.go:656 both report these; Go's
+    PauseTotalNs has no CPython counterpart — collections are not
+    stop-the-world-timed — and is deliberately not faked)."""
+    import gc
+    return (current_rss_bytes(),
+            float(sum(s["collections"] for s in gc.get_stats())))
