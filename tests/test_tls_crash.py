@@ -157,3 +157,44 @@ def test_self_metric_scope_normalization():
         assert any("deploy:canary" in x.tags for x in hit)
     finally:
         srv.shutdown()
+
+
+def test_secrets_redacted_after_start(certs):
+    """server.go:741-747: once every consumer holds its own copy of a
+    credential, the retained config is scrubbed so debug endpoints,
+    crash reports, and logs cannot leak it — while the consumers built
+    before redaction keep working (the TLS listener, whose key was
+    redacted, still handshakes) and the CALLER's Config object stays
+    unredacted (the server scrubs its own copy)."""
+    from tests.test_server import small_config, _wait_processed, by_name
+    from veneur_tpu.sinks.debug import DebugMetricSink
+    sink = DebugMetricSink()
+    cfg = small_config(
+        statsd_listen_addresses=["tcp://127.0.0.1:0"],
+        tls_key=read(certs, "server.key"),
+        tls_certificate=read(certs, "server.crt"),
+        datadog_api_key="dd-secret", signalfx_api_key="sfx-secret",
+        aws_secret_access_key="aws-secret",
+        splunk_hec_token="hec-secret")
+    srv = Server(cfg, metric_sinks=[sink])
+    srv.start()
+    try:
+        for f in ("datadog_api_key", "signalfx_api_key",
+                  "aws_secret_access_key", "splunk_hec_token", "tls_key"):
+            assert getattr(srv.cfg, f) == "REDACTED", f
+        assert srv.cfg.sentry_dsn == ""       # empty stays empty
+        assert cfg.datadog_api_key == "dd-secret"   # caller copy intact
+        assert cfg.tls_key.startswith("-----")
+        # the TLS listener built before redaction still handshakes
+        ctx = ssl.create_default_context()
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+        raw = socket.create_connection(srv.local_addr(), timeout=10)
+        tls = ctx.wrap_socket(raw)
+        tls.sendall(b"redacted.ok:9|c\n")
+        tls.close()
+        _wait_processed(srv, 1)
+        srv.trigger_flush()
+        assert by_name(sink.flushed)["redacted.ok"].value == 9.0
+    finally:
+        srv.shutdown()

@@ -867,6 +867,32 @@ class Server:
                     if addr.startswith(prefix):
                         addr = addr[len(prefix):]
                 self._forward_client = ForwardClient(addr)
+        self._redact_secrets()
+
+    _SECRET_FIELDS = (
+        # the reference's list (server.go:741-747) ...
+        "sentry_dsn", "tls_key", "datadog_api_key", "signalfx_api_key",
+        "lightstep_access_token", "aws_access_key_id",
+        "aws_secret_access_key",
+        # ... plus this config surface's other credential fields
+        "trace_lightstep_access_token", "splunk_hec_token")
+
+    def _redact_secrets(self) -> None:
+        """Scrub credentials from the retained config once every consumer
+        (sinks, TLS context, crash reporter — all built by now) holds its
+        own copy (server.go:741-747): anything that later dumps state
+        (debug endpoints, crash reports, logs) cannot leak keys. The
+        server redacts its OWN shallow copy — the caller's Config object
+        stays intact, so reusing it for another server keeps working."""
+        import dataclasses as _dc
+        self.cfg = _dc.replace(self.cfg)
+        for f in self._SECRET_FIELDS:
+            if getattr(self.cfg, f, ""):
+                setattr(self.cfg, f, "REDACTED")
+        if self.cfg.signalfx_per_tag_api_keys:
+            self.cfg.signalfx_per_tag_api_keys = [
+                {"name": d.get("name", ""), "api_key": "REDACTED"}
+                for d in self.cfg.signalfx_per_tag_api_keys]
 
     def import_metrics(self, metrics: List) -> None:
         """gRPC import entry: enqueue onto the pipeline thread
