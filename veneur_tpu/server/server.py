@@ -691,6 +691,14 @@ class Server:
             import cProfile
             self._profiler = cProfile.Profile()
             self._profiler.enable()
+        if self.cfg.mutex_profile_fraction or self.cfg.block_profile_rate:
+            # accepted for config-surface compat (server.go:331-344 sets
+            # Go runtime profiling rates); CPython has no mutex/block
+            # profiler to arm — say so instead of silently ignoring
+            log.warning(
+                "mutex_profile_fraction/block_profile_rate are Go-runtime "
+                "knobs with no CPython equivalent; ignored "
+                "(use enable_profiling for the cProfile CPU profile)")
         for sink in self.metric_sinks + self.span_sinks:
             sink.start()
         t = threading.Thread(target=self._pipeline_loop, daemon=True,
