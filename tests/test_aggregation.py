@@ -405,3 +405,29 @@ def test_packed_batch_none_stat_lanes():
     for name, a, c in zip(ref._fields, ref, packed):
         np.testing.assert_array_equal(
             np.asarray(a), np.asarray(c), err_msg=name)
+
+
+def test_set_member_invalid_utf8_survives_python_path():
+    """A set member that is not valid UTF-8 (parser decodes it with
+    surrogateescape) must stage without raising — a plain encode() threw
+    UnicodeEncodeError out of process_metric, killing the pipeline
+    thread: one corrupt datagram was a denial of service (found by the
+    extended differential fuzz). The restored bytes must hash like the
+    raw wire bytes (C++ engine parity)."""
+    from veneur_tpu.utils.hashing import hll_reg_rho
+    from veneur_tpu.samplers import parser
+    from veneur_tpu.server.aggregator import Aggregator
+
+    raw = b"\xf3\x28"                      # invalid UTF-8 member bytes
+    agg = Aggregator(TableSpec(counter_capacity=64, gauge_capacity=16,
+                               status_capacity=8, set_capacity=16,
+                               histo_capacity=16))
+    m = parser.parse_metric(b"s.bin:" + raw + b"|s")
+    agg.process_metric(m)                  # must not raise
+    assert agg.processed == 1
+    b = agg.batcher
+    assert b.ns == 1
+    reg, rho = hll_reg_rho(raw, agg.spec.hll_precision)
+    assert (b.s_slot[0] < agg.spec.set_capacity
+            and b.s_reg[0] == reg
+            and b.s_rho[0] == rho), "member bytes must round-trip"

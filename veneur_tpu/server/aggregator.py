@@ -22,6 +22,19 @@ from veneur_tpu.aggregation.step import (
 from veneur_tpu.samplers.parser import UDPMetric
 
 
+def set_member_bytes(value) -> bytes:
+    """The ONE place the set-member encoding policy lives (used by the
+    single-process and sharded process_metric paths): surrogateescape
+    round-trips NON-UTF-8 member bytes back to the original wire bytes —
+    the parser decoded them that way, a plain encode() raises
+    UnicodeEncodeError (which would kill the pipeline thread: one
+    corrupt datagram = DoS, found by differential fuzz), and the
+    restored bytes hash identically to the C++ engine's raw-byte
+    MetroHash."""
+    return value if isinstance(value, bytes) else str(value).encode(
+        "utf-8", "surrogateescape")
+
+
 class Aggregator:
     def __init__(self, spec: TableSpec, bspec: BatchSpec = BatchSpec(),
                  n_shards: int = 1, compact_every: int = 8):
@@ -82,9 +95,7 @@ class Aggregator:
             if mt is not None:
                 mt.message = m.message
         elif kind == "set":
-            member = m.value if isinstance(m.value, bytes) else str(
-                m.value).encode()
-            self.batcher.add_set(slot, member)
+            self.batcher.add_set(slot, set_member_bytes(m.value))
         elif kind in ("histogram", "timer"):
             self.batcher.add_histo(slot, float(m.value), m.sample_rate)
         self.processed += 1

@@ -172,9 +172,8 @@ class ShardedAggregator(Aggregator):
             if mt is not None:
                 mt.message = m.message
         elif kind == "set":
-            member = m.value if isinstance(m.value, bytes) else str(
-                m.value).encode()
-            b.add_set(local, member)
+            from veneur_tpu.server.aggregator import set_member_bytes
+            b.add_set(local, set_member_bytes(m.value))
         elif kind in ("histogram", "timer"):
             b.add_histo(local, float(m.value), m.sample_rate)
         self.processed += 1
