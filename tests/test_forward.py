@@ -436,3 +436,32 @@ def test_proxy_runtime_and_stats_emission():
         rx.close()
     finally:
         p.stop()
+
+
+def test_export_survives_invalid_utf8_key():
+    """One corrupt global-scoped datagram must never poison the forward
+    stream: the host key keeps its surrogate-escaped identity, but the
+    metricpb boundary replaces invalid bytes with U+FFFD so
+    export_metrics keeps serializing every interval (a raw protobuf
+    assignment raised, permanently failing ALL forwards)."""
+    from veneur_tpu.aggregation.state import TableSpec
+    from veneur_tpu.forward.convert import export_metrics
+    from veneur_tpu.samplers import parser
+    from veneur_tpu.server.aggregator import Aggregator
+
+    agg = Aggregator(TableSpec(counter_capacity=64, gauge_capacity=16,
+                               status_capacity=8, set_capacity=16,
+                               histo_capacity=16))
+    agg.process_metric(parser.parse_metric(
+        b"n\xf3me:5|c|#veneurglobalonly"))
+    agg.process_metric(parser.parse_metric(
+        b"clean.count:3|c|#veneurglobalonly"))
+    result, table, raw = agg.flush([0.5], want_raw=True)
+    metrics = export_metrics(raw, table, compression=100.0,
+                             hll_precision=14)
+    for m in metrics:
+        m.SerializeToString()      # must not raise
+    by_name = {m.name: m for m in metrics}
+    assert by_name["clean.count"].counter.value == 3
+    assert "n�me" in by_name       # corrupt key mangled, stream alive
+    assert by_name["n�me"].counter.value == 5

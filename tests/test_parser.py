@@ -227,3 +227,24 @@ def test_key_cache_parity_and_bound(monkeypatch):
     assert len(p._KEY_CACHE) <= 8
     assert len({o[3] for o in outs}) == 50   # digests still per-key
     p._KEY_CACHE.clear()
+
+
+def test_event_invalid_utf8_survives_protobuf_boundary():
+    """Event title/text/metadata land in SSF protobuf STRING fields,
+    which reject surrogate escapes — a plain surrogateescape decode made
+    one corrupt event datagram raise out of parse_event and kill the
+    pipeline thread (same DoS class as the set-member fuzz find).
+    Invalid bytes must become U+FFFD (what Go's encoding/json does to
+    invalid UTF-8 when the reference marshals events) and the sample
+    must serialize cleanly."""
+    pkt = b"_e{5,5}:hell\xf3|w\xf3rld|#env:pr\xf3d|h:h\xf3st|k:k\xf3y"
+    s = parse_event(pkt)
+    s.SerializeToString()                  # must not raise
+    assert s.name == "hell�"
+    assert s.message == "w�rld"
+    assert s.tags["env"] == "pr�d"
+    from veneur_tpu.samplers.parser import EVENT_HOSTNAME_TAG_KEY
+    assert s.tags[EVENT_HOSTNAME_TAG_KEY] == "h�st"
+    # valid UTF-8 passes through untouched
+    ok = parse_event("_e{5,7}:hello|wérld!".encode())
+    assert ok.message == "wérld!"

@@ -34,12 +34,33 @@ _TYPE_NAMES = {mpb.Counter: "counter", mpb.Gauge: "gauge",
 def metric_digest(name: str, pb_type: int, tags) -> int:
     """Sharding digest over name+type+tags, identical to the reference's
     importsrv hash (importsrv/server.go:141-148 hashMetric: fnv1a-32 over
-    name, the capitalized enum name from Type.String(), then each tag)."""
+    name, the capitalized enum name from Type.String(), then each tag).
+    Inputs are deserialized protobuf strings — always valid UTF-8 (the
+    export side replaces invalid bytes at the wire boundary, _wire_str),
+    so a plain encode cannot raise."""
     h = fnv1a_32(name.encode())
     h = fnv1a_32(mpb.Type.Name(pb_type).encode(), h)
     for t in tags:
         h = fnv1a_32(t.encode(), h)
     return h
+
+
+def _wire_str(s: str) -> str:
+    """Name/tag strings entering metricpb protobuf STRING fields. A
+    metric whose name arrived as invalid UTF-8 is held host-side with
+    surrogates (key identity must round-trip); protobuf rejects
+    surrogates, and ONE such global-scoped key would otherwise make
+    export_metrics raise EVERY interval — permanently killing the whole
+    forward stream for one corrupt datagram. Replace to U+FFFD at the
+    wire boundary instead: only the corrupt key's name is mangled, the
+    stream lives. (The Go reference has the harsher behavior: proto3
+    marshal errors on invalid UTF-8, failing the whole batch.)"""
+    try:
+        s.encode()
+        return s
+    except UnicodeEncodeError:
+        return s.encode("utf-8", "surrogateescape").decode("utf-8",
+                                                           "replace")
 
 
 def export_metrics(raw: Dict[str, np.ndarray], table: KeyTable,
@@ -53,7 +74,8 @@ def export_metrics(raw: Dict[str, np.ndarray], table: KeyTable,
     for i, (_slot, meta) in enumerate(table.get_meta("counter")):
         if meta.scope != SCOPE_GLOBAL:
             continue  # only global counters forward (worker.go:186-193)
-        m = mpb.Metric(name=meta.name, tags=list(meta.tags),
+        m = mpb.Metric(name=_wire_str(meta.name),
+                       tags=[_wire_str(t) for t in meta.tags],
                        type=mpb.Counter, scope=mpb.Global)
         m.counter.value = int(round(float(raw["counter"][i])))
         out.append(m)
@@ -61,7 +83,8 @@ def export_metrics(raw: Dict[str, np.ndarray], table: KeyTable,
     for i, (_slot, meta) in enumerate(table.get_meta("gauge")):
         if meta.scope != SCOPE_GLOBAL:
             continue
-        m = mpb.Metric(name=meta.name, tags=list(meta.tags),
+        m = mpb.Metric(name=_wire_str(meta.name),
+                       tags=[_wire_str(t) for t in meta.tags],
                        type=mpb.Gauge, scope=mpb.Global)
         m.gauge.value = float(raw["gauge"][i])
         out.append(m)
@@ -69,7 +92,8 @@ def export_metrics(raw: Dict[str, np.ndarray], table: KeyTable,
     for i, (_slot, meta) in enumerate(table.get_meta("set")):
         if meta.scope == SCOPE_LOCAL:
             continue  # local-only sets flush locally, never forward
-        m = mpb.Metric(name=meta.name, tags=list(meta.tags), type=mpb.Set,
+        m = mpb.Metric(name=_wire_str(meta.name),
+                       tags=[_wire_str(t) for t in meta.tags], type=mpb.Set,
                        scope=mpb.Global if meta.scope == SCOPE_GLOBAL
                        else mpb.Mixed)
         m.set.hyper_log_log = hll_ops.serialize(raw["hll"][i],
@@ -84,7 +108,8 @@ def export_metrics(raw: Dict[str, np.ndarray], table: KeyTable,
         if not live.any():
             continue
         mtype = mpb.Timer if meta.kind == "timer" else mpb.Histogram
-        m = mpb.Metric(name=meta.name, tags=list(meta.tags), type=mtype,
+        m = mpb.Metric(name=_wire_str(meta.name),
+                       tags=[_wire_str(t) for t in meta.tags], type=mtype,
                        scope=mpb.Global if meta.scope == SCOPE_GLOBAL
                        else mpb.Mixed)
         td = m.histogram.t_digest

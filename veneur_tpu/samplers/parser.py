@@ -129,6 +129,19 @@ def _cache_put(cache: dict, key, value):
     cache[key] = value
 
 
+def _pb_str(b: bytes) -> str:
+    """Decode bytes destined for an SSF protobuf STRING field. Protobuf
+    rejects surrogates (assignment raises, which killed the pipeline
+    thread for one corrupt event datagram — the set-member DoS class),
+    so invalid UTF-8 becomes U+FFFD here — the same replacement Go's
+    encoding/json applies to invalid bytes when the reference marshals
+    events downstream. Metric-path decodes keep surrogateescape — key
+    identity must round-trip to the original bytes — and the forward
+    path applies the same replacement at ITS protobuf boundary
+    (forward/convert.py _wire_str)."""
+    return b.decode("utf-8", "replace")
+
+
 def _f32(x: float) -> float:
     """Round-trip through float32 — SSFSample.value/sample_rate are proto
     `float` fields, so every cold-path metric is f32-quantized; hot
@@ -274,8 +287,8 @@ def parse_event(packet: bytes, now: Optional[int] = None) -> ssf_pb2.SSFSample:
         raise ParseError("actual text length did not match encoded length")
 
     sample = ssf_pb2.SSFSample(
-        name=title.decode("utf-8", "surrogateescape"),
-        message=text.decode("utf-8", "surrogateescape").replace("\\n", "\n"),
+        name=_pb_str(title),
+        message=_pb_str(text).replace("\\n", "\n"),
         timestamp=now if now is not None else int(time.time()),
     )
     sample.tags[EVENT_IDENTIFIER_KEY] = ""
@@ -298,32 +311,29 @@ def parse_event(packet: bytes, now: Optional[int] = None) -> ssf_pb2.SSFSample:
                 raise ParseError("could not parse date as unix timestamp")
         elif chunk.startswith(b"h:"):
             once("hostname")
-            sample.tags[EVENT_HOSTNAME_TAG_KEY] = chunk[2:].decode(
-                "utf-8", "surrogateescape")
+            sample.tags[EVENT_HOSTNAME_TAG_KEY] = _pb_str(chunk[2:])
         elif chunk.startswith(b"k:"):
             once("aggregation")
-            sample.tags[EVENT_AGGREGATION_KEY_TAG_KEY] = chunk[2:].decode(
-                "utf-8", "surrogateescape")
+            sample.tags[EVENT_AGGREGATION_KEY_TAG_KEY] = _pb_str(chunk[2:])
         elif chunk.startswith(b"p:"):
             once("priority")
-            pri = chunk[2:].decode("utf-8", "surrogateescape")
+            pri = _pb_str(chunk[2:])
             if pri not in ("normal", "low"):
                 raise ParseError("priority must be normal or low")
             sample.tags[EVENT_PRIORITY_TAG_KEY] = pri
         elif chunk.startswith(b"s:"):
             once("source")
-            sample.tags[EVENT_SOURCE_TYPE_TAG_KEY] = chunk[2:].decode(
-                "utf-8", "surrogateescape")
+            sample.tags[EVENT_SOURCE_TYPE_TAG_KEY] = _pb_str(chunk[2:])
         elif chunk.startswith(b"t:"):
             once("alert")
-            alert = chunk[2:].decode("utf-8", "surrogateescape")
+            alert = _pb_str(chunk[2:])
             if alert not in ("error", "warning", "info", "success"):
                 raise ParseError(
                     "alert level must be error, warning, info or success")
             sample.tags[EVENT_ALERT_TYPE_TAG_KEY] = alert
         elif chunk[0] == 0x23:  # '#'
             once("tags")
-            tags = chunk[1:].decode("utf-8", "surrogateescape").split(",")
+            tags = _pb_str(chunk[1:]).split(",")
             for k, v in parse_tags_to_map(tags).items():
                 sample.tags[k] = v
         else:

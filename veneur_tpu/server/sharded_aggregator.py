@@ -25,7 +25,8 @@ import numpy as np
 
 from veneur_tpu.aggregation.host import Batcher, BatchSpec, KeyTable
 from veneur_tpu.aggregation.state import TableSpec
-from veneur_tpu.server.aggregator import Aggregator
+from veneur_tpu.server.aggregator import (Aggregator,
+                                           set_member_bytes)
 
 
 def per_shard_spec(spec: TableSpec, n_shards: int) -> TableSpec:
@@ -172,7 +173,6 @@ class ShardedAggregator(Aggregator):
             if mt is not None:
                 mt.message = m.message
         elif kind == "set":
-            from veneur_tpu.server.aggregator import set_member_bytes
             b.add_set(local, set_member_bytes(m.value))
         elif kind in ("histogram", "timer"):
             b.add_histo(local, float(m.value), m.sample_rate)
