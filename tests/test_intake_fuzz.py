@@ -1,0 +1,79 @@
+"""Random-bytes fuzz of the network intake surfaces: whatever arrives,
+listeners must answer with the right status (HTTP) or keep reading
+(UDP) — never die or 500. The pipeline-thread DoS class (set members,
+events) was found by fuzz; these pin the transport layer the same way."""
+
+import socket
+import time
+import urllib.error
+import urllib.request
+import zlib
+
+import numpy as np
+
+from veneur_tpu.server.server import Server
+from veneur_tpu.sinks.debug import DebugMetricSink, DebugSpanSink
+
+from tests.test_server import small_config
+
+
+def test_http_import_random_bodies_never_5xx():
+    srv = Server(small_config(http_address="127.0.0.1:0"),
+                 metric_sinks=[DebugMetricSink()])
+    srv.start()
+    url = f"http://127.0.0.1:{srv.http_port}/import"
+    rng = np.random.default_rng(9)
+    codes: dict = {}
+    try:
+        for i in range(150):
+            n = int(rng.integers(0, 300))
+            body = bytes(rng.integers(0, 256, n).astype(np.uint8))
+            if i % 3 == 0:
+                body = zlib.compress(body)
+            headers = {"Content-Type": [
+                "application/json", "application/x-protobuf",
+                "application/octet-stream"][i % 3]}
+            if i % 2 == 0:
+                headers["Content-Encoding"] = "deflate"
+            req = urllib.request.Request(url, data=body, method="POST",
+                                         headers=headers)
+            try:
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    codes[r.status] = codes.get(r.status, 0) + 1
+            except urllib.error.HTTPError as e:
+                codes[e.code] = codes.get(e.code, 0) + 1
+        assert all(c < 500 for c in codes), codes
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.http_port}/healthcheck",
+                timeout=10) as r:
+            assert r.status == 200
+    finally:
+        srv.shutdown()
+
+
+def test_ssf_udp_random_datagrams_keep_reader_alive():
+    ssink = DebugSpanSink()
+    srv = Server(small_config(statsd_listen_addresses=[],
+                              ssf_listen_addresses=["udp://127.0.0.1:0"]),
+                 metric_sinks=[DebugMetricSink()], span_sinks=[ssink])
+    srv.start()
+    try:
+        rng = np.random.default_rng(4)
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        for _ in range(500):
+            n = int(rng.integers(0, 400))
+            s.sendto(bytes(rng.integers(0, 256, n).astype(np.uint8)),
+                     srv.local_addr())
+        # a valid span afterward proves the reader survived
+        from veneur_tpu.proto import ssf_pb2
+        sp = ssf_pb2.SSFSpan(version=0, trace_id=9, id=9, service="alive",
+                             name="ok", start_timestamp=1, end_timestamp=2)
+        s.sendto(sp.SerializeToString(), srv.local_addr())
+        s.close()
+        deadline = time.time() + 60
+        while time.time() < deadline and not any(
+                x.name == "ok" for x in ssink.spans):
+            time.sleep(0.05)
+        assert any(x.name == "ok" for x in ssink.spans), "reader died"
+    finally:
+        srv.shutdown()
