@@ -539,3 +539,32 @@ def test_per_flush_runtime_gauges(server):
     assert got["veneur.worker.span_chan.total_capacity"] == 100.0
     assert got["veneur.mem.heap_alloc_bytes"] > 1e6
     assert got["veneur.flush.flush_timestamp_ns"] > 1e18
+
+
+def test_pipeline_thread_survives_unexpected_exception():
+    """The dispatch backstop: an exception class nobody anticipated must
+    be counted and logged, never kill the pipeline thread (two fuzz-
+    found bug classes escaped the ParseError-only catch and silently
+    wedged the server before this existed). Python parse path: the
+    C++ engine never raises into the dispatcher."""
+    sink = DebugMetricSink()
+    srv = Server(small_config(native_ingest=False), metric_sinks=[sink])
+    srv.start()
+    orig = srv.aggregator.process_metric
+
+    def poisoned(m):
+        if m.name == "poison":
+            raise RuntimeError("injected")
+        return orig(m)
+
+    srv.aggregator.process_metric = poisoned
+    try:
+        _send_udp(srv.local_addr(), [b"poison:1|c"])
+        _wait_until(lambda: srv.internal_errors >= 1,
+                    what="backstop catch")
+        _send_udp(srv.local_addr(), [b"alive.after:2|c"])
+        _wait_processed(srv, 1)
+        srv.trigger_flush()
+        assert by_name(sink.flushed)["alive.after"].value == 2.0
+    finally:
+        srv.shutdown()

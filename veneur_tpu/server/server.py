@@ -234,6 +234,7 @@ class Server:
         self.sink_flushes_skipped = 0
         self.parse_errors = 0
         self.import_errors = 0
+        self.internal_errors = 0   # _dispatch_item backstop catches
         self.imported_total = 0
         # per-metric-sink flush accounting for the sink.* conventions
         # (sinks/sinks.go:11-29), accumulated by sink flush threads
@@ -339,7 +340,10 @@ class Server:
             # the UDP sockets, which happens after this thread launches
             if self._native_readers_active:
                 for special in self.aggregator.pump(20):
-                    self.handle_metric_packet(special)
+                    # through the backstop like every other work item (a
+                    # special is one event/service-check line; the extra
+                    # native feed() round-trip just re-classifies it)
+                    self._dispatch_item(special)
                 while True:
                     try:
                         item = self.packet_queue.get_nowait()
@@ -358,6 +362,26 @@ class Server:
                 self._dispatch_item(item)
 
     def _dispatch_item(self, item):
+        try:
+            self._dispatch_item_inner(item)
+        except Exception as e:
+            # the pipeline thread must NEVER die to a data-plane
+            # exception: two fuzz-found bug classes (set members, event
+            # datagrams) escaped the ParseError-only catch below and
+            # silently wedged the server — the backstop for the NEXT
+            # unknown class is here, at the single place every work item
+            # passes through (the native pump path routes its specials
+            # here too). Counted and logged with traceback; a flush
+            # request that died mid-handling must still release its
+            # waiter instead of letting trigger_flush block out its
+            # whole budget.
+            self.internal_errors += 1
+            log.exception("pipeline item failed (server continues); "
+                          "item=%r", type(item).__name__)
+            if isinstance(item, FlushRequest):
+                item.finish(False, f"internal error: {e}")
+
+    def _dispatch_item_inner(self, item):
         if isinstance(item, FlushRequest):
             self._handle_flush_request(item)
         elif isinstance(item, _ImportBatch):
@@ -418,6 +442,7 @@ class Server:
             "processed": self.aggregator.processed + 0,
             "dropped": self.aggregator.dropped_capacity,
             "import_errors": self.import_errors,
+            "internal_errors": self.internal_errors,
             "imported_total": self.imported_total,
             "forward_errors": self.forward_errors,
             "spans_received": self.span_pipeline.spans_received,
@@ -1131,6 +1156,8 @@ class Server:
                "veneur.worker.metrics_processed_total": stats["processed"],
                "veneur.worker.metrics_dropped_total": stats["dropped"],
                "veneur.import.errors_total": stats["import_errors"],
+               "veneur.pipeline.internal_errors_total":
+                   stats.get("internal_errors", 0),
                "veneur.import.metrics_total": stats.get("imported_total", 0),
                # the reference tags forward.error_total with a cause
                # (deadline_exceeded/post, flusher.go:512-524); the delta

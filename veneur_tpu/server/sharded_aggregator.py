@@ -25,8 +25,7 @@ import numpy as np
 
 from veneur_tpu.aggregation.host import Batcher, BatchSpec, KeyTable
 from veneur_tpu.aggregation.state import TableSpec
-from veneur_tpu.server.aggregator import (Aggregator,
-                                           set_member_bytes)
+from veneur_tpu.server.aggregator import Aggregator, set_member_bytes
 
 
 def per_shard_spec(spec: TableSpec, n_shards: int) -> TableSpec:
