@@ -465,3 +465,29 @@ def test_export_survives_invalid_utf8_key():
     assert by_name["clean.count"].counter.value == 3
     assert "n�me" in by_name       # corrupt key mangled, stream alive
     assert by_name["n�me"].counter.value == 5
+
+
+def test_forward_monitoring_metrics(tier):
+    """README §Monitoring's forwarding alerts: forward.duration_ns
+    (a timer — flushes as .count/aggregates) and
+    forward.post_metrics_total must ride the local's self-telemetry
+    after a forward."""
+    local, lsink, glob, gsink = tier
+    _send_udp(local.local_addr(), [b"fmon.c:1|c|#veneurglobalonly"])
+    _wait_processed(local, 1)
+    _flush_through(local, glob)
+    deadline = time.time() + 30
+    got = {}
+    while time.time() < deadline:
+        local.trigger_flush()
+        got = {m.name: m.value for m in lsink.flushed
+               if m.name.startswith(("veneur.forward.duration_ns",
+                                     "veneur.forward.post_metrics_"))}
+        if any(n.startswith("veneur.forward.duration_ns.") for n in got) \
+                and "veneur.forward.post_metrics_total" in got:
+            break
+        time.sleep(0.1)
+    assert got.get("veneur.forward.post_metrics_total", 0) >= 1.0, got
+    assert got.get("veneur.forward.duration_ns.count", 0) >= 1.0, got
+    # duration values are nanoseconds: a loopback POST is > 10us
+    assert got.get("veneur.forward.duration_ns.max", 0) > 1e4, got

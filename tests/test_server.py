@@ -568,3 +568,64 @@ def test_pipeline_thread_survives_unexpected_exception():
         assert by_name(sink.flushed)["alive.after"].value == 2.0
     finally:
         srv.shutdown()
+
+
+def test_reference_monitoring_metric_names(server):
+    """README §Monitoring's documented operator alerts must exist under
+    their reference names: per-type worker.metrics_flushed_total,
+    forward.duration_ns/post_metrics_total (forwarding servers), and
+    flush.error_total when a sink POST fails."""
+    srv, sink = server
+    _send_udp(srv.local_addr(), [b"mon.count:1|c", b"mon.t:3|ms"])
+    _wait_processed(srv, 2)
+    srv.trigger_flush()           # interval 1 emits the counts
+    deadline = time.time() + 30
+    got = {}
+    while time.time() < deadline:
+        srv.trigger_flush()
+        got = {(m.name, tuple(m.tags)): m.value for m in sink.flushed
+               if m.name == "veneur.worker.metrics_flushed_total"}
+        if got:
+            break
+        time.sleep(0.1)
+    by_type = {t[0].split(":", 1)[1]: v for (_n, t), v in got.items()
+               if t}
+    # counted by FLUSHED metric type: the timer's aggregates emit as
+    # counter (.count) and gauge (.min/.max/percentiles) rows
+    assert by_type.get("counter", 0) >= 1.0
+    assert by_type.get("gauge", 0) >= 1.0, by_type
+
+
+def test_sink_error_total_counts_failed_flushes():
+    from veneur_tpu.sinks.base import MetricSink
+
+    class FailingSink(MetricSink):
+        name = "failing"
+
+        def flush(self, metrics):
+            raise RuntimeError("sink down")
+
+    good = DebugMetricSink()
+    srv = Server(small_config(), metric_sinks=[good, FailingSink()])
+    srv.start()
+    try:
+        _send_udp(srv.local_addr(), [b"err.count:1|c"])
+        _wait_processed(srv, 1)
+        srv.trigger_flush()       # FailingSink raises; counted
+        deadline = time.time() + 30
+        val = 0
+        while time.time() < deadline:
+            srv.trigger_flush()
+            vals = [m.value for m in good.flushed
+                    if m.name == "veneur.flush.error_total"]
+            if vals:
+                val = sum(vals)
+                break
+            time.sleep(0.1)
+        assert val >= 1.0
+        errs = [m for m in good.flushed
+                if m.name == "veneur.flush.error_total"]
+        assert any("sink:failing" in m.tags for m in errs), (
+            [m.tags for m in errs])
+    finally:
+        srv.shutdown()

@@ -240,7 +240,11 @@ class Server:
         # (sinks/sinks.go:11-29), accumulated by sink flush threads
         self._sink_stats_lock = threading.Lock()
         self._sink_flush_stats: dict = {}
+        # README: veneur.flush.error_total, per sink like the other
+        # sink.* conventions (an untagged total can't say WHICH sink)
+        self._sink_flush_errors: dict = {}
         self.forward_errors = 0
+        self._forward_stats: list = []  # (duration_ns, n_metrics) per POST
         self._packets_received = 0
         self._packets_dropped_py = 0
         self._packets_toolong_py = 0
@@ -1129,7 +1133,7 @@ class Server:
         # idle server must still bootstrap veneur.flush.* / packet counters
         # into its own pipeline.
         self._report_self_metrics(len(final), time.perf_counter() - flush_t0,
-                                  stats)
+                                  stats, final=final)
         root.client_finish(self.trace_client)
 
     def _forward_traced(self, span, raw, table):
@@ -1139,7 +1143,7 @@ class Server:
             span.client_finish(self.trace_client)
 
     def _report_self_metrics(self, n_flushed: int, flush_seconds: float,
-                             stats: dict):
+                             stats: dict, final=None):
         """Every stage emits self-metrics through the pipeline itself
         (SURVEY §5: worker counts worker.go:513, flush totals
         flusher.go:300-336), as deltas per interval. `stats` is the counter
@@ -1200,11 +1204,45 @@ class Server:
                 "veneur.flush.unique_timeseries_total", self._unique_ts,
                 {"global_veneur": str(not self.cfg.is_local).lower()}))
             self._unique_ts = None
+        # README §Monitoring names operators alert on:
+        # worker.metrics_flushed_total by metric_type (unique name-tag-
+        # type combos this interval), forward.duration_ns +
+        # forward.post_metrics_total per POST, flush.error_total for
+        # sink POST errors
+        if final is not None and len(final):
+            from collections import Counter
+
+            from veneur_tpu.server.flusher import MetricFrame
+            if isinstance(final, MetricFrame):
+                by_type = Counter()
+                for seg in final.segments:
+                    by_type[seg.mtype] += len(seg.names)
+            else:
+                by_type = Counter(m.type for m in final)
+            for mtype, n in sorted(by_type.items()):
+                samples.append(ssf_samples.count(
+                    "veneur.worker.metrics_flushed_total", n,
+                    {"metric_type": mtype}))
+        with self._reader_fold_lock:
+            fstats, self._forward_stats = self._forward_stats, []
+        for dur_ns, n_metrics in fstats:
+            samples.append(ssf_samples.timing(
+                "veneur.forward.duration_ns", dur_ns / 1e9))
+            samples.append(ssf_samples.count(
+                "veneur.forward.post_metrics_total", n_metrics))
         # per-metric-sink conventions, measured centrally by the fan-out
         # (sinks/sinks.go:11-24; the previous interval's threads that
         # outlived the barrier settle into the NEXT interval's report)
         with self._sink_stats_lock:
             sink_stats, self._sink_flush_stats = self._sink_flush_stats, {}
+            sink_errs = dict(self._sink_flush_errors)
+        for sname, total in sink_errs.items():
+            key = f"veneur.flush.error_total|{sname}"
+            delta = total - self._last_stats.get(key, 0)
+            self._last_stats[key] = total
+            if delta:
+                samples.append(ssf_samples.count(
+                    "veneur.flush.error_total", delta, {"sink": sname}))
         for name, (rows, total_ns) in sink_stats.items():
             tags = {"sink": name}
             if rows:
@@ -1289,6 +1327,7 @@ class Server:
         propagated to the peer over HTTP so its /import spans join this
         flush's trace."""
         from veneur_tpu.forward.convert import export_metrics
+        t0 = time.perf_counter_ns()
         try:
             metrics = export_metrics(
                 raw, table, compression=self.aggregator.spec.compression,
@@ -1297,6 +1336,13 @@ class Server:
                 self._forward_client.send_metrics(
                     metrics, timeout=self.interval, parent_span=span,
                     trace_client=self.trace_client)
+                # README §Monitoring: veneur.forward.duration_ns +
+                # forward.post_metrics_total are the documented operator
+                # alerts for the forward path; drained by the next
+                # interval's self-telemetry report
+                with self._reader_fold_lock:
+                    self._forward_stats.append(
+                        (time.perf_counter_ns() - t0, len(metrics)))
         except Exception as e:
             # concurrent forwards (one aux thread per interval; a slow
             # failure can overlap the next interval's) make += lossy —
@@ -1323,6 +1369,9 @@ class Server:
             ok = False
             if span is not None:
                 span.error = True
+            with self._sink_stats_lock:
+                self._sink_flush_errors[sink.name] = (
+                    self._sink_flush_errors.get(sink.name, 0) + 1)
             log.warning("sink %s flush failed: %s", sink.name, e)
         finally:
             # the centrally-measured sink.* conventions
