@@ -271,3 +271,52 @@ def test_traced_post_raises_and_marks_error_on_4xx():
         assert len(rt) == 1 and rt[0].error
     finally:
         httpd.shutdown()
+
+
+def test_import_request_telemetry(http_server):
+    """README §Monitoring on the global node: import.request_error_total
+    (cause-tagged) and import.response_duration_ns (part-tagged) must
+    ride the self-telemetry loop (handlers_global.go:96-190,
+    http.go:78)."""
+    import urllib.error
+
+    srv, sink = http_server
+    url = f"http://127.0.0.1:{srv.http_port}/import"
+
+    def post(body, **headers):
+        req = urllib.request.Request(url, data=body, method="POST",
+                                     headers=headers)
+        try:
+            with urllib.request.urlopen(req, timeout=10) as r:
+                return r.status
+        except urllib.error.HTTPError as e:
+            return e.code
+
+    # one of each error cause + one success
+    assert post(b"not json", **{"Content-Type": "application/json"}) == 400
+    assert post(b"x", **{"Content-Type": "application/json",
+                         "Content-Encoding": "deflate"}) == 400
+    assert post(b"x", **{"Content-Encoding": "snappy"}) == 415
+    m = mpb.Metric(name="imp.c", type=mpb.Counter, scope=mpb.Global)
+    m.counter.value = 1
+    good = fpb.MetricList(metrics=[m]).SerializeToString()
+    assert post(good,
+                **{"Content-Type": "application/x-protobuf"}) == 202
+
+    deadline = time.time() + 30
+    causes, parts = set(), set()
+    while time.time() < deadline:
+        srv.trigger_flush()
+        for m in sink.flushed:
+            if m.name == "veneur.import.request_error_total":
+                causes |= {t for t in m.tags if t.startswith("cause:")}
+            if m.name.startswith("veneur.import.response_duration_ns"):
+                parts |= {t for t in m.tags if t.startswith("part:")}
+        if {"cause:json", "cause:deflate",
+                "cause:unknown_content_encoding"} <= causes \
+                and {"part:request", "part:merge"} <= parts:
+            break
+        time.sleep(0.1)
+    assert {"cause:json", "cause:deflate",
+            "cause:unknown_content_encoding"} <= causes, causes
+    assert {"part:request", "part:merge"} <= parts, parts

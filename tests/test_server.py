@@ -571,10 +571,10 @@ def test_pipeline_thread_survives_unexpected_exception():
 
 
 def test_reference_monitoring_metric_names(server):
-    """README §Monitoring's documented operator alerts must exist under
-    their reference names: per-type worker.metrics_flushed_total,
-    forward.duration_ns/post_metrics_total (forwarding servers), and
-    flush.error_total when a sink POST fails."""
+    """README §Monitoring: veneur.worker.metrics_flushed_total must
+    flush per metric type. (forward.* names: test_forward.py
+    test_forward_monitoring_metrics; flush.error_total:
+    test_sink_error_total_counts_failed_flushes below.)"""
     srv, sink = server
     _send_udp(srv.local_addr(), [b"mon.count:1|c", b"mon.t:3|ms"])
     _wait_processed(srv, 2)

@@ -157,7 +157,29 @@ def start_http_server(server, address) -> "http.server.ThreadingHTTPServer":
             else:
                 self._reply(404, b"not found")
 
+        def _import_error(self, cause: str) -> None:
+            """README §Monitoring: veneur.import.request_error_total
+            with the reference's cause tags (handlers_global.go:96,146,
+            154,163), through the self-telemetry loop."""
+            from veneur_tpu.samplers import ssf_samples
+            from veneur_tpu.trace.client import report_one
+            report_one(server.trace_client, ssf_samples.count(
+                "veneur.import.request_error_total", 1, {"cause": cause}))
+
+        def _import_timing(self, t0_ns: int, part: str) -> None:
+            """veneur.import.response_duration_ns tagged part:request/
+            merge (handlers_global.go:190, http.go:78)."""
+            import time as _time
+
+            from veneur_tpu.samplers import ssf_samples
+            from veneur_tpu.trace.client import report_one
+            report_one(server.trace_client, ssf_samples.timing(
+                "veneur.import.response_duration_ns",
+                (_time.perf_counter_ns() - t0_ns) / 1e9, {"part": part}))
+
         def _handle_import(self):
+            import time as _time
+            self._import_t0 = _time.perf_counter_ns()
             length = int(self.headers.get("Content-Length", "0"))
             body = self.rfile.read(length)
             encoding = self.headers.get("Content-Encoding", "")
@@ -165,11 +187,13 @@ def start_http_server(server, address) -> "http.server.ThreadingHTTPServer":
                 try:
                     body = zlib.decompress(body)
                 except zlib.error:
+                    self._import_error("deflate")
                     self._reply(400, b"bad deflate body")
                     return
             elif encoding not in ("", "identity"):
                 # reference: unknown encodings are 415
                 # (handlers_global.go:150-156)
+                self._import_error("unknown_content_encoding")
                 self._reply(415, encoding.encode())
                 return
             if not body.strip():
@@ -196,6 +220,7 @@ def start_http_server(server, address) -> "http.server.ThreadingHTTPServer":
             try:
                 jms = json.loads(body)
             except ValueError:
+                self._import_error("json")
                 self._reply(400, b"bad JSON body")
                 return
             if not isinstance(jms, list) or not jms:
@@ -217,6 +242,7 @@ def start_http_server(server, address) -> "http.server.ThreadingHTTPServer":
                                  b"metrics")
                 return
             server.import_metrics(metrics)
+            self._import_timing(self._import_t0, "request")
             self._reply(202, b"imported")
 
         def _import_protobuf(self, body: bytes) -> None:
@@ -224,9 +250,11 @@ def start_http_server(server, address) -> "http.server.ThreadingHTTPServer":
             try:
                 mlist = fpb.MetricList.FromString(body)
             except Exception:
+                self._import_error("protobuf")
                 self._reply(400, b"bad MetricList protobuf")
                 return
             server.import_metrics(list(mlist.metrics))
+            self._import_timing(self._import_t0, "request")
             self._reply(202, b"imported")
 
         def _quit(self):

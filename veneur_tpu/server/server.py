@@ -29,8 +29,9 @@ from typing import List, Optional
 from veneur_tpu.aggregation.host import BatchSpec
 from veneur_tpu.aggregation.state import TableSpec
 from veneur_tpu.config import Config
-from veneur_tpu.samplers import parser
+from veneur_tpu.samplers import parser, ssf_samples
 from veneur_tpu.samplers.intermetric import InterMetric
+from veneur_tpu.trace.client import report_one
 from veneur_tpu.server.aggregator import Aggregator
 from veneur_tpu.server.flusher import generate_intermetrics
 
@@ -244,7 +245,9 @@ class Server:
         # sink.* conventions (an untagged total can't say WHICH sink)
         self._sink_flush_errors: dict = {}
         self.forward_errors = 0
-        self._forward_stats: list = []  # (duration_ns, n_metrics) per POST
+        # (duration_ns, n_metrics) per forward POST, success or failure;
+        # guarded by _sink_stats_lock with the other flush telemetry
+        self._forward_stats: list = []
         self._packets_received = 0
         self._packets_dropped_py = 0
         self._packets_toolong_py = 0
@@ -393,6 +396,7 @@ class Server:
             # counted here on the single pipeline thread, not in the
             # multi-threaded gRPC handler, so concurrent imports can't
             # lose increments (importsrv/server.go:130 import.metrics_total)
+            t0 = time.perf_counter_ns()
             self.imported_total += len(item)
             for metric in item:
                 try:
@@ -404,6 +408,13 @@ class Server:
                     self.import_errors += 1
                     log.warning("bad imported metric %s: %s",
                                 metric.name, e)
+            # README §Monitoring: import.response_duration_ns part:merge
+            # (http.go:78 — time spent handing metrics to workers);
+            # helpers imported at module top — this is the serialized
+            # pipeline thread, no per-batch sys.modules hits
+            report_one(self.trace_client, ssf_samples.timing(
+                "veneur.import.response_duration_ns",
+                (time.perf_counter_ns() - t0) / 1e9, {"part": "merge"}))
         elif isinstance(item, _SpanMetricBatch):
             for m in item:
                 self.aggregator.process_metric(m)
@@ -1223,7 +1234,7 @@ class Server:
                 samples.append(ssf_samples.count(
                     "veneur.worker.metrics_flushed_total", n,
                     {"metric_type": mtype}))
-        with self._reader_fold_lock:
+        with self._sink_stats_lock:
             fstats, self._forward_stats = self._forward_stats, []
         for dur_ns, n_metrics in fstats:
             samples.append(ssf_samples.timing(
@@ -1235,14 +1246,13 @@ class Server:
         # outlived the barrier settle into the NEXT interval's report)
         with self._sink_stats_lock:
             sink_stats, self._sink_flush_stats = self._sink_flush_stats, {}
-            sink_errs = dict(self._sink_flush_errors)
-        for sname, total in sink_errs.items():
-            key = f"veneur.flush.error_total|{sname}"
-            delta = total - self._last_stats.get(key, 0)
-            self._last_stats[key] = total
-            if delta:
-                samples.append(ssf_samples.count(
-                    "veneur.flush.error_total", delta, {"sink": sname}))
+            # swap-and-reset like _sink_flush_stats: stragglers from an
+            # abandoned sink thread land in the next interval's dict
+            sink_errs, self._sink_flush_errors = (
+                self._sink_flush_errors, {})
+        for sname, n in sink_errs.items():
+            samples.append(ssf_samples.count(
+                "veneur.flush.error_total", n, {"sink": sname}))
         for name, (rows, total_ns) in sink_stats.items():
             tags = {"sink": name}
             if rows:
@@ -1328,21 +1338,16 @@ class Server:
         flush's trace."""
         from veneur_tpu.forward.convert import export_metrics
         t0 = time.perf_counter_ns()
+        n_metrics = 0
         try:
             metrics = export_metrics(
                 raw, table, compression=self.aggregator.spec.compression,
                 hll_precision=self.aggregator.spec.hll_precision)
+            n_metrics = len(metrics)
             if metrics:
                 self._forward_client.send_metrics(
                     metrics, timeout=self.interval, parent_span=span,
                     trace_client=self.trace_client)
-                # README §Monitoring: veneur.forward.duration_ns +
-                # forward.post_metrics_total are the documented operator
-                # alerts for the forward path; drained by the next
-                # interval's self-telemetry report
-                with self._reader_fold_lock:
-                    self._forward_stats.append(
-                        (time.perf_counter_ns() - t0, len(metrics)))
         except Exception as e:
             # concurrent forwards (one aux thread per interval; a slow
             # failure can overlap the next interval's) make += lossy —
@@ -1352,6 +1357,16 @@ class Server:
             if span is not None:
                 span.error = True
             log.warning("forward failed: %s", e)
+        finally:
+            # README §Monitoring: veneur.forward.duration_ns +
+            # forward.post_metrics_total, drained by the next interval's
+            # self-telemetry report. Recorded on FAILURE too — the
+            # duration alert exists precisely for degraded forwards, and
+            # a timed-out POST must show as a latency spike, not as an
+            # absent metric.
+            with self._sink_stats_lock:
+                self._forward_stats.append(
+                    (time.perf_counter_ns() - t0, n_metrics))
 
     def _flush_sink(self, sink, metrics, parent=None):
         """metrics is a List[InterMetric] or a flusher.MetricFrame —
