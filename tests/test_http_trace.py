@@ -222,8 +222,13 @@ def test_traced_post_connection_event_span_chain():
         assert all(s.parent_id == rt.id for s in recorded[:-1])
         conn_span = recorded[2]
         assert conn_span.tags["was_idle"] == "false"
+        # the roundtrip span carries the POST body size count
+        # (http/http.go:202 content_length_bytes)
+        sizes = [m for m in rt.metrics
+                 if m.name == "veneur.forward.content_length_bytes"]
+        assert len(sizes) == 1 and sizes[0].value == 3.0
         counts = [m for m in conn_span.metrics
-                  if m.name == "forward.connections_used_total"]
+                  if m.name == "veneur.forward.connections_used_total"]
         assert len(counts) == 1 and counts[0].tags["state"] == "new"
         # phases tile the timeline: each ends before the next begins
         for a, b in zip(recorded[:-2], recorded[1:-1]):

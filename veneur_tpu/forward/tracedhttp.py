@@ -80,6 +80,11 @@ def traced_post(url: str, body: bytes, headers: Dict[str, str],
     rt = parent_span.child("http.post") if parent_span is not None else None
     if rt is not None:
         rt.set_tag("action", action)
+        # README §Monitoring: veneur.<action>.content_length_bytes — the
+        # POST body size PostHelper reports (http/http.go:202, a count
+        # sample carrying the byte length)
+        rt.add(ssf_samples.count(
+            "veneur." + action + ".content_length_bytes", len(body)))
 
     import urllib.request
     proxies = urllib.request.getproxies()
@@ -147,7 +152,8 @@ def traced_post(url: str, body: bytes, headers: Dict[str, str],
         if sp is not None:
             sp.set_tag("was_idle", "false")
             sp.add(ssf_samples.count(
-                action + ".connections_used_total", 1, {"state": "new"}))
+                "veneur." + action + ".connections_used_total", 1,
+                {"state": "new"}))
 
         # HTTPSConnection for its default_port=443, so the Host header
         # omits the port exactly as a stock client would (strict virtual
