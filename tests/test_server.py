@@ -438,12 +438,13 @@ def test_stats_address_mirrors_self_metrics():
         _wait_processed(srv, 1)
         assert srv.trigger_flush()
         got = b""
-        deadline = time.time() + 5
-        while time.time() < deadline and b"veneur." not in got:
+        deadline = time.time() + 15
+        while time.time() < deadline \
+                and b"veneur.worker.metrics_processed_total" not in got:
             try:
                 got += ext.recv(65536) + b"\n"
             except socket.timeout:
-                break
+                continue   # quiet gap; the deadline bounds the wait
         assert b"veneur.worker.metrics_processed_total" in got
         assert b"|c" in got
     finally:
@@ -498,17 +499,18 @@ def test_sink_flush_conventions_reported():
             time.sleep(0.02)
         assert srv.trigger_flush()
         got = b""
-        deadline = time.time() + 10
-        want = (b"sink.metrics_flushed_total", b"sink:debug",
-                b"sink.metric_flush_total_duration_ns",
-                b"sink.spans_flushed_total",
-                b"worker.span.flush_duration_ns",
-                b"sink.span_ingest_total_duration_ns")
+        deadline = time.time() + 30
+        want = (b"veneur.worker.metrics_processed_total",
+                b"veneur.sink.metrics_flushed_total", b"sink:debug",
+                b"veneur.sink.metric_flush_total_duration_ns",
+                b"veneur.sink.spans_flushed_total",
+                b"veneur.worker.span.flush_duration_ns",
+                b"veneur.sink.span_ingest_total_duration_ns")
         while time.time() < deadline and not all(w in got for w in want):
             try:
                 got += ext.recv(65536) + b"\n"
             except socket.timeout:
-                break
+                continue   # quiet gap; the deadline bounds the wait
         for w in want:
             assert w in got, (w, got[-1500:])
     finally:

@@ -1257,9 +1257,9 @@ class Server:
             tags = {"sink": name}
             if rows:
                 samples.append(ssf_samples.count(
-                    "sink.metrics_flushed_total", rows, tags))
+                    "veneur.sink.metrics_flushed_total", rows, tags))
             samples.append(ssf_samples.timing(
-                "sink.metric_flush_total_duration_ns", total_ns / 1e9,
+                "veneur.sink.metric_flush_total_duration_ns", total_ns / 1e9,
                 tags))
         for name, total in cur.items():
             delta = total - self._last_stats.get(name, 0)

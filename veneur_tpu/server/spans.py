@@ -135,17 +135,20 @@ class SpanPipeline:
                         self._ingest_ns.get(sink.name, 0)
                         + time.perf_counter_ns() - t0)
                     # only successfully-ingested spans count toward
-                    # sink.spans_flushed_total — a dead sink must not
-                    # look healthy on dashboards
+                    # veneur.sink.spans_flushed_total — a dead sink must
+                    # not look healthy on dashboards
                     self._ingested[sink.name] = (
                         self._ingested.get(sink.name, 0) + ok_spans)
 
     def flush(self):
         """worker.go:698 SpanWorker.Flush: flush every span sink, timing
         each, then report the per-sink conventions the reference's span
-        worker emits (worker.go:706-713): worker.span.flush_duration_ns,
-        sink.span_ingest_total_duration_ns (cumulative since last flush),
-        and sink.spans_flushed_total (measured centrally as spans
+        worker emits (worker.go:706-713), veneur.-prefixed like the
+        reference's central ssf.NamePrefix:
+        veneur.worker.span.flush_duration_ns,
+        veneur.sink.span_ingest_total_duration_ns (cumulative since last
+        flush), and veneur.sink.spans_flushed_total (measured centrally
+        as spans
         delivered to the sink — a sampling sink may send fewer downstream,
         which its own telemetry covers)."""
         with self._stats_lock:
@@ -163,15 +166,15 @@ class SpanPipeline:
             from veneur_tpu.samplers import ssf_samples
             tags = {"sink": sink.name}
             samples.append(ssf_samples.timing(
-                "worker.span.flush_duration_ns",
+                "veneur.worker.span.flush_duration_ns",
                 (time.perf_counter_ns() - t0) / 1e9, tags))
             samples.append(ssf_samples.timing(
-                "sink.span_ingest_total_duration_ns",
+                "veneur.sink.span_ingest_total_duration_ns",
                 ing_ns.get(sink.name, 0) / 1e9, tags))
             n = ing_n.get(sink.name, 0)
             if n:
                 samples.append(ssf_samples.count(
-                    "sink.spans_flushed_total", n, tags))
+                    "veneur.sink.spans_flushed_total", n, tags))
         if samples and self.report_samples is not None:
             try:
                 self.report_samples(samples)
