@@ -487,7 +487,10 @@ def config5_span_firehose(scale=1.0):
                      tag_frequency_top_k=hot_tags,
                      tag_frequency_batch_size=8192)
     try:
-        handle = srv.span_pipeline.handle_span
+        import functools
+        # production wire path includes the per-service intake counters
+        handle = functools.partial(srv.span_pipeline.handle_span,
+                                   ssf_format="packet")
         # warm: one span through the pipeline compiles the count-min
         # update; flush resets the sketch so warm tags don't leak in
         warm_span = ssf_pb2.SSFSpan(version=0, trace_id=1, id=2,

@@ -195,7 +195,8 @@ def bench_handle_ssf(seconds):
 
     def run():
         for _ in range(100):
-            while not pipe.handle_span(parse_ssf(data)):
+            while not pipe.handle_span(parse_ssf(data),
+                                        ssf_format="packet"):
                 time.sleep(0.0005)
 
     try:

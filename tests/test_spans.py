@@ -299,3 +299,51 @@ def test_indicator_template_cache_cold_hot_bit_identical():
     u_warm = parser.convert_span_uniqueness_metrics(sp2, rate=1.0)
     assert u_cold[0].value == u_warm[0].value == sp2.name
     assert u_cold[0].sample_rate == u_warm[0].sample_rate
+
+
+def test_per_service_span_intake_telemetry():
+    """flusher.go:463-466: every flush drains per-(service, ssf_format)
+    intake counters into ssf.spans.received_total (+ the root variant,
+    tagged veneurglobalonly so the global tier aggregates
+    infrastructure-wide root counts)."""
+    msink = DebugMetricSink()
+    srv = Server(small_config(statsd_listen_addresses=[],
+                              ssf_listen_addresses=["udp://127.0.0.1:0"]),
+                 metric_sinks=[msink])
+    srv.start()
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        for i in range(4):
+            sp = make_span(trace_id=10 + i, span_id=10 + i if i < 2
+                           else 99 + i, service="svc-a")
+            s.sendto(sp.SerializeToString(), srv.local_addr())
+        s.close()
+        # all 4 must be COUNTED at intake before the first drain, or a
+        # partial delta splits across flushes
+        t0 = time.time()
+        while srv.span_pipeline.spans_received < 4 \
+                and time.time() - t0 < 60:
+            time.sleep(0.02)
+        deadline = time.time() + 60
+        totals, tags_seen = {}, {}
+        seen_ids = set()
+        while time.time() < deadline:
+            srv.trigger_flush()
+            for m in msink.flushed:
+                if m.name.startswith("veneur.ssf.spans.") \
+                        and id(m) not in seen_ids:
+                    seen_ids.add(id(m))
+                    # ACCUMULATE: deltas may split across intervals
+                    totals[m.name] = totals.get(m.name, 0) + m.value
+                    tags_seen[m.name] = list(m.tags)
+            if totals.get("veneur.ssf.spans.received_total", 0) >= 4:
+                break
+            time.sleep(0.1)
+        assert totals.get("veneur.ssf.spans.received_total") == 4.0, totals
+        rtags = tags_seen["veneur.ssf.spans.received_total"]
+        assert "service:svc-a" in rtags and "ssf_format:packet" in rtags
+        # 2 of the 4 were root spans (id == trace_id)
+        assert totals.get(
+            "veneur.ssf.spans.root.received_total") == 2.0, totals
+    finally:
+        srv.shutdown()

@@ -587,7 +587,7 @@ class Server:
             except Exception:
                 self.parse_errors += 1
                 continue
-            self.span_pipeline.handle_span(span)
+            self.span_pipeline.handle_span(span, ssf_format="packet")
 
     def _ssf_stream_listener(self, sock: socket.socket):
         """Framed SSF stream (server.go:1160 ReadSSFStreamSocket)."""
@@ -639,7 +639,8 @@ class Server:
                     except Exception:
                         self.parse_errors += 1
                         continue
-                    self.span_pipeline.handle_span(span)
+                    self.span_pipeline.handle_span(span,
+                                                   ssf_format="framed")
 
     def _tcp_listener(self, sock: socket.socket, tls_ctx):
         """reference server.go:1283 ReadTCPSocket: newline-delimited metrics
@@ -1234,6 +1235,19 @@ class Server:
                 samples.append(ssf_samples.count(
                     "veneur.worker.metrics_flushed_total", n,
                     {"metric_type": mtype}))
+        # per-(service, ssf_format) span intake (flusher.go:463-466):
+        # ssf.spans.received_total + the root-span variant, which carries
+        # veneurglobalonly so infrastructure-wide root counts aggregate
+        # on the global tier exactly like the reference's
+        for (service, fmt), (n, n_root) in sorted(
+                self.span_pipeline.drain_service_counts().items()):
+            tags = {"service": service, "ssf_format": fmt}
+            samples.append(ssf_samples.count(
+                "veneur.ssf.spans.received_total", n, tags))
+            if n_root:
+                samples.append(ssf_samples.count(
+                    "veneur.ssf.spans.root.received_total", n_root,
+                    dict(tags, veneurglobalonly="true")))
         with self._sink_stats_lock:
             fstats, self._forward_stats = self._forward_stats, []
         for dur_ns, n_metrics in fstats:

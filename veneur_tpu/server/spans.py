@@ -45,12 +45,32 @@ class SpanPipeline:
         self._stats_lock = threading.Lock()
         self._ingest_ns: dict = {}
         self._ingested: dict = {}
+        # per-(service, ssf_format) intake counters since the last drain
+        # (server.go:154-157 ssfServiceSpanMetrics in a sync.Map;
+        # flusher.go:463-466 swaps them out per flush): value is
+        # [received, root_received]. Own lock: listener threads must not
+        # contend with the span workers' per-batch stats lock.
+        self._svc_lock = threading.Lock()
+        self._svc_counts: dict = {}
 
     # -- intake (server.go:1022 handleSSF) ----------------------------------
-    def handle_span(self, span) -> bool:
+    def handle_span(self, span, ssf_format: str = None) -> bool:
         """Enqueue; returns False when the channel is full (the reference
-        blocks; we drop + count to protect the UDP readers)."""
+        blocks; we drop + count to protect the UDP readers). ssf_format
+        ("packet"/"framed") is set by the WIRE listeners only: the
+        reference's channel client feeds SpanChan directly
+        (server.go:310), bypassing the per-service intake counters, so
+        self-telemetry spans (format None) skip them too."""
         self.spans_received += 1
+        if ssf_format is not None:
+            key = (span.service, ssf_format)
+            with self._svc_lock:
+                c = self._svc_counts.get(key)
+                if c is None:
+                    c = self._svc_counts[key] = [0, 0]
+                c[0] += 1
+                if span.id == span.trace_id:
+                    c[1] += 1
         try:
             self.chan.put_nowait(span)
             return True
@@ -58,6 +78,13 @@ class SpanPipeline:
             self.spans_dropped += 1
             self.chan_cap_hits += 1   # worker.go:717 hit_chan_cap
             return False
+
+    def drain_service_counts(self) -> dict:
+        """Swap out the per-(service, format) intake counters (the
+        flusher.go:463 atomic-swap idiom)."""
+        with self._svc_lock:
+            counts, self._svc_counts = self._svc_counts, {}
+        return counts
 
     # -- workers (worker.go:611 SpanWorker.Work) ----------------------------
     def start(self):
