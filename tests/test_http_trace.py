@@ -325,3 +325,25 @@ def test_import_request_telemetry(http_server):
     assert {"cause:json", "cause:deflate",
             "cause:unknown_content_encoding"} <= causes, causes
     assert {"part:request", "part:merge"} <= parts, parts
+
+
+def test_import_metric_count_names(http_server):
+    """Both reference import-count names must flush: import.metrics_total
+    (importsrv/server.go:129) and the worker-level alias operators alert
+    on (worker.go:514)."""
+    srv, sink = http_server
+    m = mpb.Metric(name="imp.alias", type=mpb.Counter, scope=mpb.Global)
+    m.counter.value = 2
+    srv.import_metrics([m])
+    deadline = time.time() + 30
+    names = set()
+    while time.time() < deadline:
+        srv.trigger_flush()
+        names = {x.name for x in sink.flushed
+                 if x.name in ("veneur.import.metrics_total",
+                               "veneur.worker.metrics_imported_total")}
+        if len(names) == 2:
+            break
+        time.sleep(0.1)
+    assert names == {"veneur.import.metrics_total",
+                     "veneur.worker.metrics_imported_total"}, names

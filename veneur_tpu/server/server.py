@@ -1175,6 +1175,11 @@ class Server:
                "veneur.pipeline.internal_errors_total":
                    stats.get("internal_errors", 0),
                "veneur.import.metrics_total": stats.get("imported_total", 0),
+               # the reference emits BOTH: import.metrics_total from the
+               # import server (importsrv/server.go:129) and the worker-
+               # level alias operators alert on (worker.go:514)
+               "veneur.worker.metrics_imported_total":
+                   stats.get("imported_total", 0),
                # the reference tags forward.error_total with a cause
                # (deadline_exceeded/post, flusher.go:512-524); the delta
                # counter here is untagged — the log line carries the why
