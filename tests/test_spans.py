@@ -347,3 +347,33 @@ def test_per_service_span_intake_telemetry():
             "veneur.ssf.spans.root.received_total") == 2.0, totals
     finally:
         srv.shutdown()
+
+
+def test_span_worker_common_tag_application():
+    """worker.go:155 TestSpanWorkerTagApplication: config tags are
+    stamped onto every span WITHOUT clobbering tags the span already
+    carries."""
+    ssink = DebugSpanSink()
+    srv = Server(small_config(statsd_listen_addresses=[],
+                              ssf_listen_addresses=["udp://127.0.0.1:0"],
+                              tags=["env:prod", "dc:iad", "bare"]),
+                 metric_sinks=[DebugMetricSink()], span_sinks=[ssink])
+    srv.start()
+    try:
+        sp = make_span(service="svc-t")
+        sp.tags["env"] = "already-set"     # must NOT be clobbered
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.sendto(sp.SerializeToString(), srv.local_addr())
+        s.close()
+        deadline = time.time() + 60
+        while time.time() < deadline and not any(
+                x.service == "svc-t" for x in ssink.spans):
+            time.sleep(0.05)
+        got = [x for x in ssink.spans if x.service == "svc-t"]
+        assert got, "span never reached the sink"
+        tags = dict(got[0].tags)
+        assert tags["env"] == "already-set"
+        assert tags["dc"] == "iad"
+        assert tags["bare"] == ""          # bare tag -> empty value
+    finally:
+        srv.shutdown()
