@@ -61,10 +61,14 @@ class SpanPipeline:
         reference's channel client feeds SpanChan directly
         (server.go:310), bypassing the per-service intake counters, so
         self-telemetry spans (format None) skip them too."""
-        self.spans_received += 1
-        if ssf_format is not None:
-            key = (span.service, ssf_format)
-            with self._svc_lock:
+        # += on an attribute is read-modify-write; concurrent listener
+        # threads can interleave at bytecode boundaries and lose counts
+        # (the reference uses atomics) — one short lock covers both
+        # counters
+        with self._svc_lock:
+            self.spans_received += 1
+            if ssf_format is not None:
+                key = (span.service, ssf_format)
                 c = self._svc_counts.get(key)
                 if c is None:
                     c = self._svc_counts[key] = [0, 0]
@@ -75,8 +79,9 @@ class SpanPipeline:
             self.chan.put_nowait(span)
             return True
         except queue.Full:
-            self.spans_dropped += 1
-            self.chan_cap_hits += 1   # worker.go:717 hit_chan_cap
+            with self._svc_lock:
+                self.spans_dropped += 1
+                self.chan_cap_hits += 1   # worker.go:717 hit_chan_cap
             return False
 
     def drain_service_counts(self) -> dict:
@@ -124,7 +129,8 @@ class SpanPipeline:
                         span.tags[k] = v
                 # drop spans that are invalid traces and carry no metrics
                 if not valid_trace(span) and not span.metrics:
-                    self.spans_dropped += 1
+                    with self._svc_lock:
+                        self.spans_dropped += 1
                     continue
                 spans.append(span)
             if not spans:
@@ -154,7 +160,8 @@ class SpanPipeline:
                             sink.ingest(span)
                             ok_spans += 1
                         except Exception as e:
-                            self.sink_errors += 1
+                            with self._stats_lock:
+                                self.sink_errors += 1
                             log.warning("span sink %s ingest failed: %s",
                                         sink.name, e)
                 with self._stats_lock:
