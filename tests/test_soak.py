@@ -219,3 +219,83 @@ def test_soak_sharded_mesh_all_types():
     finally:
         s.close()
         srv.shutdown()
+
+
+def test_combined_storm_exact_totals():
+    """Metrics, service checks, and events from concurrent sender
+    threads with concurrent ticker-style flushes: counter totals must
+    stay EXACT across interval swaps and service checks must flush, with
+    zero internal errors (one flush worker, many writers — the
+    concurrency shape production runs; events ride along to exercise
+    the buffer path under contention)."""
+    import threading
+
+    msink = DebugMetricSink()
+    srv = Server(small_config(
+        tpu_counter_capacity=1024, tpu_histo_capacity=256,
+        tpu_set_capacity=64, tpu_gauge_capacity=128),
+        metric_sinks=[msink])
+    srv.start()
+    addr = srv.local_addr()
+    try:
+        errors = []
+
+        def storm(tid):
+            s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            try:
+                for it in range(3):
+                    for i in range(200):
+                        s.sendto(b"st%d.c%d:2|c" % (tid, i % 40), addr)
+                        if i % 7 == 0:
+                            s.sendto(b"st%d.t:%d|ms" % (tid, i), addr)
+                        if i % 60 == 0:
+                            s.sendto(b"_e{5,5}:hello|world", addr)
+                            s.sendto(b"_sc|st%d.chk|0|m:ok" % tid, addr)
+                    time.sleep(0.03)
+            except Exception as e:
+                errors.append(e)
+            finally:
+                s.close()
+
+        flush_oks = []
+
+        def flusher():
+            for _ in range(5):
+                time.sleep(0.4)
+                flush_oks.append(srv.trigger_flush(timeout=120))
+
+        ts = [threading.Thread(target=storm, args=(t,)) for t in range(3)]
+        ts.append(threading.Thread(target=flusher))
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not errors, errors
+        assert all(flush_oks), flush_oks
+        # drain by the PROCESSED counter (works on the native-reader
+        # path too, where UDP datagrams bypass packet_queue): per
+        # thread-iteration 200 counters + 29 timers + 4 service checks
+        want_processed = 3 * 3 * (200 + 29 + 4)
+        deadline = time.time() + 60
+        while time.time() < deadline \
+                and srv.aggregator.processed < want_processed \
+                and srv.packets_dropped == 0:
+            time.sleep(0.05)
+        assert srv.trigger_flush(timeout=120)
+        if srv.packets_dropped:
+            pytest.skip(f"loopback dropped {srv.packets_dropped} "
+                        "datagrams; exactness unverifiable this run")
+        import re
+        counter_name = re.compile(r"st\d+\.c\d+$")
+        total = sum(m.value for m in msink.flushed
+                    if counter_name.match(m.name))
+        expect = 3 * 3 * 200 * 2
+        assert srv.internal_errors == 0
+        assert srv.aggregator.dropped_capacity == 0
+        assert total == expect, (total, expect)
+        # service checks flushed through the status path under contention
+        chk = {m.name for m in msink.flushed
+               if m.name.endswith(".chk")}
+        assert chk == {f"st{t}.chk" for t in range(3)}, chk
+    finally:
+        srv.shutdown()
