@@ -811,7 +811,7 @@ def pin_platform():
         jax.config.update("jax_platforms", "cpu")
 
 
-def _run_config_subprocess(n, scale, force_cpu=False):
+def _run_config_subprocess(n, scale, force_cpu=False, budget_cap=None):
     """One config per subprocess. Two reasons: (a) the reference's own
     perf story is per-benchmark processes (`go test -bench` spawns a
     fresh process per package), and (b) the tunneled single-chip backend
@@ -830,6 +830,11 @@ def _run_config_subprocess(n, scale, force_cpu=False):
     # block every child from acquiring the single tunneled chip
     env = cache_env(force_cpu=force_cpu)
     budget = _config_budget(n)
+    if budget_cap is not None:
+        # the orchestrator's wall-clock guard wins over per-config
+        # budgets: a partial e2e block inside the driver's budget beats
+        # a complete one that ships as rc=124 (the r04 failure class)
+        budget = min(budget, max(60.0, budget_cap))
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True,
                               cwd=repo, timeout=budget, env=env)
@@ -845,7 +850,12 @@ def _run_config_subprocess(n, scale, force_cpu=False):
 
 
 def main(configs=None, scale=None, in_process=False, force_cpu=False,
-         on_result=None):
+         on_result=None, deadline=None):
+    """`configs` runs in the GIVEN order when passed explicitly (the
+    bench orchestrator front-loads the headline configs so a wall-clock
+    guard truncates the tail, not the head); default remains all configs
+    in numeric order. `deadline` (time.monotonic() absolute) skips
+    configs that can't start and caps the budget of the one in flight."""
     if in_process:
         # only the in-process (child) path may touch the backend; the
         # subprocess orchestrator must stay off the chip entirely
@@ -858,13 +868,21 @@ def main(configs=None, scale=None, in_process=False, force_cpu=False,
         if scale is None:
             scale = 1.0 if on_tpu else 0.02
     results = []
-    for n in sorted(configs or CONFIGS):
+    seq = list(configs) if configs else sorted(CONFIGS)
+    for n in seq:
+        left = None if deadline is None else deadline - time.monotonic()
+        if left is not None and left < 90.0:
+            results.append({"config": n,
+                            "skipped": "bench wall-clock guard"})
+            if on_result is not None:
+                on_result(results)
+            continue
         if in_process:
             phase(f"config{n}_start")
             results.append(CONFIGS[n](scale))
         else:
-            results.append(_run_config_subprocess(n, scale,
-                                                  force_cpu=force_cpu))
+            results.append(_run_config_subprocess(
+                n, scale, force_cpu=force_cpu, budget_cap=left))
         if on_result is not None:
             on_result(results)   # caller checkpoints partial artifacts
     return results
