@@ -47,13 +47,10 @@ def midpoint_quantile(vals, q):
     what a PERFECT digest (one centroid per sample) returns, and the
     convention of the Go reference digest (merging_digest.go:302 Quantile).
     Using numpy's order-statistic interpolation as the oracle instead would
-    charge the sketch for a definitional difference that grows as 1/n."""
-    v = np.sort(np.asarray(vals, np.float64))
-    n = len(v)
-    mids = np.arange(n) + 0.5
-    xs = np.concatenate([[0.0], mids, [float(n)]])
-    ys = np.concatenate([[v[0]], v, [v[-1]]])
-    return float(np.interp(q * n, xs, ys))
+    charge the sketch for a definitional difference that grows as 1/n.
+    ONE implementation, shared with the analysis harness."""
+    from benchmarks.tdigest_analysis import midpoint_quantile as _mq
+    return _mq(vals, q)
 
 
 def _mk_server(metric_sinks, span_sinks=(), udp=False, **cfg_kw):
@@ -226,14 +223,12 @@ def config1_counter_replay(scale=1.0):
 
 def config2_zipf_timers(scale=1.0):
     """100k names × heavy-tail latencies → t-digest p50/p90/p99 error vs
-    exact (BASELINE #2; accuracy gate ≤1% p99 MEAN over the checked
-    names, matching the north star's "vs Go t-digest" framing).
-    p99_err_max runs ~10% for names with a few hundred samples — that is
-    the algorithm class, not this implementation: a sequential
-    reference-style merging digest (δ=100) measured on the same
-    300-1000-sample lognormal names shows mean 1.8% / max 9.6%, i.e.
-    strictly worse mean than this pipeline's (temp-cell-exact cold keys
-    buy the difference)."""
+    exact (BASELINE #2; budget ≤1% p99 PER KEY — p99_err_max is the
+    gate, VERDICT r04 #3). Exact-extreme protection + extremeness-
+    priority temp (ops/tdigest.py, step._histo_update) hold the worst
+    key inside 1%; a sequential reference-style merging digest (δ=100)
+    on the same data measures max 9.6% — this pipeline beats the
+    reference algorithm at the tails, not just matches it."""
     from veneur_tpu.sinks.debug import DebugMetricSink
 
     names = max(1000, int(100_000 * scale))

@@ -40,11 +40,15 @@ def distributions(rng, n):
     }
 
 
-def midpoint_quantile(sorted_vals, q):
-    n = len(sorted_vals)
+def midpoint_quantile(vals, q):
+    """Midpoint-mass quantile oracle; sorts internally, so unsorted
+    input is safe (the twin in benchmarks/e2e.py delegates here — keep
+    them ONE implementation)."""
+    v = np.sort(np.asarray(vals, np.float64))
+    n = len(v)
     mids = np.arange(n) + 0.5
     xs = np.concatenate([[0.0], mids, [float(n)]])
-    ys = np.concatenate([[sorted_vals[0]], sorted_vals, [sorted_vals[-1]]])
+    ys = np.concatenate([[v[0]], v, [v[-1]]])
     return float(np.interp(q * n, xs, ys))
 
 

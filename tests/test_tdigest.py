@@ -92,10 +92,13 @@ def test_merge_is_deterministic_and_order_free():
 
 
 def test_centroid_capacity_bound():
-    assert tdigest.centroid_capacity(100.0, 2) >= 102
+    # interior k-cells alone bound δ/2·cpk + 2; the full capacity adds
+    # the 2·E protected extreme slots (exact-extreme protection)
+    assert tdigest.interior_capacity(100.0, 2) >= 102
+    assert tdigest.centroid_capacity(100.0, 2, 64) >= 102 + 128
     t = _feed(np.random.RandomState(0).uniform(0, 1, 20_000))
     occupied = int(jnp.sum(t.weight > 0))
-    assert occupied <= tdigest.centroid_capacity(100.0, 2)
+    assert occupied <= tdigest.centroid_capacity()
 
 
 def test_cdf_roundtrip():

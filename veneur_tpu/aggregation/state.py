@@ -46,12 +46,23 @@ class TableSpec:
     histo_capacity: int = 1 << 14
     compression: float = td.DEFAULT_COMPRESSION
     cells_per_k: int = td.DEFAULT_CELLS_PER_K
-    temp_cells: int = 128
+    exact_extremes: int = td.DEFAULT_EXACT_EXTREMES
+    # 192 raw cells + 280 centroids = 472 columns — inside the 512 the
+    # Pallas quantile kernel pads to anyway; temp feeds the per-batch
+    # extremeness-priority allocation in step._histo_update
+    temp_cells: int = 192
     hll_precision: int = hll.DEFAULT_PRECISION
 
     @property
     def centroids(self) -> int:
-        return td.centroid_capacity(self.compression, self.cells_per_k)
+        return td.centroid_capacity(self.compression, self.cells_per_k,
+                                    self.exact_extremes)
+
+    @property
+    def interior_cells(self) -> int:
+        """k-cell columns between the 2·exact_extremes protected slots
+        (see ops/tdigest.py DEFAULT_EXACT_EXTREMES)."""
+        return self.centroids - 2 * self.exact_extremes
 
     @property
     def total_cells(self) -> int:

@@ -94,14 +94,33 @@ def _histo_update(state: DeviceState, slot, val, wt, spec: TableSpec):
     seg_id = jnp.cumsum(seg_start.astype(jnp.int32)) - 1
     rank = idx - jax.lax.cummax(jnp.where(seg_start, idx, 0))
 
-    # A key's first T samples since its last compaction land verbatim in
-    # its temp cells (exact — no estimate involved), the fixed-shape
-    # analogue of the reference digest's temp buffer
-    # (merging_digest.go:105-140). Only once a key is hot enough to have
-    # overflowed temp does estimate-based k-cell assignment kick in — by
-    # then the compacted digest is well-formed and the estimates are good.
-    temp_idx = state.h_temp_n[jnp.minimum(s, kh - 1)] + rank
-    use_temp = ok & (temp_idx < t)
+    # T samples per compaction cycle land verbatim in a key's temp cells
+    # (exact — no estimate involved), the fixed-shape analogue of the
+    # reference digest's temp buffer (merging_digest.go:105-140). Temp
+    # PRIORITY within each batch segment goes to the segment's most
+    # EXTREME samples, alternating bottom/top (ext_order is a
+    # permutation of 0..seg_len-1: bottom-0, top-0, bottom-1, top-1, …),
+    # so when a hot key overflows temp, it's the MID-RANGE samples that
+    # fall back to estimate-based k-cells — where cells are
+    # statistically thick and merging is harmless — while the tail
+    # samples that decide p99 stay raw until compaction's exact-extreme
+    # protection (ops/tdigest.py) takes them over. First-come order
+    # instead (the pre-r05 behavior) let tail samples of hot keys merge
+    # in narrow estimate cells, the dominant per-key p99 error term.
+    seg_cnt = jax.ops.segment_sum(
+        jnp.where(ok, 1, 0).astype(jnp.int32), seg_id,
+        num_segments=s.shape[0], indices_are_sorted=True)[seg_id]
+    r_top = seg_cnt - 1 - rank
+    ext_order = 2 * jnp.minimum(rank, r_top) + (rank > r_top)
+    # Temp budget per batch: half of what's left (with a small floor),
+    # so one big batch can't starve the rest of the compaction cycle —
+    # every batch in the cycle keeps at least its ±8-rank neighborhood
+    # of the tail queries exact. h_temp_n counts USED slots only (see
+    # below), so unused budget rolls over to the next batch.
+    avail = t - state.h_temp_n[jnp.minimum(s, kh - 1)]
+    allowed = jnp.maximum(avail // 2, jnp.minimum(avail, 16))
+    use_temp = ok & (ext_order < allowed)
+    temp_idx = state.h_temp_n[jnp.minimum(s, kh - 1)] + ext_order
 
     # mass of the current digest below each sample value (temp cells
     # participate: their "means" are raw sample values)
@@ -124,13 +143,19 @@ def _histo_update(state: DeviceState, slot, val, wt, spec: TableSpec):
     k0 = -spec.compression / 4.0
     cell = jnp.floor((td._k1(q_mid, spec.compression) - k0)
                      * spec.cells_per_k).astype(jnp.int32)
-    cell = jnp.clip(cell, 0, c - 1)
+    # estimate-based scatter lands in the k-cell INTERIOR only — the
+    # protected extreme columns [0,E) and [C-E,C) are written exclusively
+    # by compaction, which owns rank order (ops/tdigest.py compress_rows)
+    cell = spec.exact_extremes + jnp.clip(cell, 0, spec.interior_cells - 1)
     cell = jnp.where(use_temp, c + jnp.minimum(temp_idx, t - 1), cell)
 
     h_w = state.h_w.at[s, cell].add(w, mode="drop")
     h_wm = state.h_wm.at[s, cell].add(w * v, mode="drop")
+    # count USED temp slots (samples that overflowed to estimate cells
+    # don't consume budget — their slots stay available to later batches
+    # in the cycle)
     h_temp_n = state.h_temp_n.at[s].add(
-        jnp.where(ok, 1, 0).astype(jnp.int32), mode="drop")
+        jnp.where(use_temp, 1, 0).astype(jnp.int32), mode="drop")
     h_min = state.h_min.at[s].min(jnp.where(w > 0, v, jnp.inf), mode="drop")
     h_max = state.h_max.at[s].max(jnp.where(w > 0, v, -jnp.inf), mode="drop")
     h_count = state.h_count_acc.at[s].add(w, mode="drop")
@@ -304,7 +329,8 @@ def compact_core(state: DeviceState, *, spec: TableSpec) -> DeviceState:
     mean = state.h_wm / jnp.maximum(state.h_w, 1e-30)
     m2, w2 = td.compress_rows(mean, state.h_w, compression=spec.compression,
                               cells_per_k=spec.cells_per_k,
-                              out_c=spec.centroids)
+                              out_c=spec.centroids,
+                              exact_extremes=spec.exact_extremes)
     pad = jnp.zeros(w2.shape[:-1] + (spec.temp_cells,), w2.dtype)
     return state._replace(
         h_wm=jnp.concatenate([m2 * w2, pad], axis=-1),

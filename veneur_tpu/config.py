@@ -236,7 +236,10 @@ class Config:
     # cells ≈ δ/2·cells_per_k + 2, ops/tdigest.py centroid_capacity;
     # higher = finer quantiles, more HBM per key)
     tpu_digest_compression: float = 100.0
-    tpu_digest_cells_per_k: int = 2
+    tpu_digest_cells_per_k: int = 3
+    # bottom/top centroids kept exact through compression (per-key p99
+    # tail accuracy; ops/tdigest.py DEFAULT_EXACT_EXTREMES)
+    tpu_digest_exact_extremes: int = 64
 
     def parse_interval(self) -> float:
         return parse_duration(self.interval)

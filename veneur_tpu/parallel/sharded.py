@@ -218,7 +218,8 @@ def _merge_replica_block(state: DeviceState, spec: TableSpec):
     w = w.reshape(s_l, k, r * c)
     m2, w2 = td.compress_rows(mean, w, compression=spec.compression,
                               cells_per_k=spec.cells_per_k,
-                              out_c=spec.centroids)
+                              out_c=spec.centroids,
+                              exact_extremes=spec.exact_extremes)
     # back to the state's [C + temp] column layout, temp emptied
     pad = jnp.zeros(w2.shape[:-1] + (spec.temp_cells,), w2.dtype)
     w2 = jnp.concatenate([w2, pad], axis=-1)

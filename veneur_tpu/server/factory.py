@@ -50,6 +50,10 @@ def new_from_config(cfg: Config, extra_metric_sinks=(), extra_span_sinks=(),
                     f"signalfx_per_tag_api_keys entry needs name and "
                     f"api_key: {sorted(e)}")
             per_tag[e["name"]] = e["api_key"]
+        from veneur_tpu.config import parse_duration
+        # reference server.go:482-486: empty period defaults to 10m
+        refresh = parse_duration(
+            cfg.signalfx_dynamic_per_tag_api_keys_refresh_period or "10m")
         metric_sinks.append(SignalFxMetricSink(
             api_key=cfg.signalfx_api_key,
             endpoint=cfg.signalfx_endpoint_base
@@ -61,7 +65,12 @@ def new_from_config(cfg: Config, extra_metric_sinks=(), extra_span_sinks=(),
             flush_max_per_body=cfg.signalfx_flush_max_per_body or 5000,
             metric_name_prefix_drops=cfg.signalfx_metric_name_prefix_drops,
             metric_tag_prefix_drops=cfg.signalfx_metric_tag_prefix_drops,
-            tags=cfg.tags))
+            tags=cfg.tags,
+            dynamic_per_tag_tokens_enable=(
+                cfg.signalfx_dynamic_per_tag_api_keys_enable),
+            dynamic_per_tag_tokens_refresh_s=refresh,
+            api_endpoint=cfg.signalfx_endpoint_api
+            or "https://api.signalfx.com"))
     if bool(cfg.splunk_hec_address) != bool(cfg.splunk_hec_token):
         # reference server.go:574-576: half a splunk config is an error
         raise ValueError(
@@ -86,7 +95,17 @@ def new_from_config(cfg: Config, extra_metric_sinks=(), extra_span_sinks=(),
             batch_size=cfg.splunk_hec_batch_size,
             sample_rate=cfg.splunk_span_sample_rate or 1,
             send_timeout=parse_duration(cfg.splunk_hec_send_timeout)
-            if cfg.splunk_hec_send_timeout else 10.0))
+            if cfg.splunk_hec_send_timeout else 10.0,
+            # reference example.yaml:500: workers default to 1
+            workers=cfg.splunk_hec_submission_workers or 1,
+            ingest_timeout=parse_duration(cfg.splunk_hec_ingest_timeout)
+            if cfg.splunk_hec_ingest_timeout else 0.0,
+            max_conn_lifetime=parse_duration(
+                cfg.splunk_hec_max_connection_lifetime)
+            if cfg.splunk_hec_max_connection_lifetime else 10.0,
+            conn_lifetime_jitter=parse_duration(
+                cfg.splunk_hec_connection_lifetime_jitter)
+            if cfg.splunk_hec_connection_lifetime_jitter else 0.0))
     if spans_enabled and cfg.xray_address:
         if cfg.xray_sample_percentage <= 0:
             # reference server.go:535: 0% means no sink, loudly

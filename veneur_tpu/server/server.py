@@ -118,7 +118,8 @@ def spec_from_config(cfg: Config) -> TableSpec:
         set_capacity=cfg.tpu_set_capacity,
         histo_capacity=cfg.tpu_histo_capacity,
         compression=float(cfg.tpu_digest_compression),
-        cells_per_k=int(cfg.tpu_digest_cells_per_k))
+        cells_per_k=int(cfg.tpu_digest_cells_per_k),
+        exact_extremes=int(cfg.tpu_digest_exact_extremes))
 
 
 class Server:
