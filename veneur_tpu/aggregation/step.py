@@ -526,6 +526,51 @@ def flush_live_shapes(spec, n_c, n_g, n_st, n_set, n_h, n_q,
     return shapes
 
 
+# Which live-index bucket each flush output key rides (0=counter,
+# 1=gauge, 2=status, 3=set, 4=histo) — the tiled flush uses this to trim
+# each block's padded rows back to the kind's real length.
+FLUSH_KEY_KIND = {
+    "counter_hi": 0, "counter_lo": 0, "gauge": 1, "status": 2,
+    "set_estimate": 3, "raw_hll": 3,
+    "histo_quantiles": 4, "histo_min": 4, "histo_max": 4,
+    "histo_count_hi": 4, "histo_count_lo": 4, "histo_sum_hi": 4,
+    "histo_sum_lo": 4, "histo_recip_hi": 4, "histo_recip_lo": 4,
+    "histo_median": 4, "raw_h_mean": 4, "raw_h_weight": 4,
+}
+
+# Row-block size for the tiled flush: a flush whose live buckets exceed
+# this compiles ONE block-shaped executable and loops over blocks on the
+# host instead of minting a multi-million-row program (config 6's
+# cycle-0 flush compile blew a 600s budget on the tunneled chip —
+# VERDICT r04 #2; the reference streams flushes in fixed chunks too,
+# flusher.go:169-298).
+FLUSH_BLOCK_ROWS = 1 << 17
+
+
+def live_slots(table, kind: str):
+    """UNPADDED int32 slot-index array for a kind, in get_meta order."""
+    import numpy as np
+    metas = table.get_meta(kind)
+    idx = np.zeros(len(metas), np.int32)
+    for i, (slot, _m) in enumerate(metas):
+        idx[i] = slot
+    return idx
+
+
+def pack_bucket_chunks(slots, buckets, block_i: int):
+    """Block `block_i`'s per-kind index chunk, zero-padded to each
+    kind's STATIC bucket size (the tiled flush's executable-shape
+    contract: every block invocation has identical bucket shapes)."""
+    import numpy as np
+    out = []
+    for sarr, b in zip(slots, buckets):
+        c = sarr[block_i * b:(block_i + 1) * b]
+        buf = np.zeros(b, np.int32)
+        buf[:len(c)] = c
+        out.append(buf)
+    return out
+
+
 
 
 
@@ -545,12 +590,12 @@ def pad_bucket(n: int, cap: int) -> int:
 
 def live_indices(table, kind: str, cap: int):
     """Padded int32 slot-index array for a kind, in get_meta order (the
-    positional contract flush_live's outputs follow)."""
+    positional contract flush_live's outputs follow). Pad-of-live_slots:
+    ONE copy of the slot-extraction loop."""
     import numpy as np
-    metas = table.get_meta(kind)
-    idx = np.zeros(pad_bucket(len(metas), cap), np.int32)
-    for i, (slot, _m) in enumerate(metas):
-        idx[i] = slot
+    raw = live_slots(table, kind)
+    idx = np.zeros(pad_bucket(len(raw), cap), np.int32)
+    idx[:len(raw)] = raw
     return idx
 
 

@@ -193,8 +193,10 @@ class Aggregator:
         cross the host boundary). With want_raw, also returns the live
         rows' mergeable sketch state (numpy) for forwarding."""
         from veneur_tpu.aggregation.step import (
-            combine_flush_scalars, flush_live_in_packed, flush_live_shapes,
-            live_indices, pack_flush_inputs, unpack_flush)
+            FLUSH_BLOCK_ROWS, FLUSH_KEY_KIND, combine_flush_scalars,
+            flush_live_in_packed, flush_live_shapes, live_slots,
+            pack_bucket_chunks, pack_flush_inputs, pad_bucket,
+            unpack_flush)
 
         # No fold/compact pass here: ingest folds accumulators in-program
         # (step.py ingest_core), and the quantile kernel argsorts cells
@@ -205,19 +207,47 @@ class Aggregator:
         # precise; forwarding re-adds centroids either way).
         perc = percentiles or [0.5]
         spec = self.spec
-        idx = [live_indices(table, "counter", spec.counter_capacity),
-               live_indices(table, "gauge", spec.gauge_capacity),
-               live_indices(table, "status", spec.status_capacity),
-               live_indices(table, "set", spec.set_capacity),
-               live_indices(table, "histogram", spec.histo_capacity)]
-        # ONE host->device transfer in (quantiles + index buckets), ONE
-        # device->host transfer out (the packed flush arrays)
-        packed = np.asarray(flush_live_in_packed(
-            state, pack_flush_inputs(perc, idx), spec=spec,
-            n_q=len(perc), buckets=tuple(len(i) for i in idx),
-            want_raw=want_raw))
-        out = unpack_flush(packed, flush_live_shapes(
-            spec, *[len(i) for i in idx], len(perc), want_raw=want_raw))
+        caps = [spec.counter_capacity, spec.gauge_capacity,
+                spec.status_capacity, spec.set_capacity,
+                spec.histo_capacity]
+        slots = [live_slots(table, k) for k in
+                 ("counter", "gauge", "status", "set", "histogram")]
+        lens = [len(s) for s in slots]
+        n_blocks = max(1, max(
+            -(-n // min(pad_bucket(n, cap), FLUSH_BLOCK_ROWS))
+            for n, cap in zip(lens, caps)))
+        # Per-kind buckets sized to SPREAD each kind's rows evenly over
+        # all n_blocks invocations (ceil(n/n_blocks), padded): a kind
+        # smaller than the block-count driver never runs full-padding
+        # garbage blocks — e.g. 7M counters + 1M timers tiles as 57
+        # blocks of 128k counters x 18k timers, not 57 x 128k timers of
+        # which 49 are pure waste on the expensive quantile kernel.
+        buckets = tuple(min(pad_bucket(-(-n // n_blocks), cap),
+                            FLUSH_BLOCK_ROWS)
+                        for n, cap in zip(lens, caps))
+        shapes = flush_live_shapes(spec, *buckets, len(perc),
+                                   want_raw=want_raw)
+        # Tiled flush (VERDICT r04 #2): every invocation reuses ONE
+        # block-shaped executable — compile cost is bounded by the block
+        # size, never by live cardinality. n_blocks == 1 is the steady
+        # small-table case: same shapes as the old single-shot path. All
+        # blocks are dispatched before any is materialized, so the
+        # device pipelines them.
+        packs = [
+            flush_live_in_packed(
+                state, pack_flush_inputs(
+                    perc, pack_bucket_chunks(slots, buckets, i)),
+                spec=spec, n_q=len(perc), buckets=buckets,
+                want_raw=want_raw)
+            for i in range(n_blocks)]
+        pieces = [unpack_flush(np.asarray(p), shapes) for p in packs]
+        out = {}
+        for key, kind_i in ((k, FLUSH_KEY_KIND[k]) for k in pieces[0]):
+            b, n = buckets[kind_i], lens[kind_i]
+            rows = [p[key][:min(b, n - i * b)]
+                    for i, p in enumerate(pieces) if n - i * b > 0]
+            out[key] = (np.concatenate(rows) if rows
+                        else pieces[0][key][:0])
         result = combine_flush_scalars(out)
         if want_raw:
             raw = {
