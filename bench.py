@@ -229,7 +229,8 @@ def main():
                  "--seconds", "0.5",
                  "--only", "parse_metric_native",
                  "--only", "parse_metric_warm",
-                 "--only", "worker_ingest", "--only", "flush_label_frame"],
+                 "--only", "worker_ingest", "--only", "flush_label_frame",
+                 "--only", "import_decode_native"],
                 capture_output=True, text=True, timeout=micro_t,
                 cwd=here, env=cache_env(force_cpu=True))
             host = {}

@@ -114,7 +114,7 @@ def test_parse_parity_good():
 
     # key metadata parity: same names/scopes/tags in same slots
     native_keys = {(k, s): (sc, n, t)
-                   for k, s, sc, n, t in eng.drain_new_keys()}
+                   for k, s, sc, n, t, _imp in eng.drain_new_keys()}
     for kind_name in ("counter", "gauge", "set", "histogram"):
         for slot, meta in table.get_meta(kind_name):
             nk = native_keys[(meta.kind, slot)]
@@ -153,7 +153,7 @@ def test_randomized_digest_parity():
         eng.feed(pkt_b)
         m = parser.parse_metric(pkt_b)
         table.slot_for(m.type, m.name, m.tags, m.scope, m.digest)
-    native_keys = {(k, s) for k, s, _, _, _ in eng.drain_new_keys()}
+    native_keys = {(k, s) for k, s, _, _, _, _ in eng.drain_new_keys()}
     python_keys = set()
     for kind_name in ("counter", "gauge", "set", "histogram"):
         for slot, meta in table.get_meta(kind_name):

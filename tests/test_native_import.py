@@ -1,0 +1,203 @@
+"""Native metricpb import decoder (vi_import) vs the Python import path.
+
+The global tier's gRPC payload decoded+staged in C++ must produce the
+SAME flushed aggregates as the Python import_into path on the same
+serialized MetricList — the differential idiom of tests/test_native.py,
+extended to the import direction (reference importsrv/server.go:97
+SendMetrics → worker.go:438 ImportMetricGRPC).
+"""
+
+import numpy as np
+import pytest
+
+from veneur_tpu.aggregation.host import BatchSpec
+from veneur_tpu.aggregation.state import TableSpec
+from veneur_tpu.proto import forwardrpc_pb2 as fpb
+from veneur_tpu.proto import metricpb_pb2 as mpb
+from veneur_tpu import native
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native engine unavailable")
+
+SPEC = TableSpec(counter_capacity=256, gauge_capacity=64,
+                 status_capacity=16, set_capacity=32, histo_capacity=64)
+BSPEC = BatchSpec(counter=512, gauge=128, status=16, set=64, histo=512,
+                  histo_stat=64)
+
+
+def _mk_list(rng, n_counters=40, n_gauges=10, n_timers=8, n_sets=3):
+    """A MetricList shaped like a local's forward payload."""
+    ml = fpb.MetricList()
+    for i in range(n_counters):
+        m = ml.metrics.add()
+        m.name = f"imp.c.{i}"
+        m.tags.extend([f"host:h{i % 3}", "env:prod"])
+        m.type = mpb.Counter
+        m.counter.value = int(rng.integers(-5, 1000))
+    for i in range(n_gauges):
+        m = ml.metrics.add()
+        m.name = f"imp.g.{i}"
+        m.type = mpb.Gauge
+        m.gauge.value = float(rng.uniform(-10, 10))
+    for i in range(n_timers):
+        m = ml.metrics.add()
+        m.name = f"imp.t.{i}"
+        m.tags.append("svc:api")
+        m.type = mpb.Timer
+        m.scope = mpb.Global
+        td = m.histogram.t_digest
+        vals = rng.lognormal(2, 0.8, 30)
+        for v in vals:
+            c = td.main_centroids.add()
+            c.mean = float(v)
+            c.weight = float(rng.integers(1, 4))
+        td.min = float(vals.min())
+        td.max = float(vals.max())
+        td.reciprocalSum = float(np.sum(1.0 / vals))
+    for i in range(n_sets):
+        m = ml.metrics.add()
+        m.name = f"imp.s.{i}"
+        m.type = mpb.Set
+        from veneur_tpu.ops import hll
+        regs = np.zeros(hll.num_registers(SPEC.hll_precision), np.uint8)
+        regs[rng.integers(0, len(regs), 50)] = rng.integers(1, 20, 50)
+        m.set.hyper_log_log = hll.serialize(regs)
+    # proto3-default edge cases: min == 0.0 is ELIDED from the wire (a
+    # digest containing a 0.0 sample), and an all-negative digest elides
+    # nothing but exercises negative min/max — both must stage exactly
+    # what the Python path stages (r05 review finding: +-inf sentinels
+    # for absent fields silently no-op'd the scatter-min/max)
+    m = ml.metrics.add()
+    m.name = "imp.t.zero_min"
+    m.type = mpb.Timer
+    td = m.histogram.t_digest
+    for mean, weight in ((0.0, 1.0), (3.5, 2.0), (8.0, 1.0)):
+        c = td.main_centroids.add()
+        c.mean, c.weight = mean, weight
+    td.min = 0.0      # elided on the wire
+    td.max = 8.0
+    td.reciprocalSum = 0.0   # elided (0.0-mean makes it undefined)
+    m = ml.metrics.add()
+    m.name = "imp.t.negative"
+    m.type = mpb.Timer
+    td = m.histogram.t_digest
+    for mean, weight in ((-9.5, 1.0), (-2.25, 3.0)):
+        c = td.main_centroids.add()
+        c.mean, c.weight = mean, weight
+    td.min = -9.5
+    td.max = -2.25    # negative max; 0.0 would be elided
+    td.reciprocalSum = float(1.0 / -9.5 + 3.0 / -2.25)
+    return ml
+
+
+def _flush_of(agg):
+    out, table = agg.flush([0.5, 0.99])
+    by = {}
+    for kind in ("counter", "gauge", "set", "histogram"):
+        for i, (_slot, meta) in enumerate(table.get_meta(kind)):
+            by[(meta.kind, meta.name, meta.joined_tags)] = {
+                k: np.asarray(v)[i] for k, v in out.items()
+                if k.startswith(
+                    {"counter": "counter", "gauge": "gauge",
+                     "set": "set", "histogram": "histo"}[kind])}
+    return by
+
+
+def test_native_import_matches_python_import():
+    from veneur_tpu.forward.convert import import_into
+    from veneur_tpu.server.aggregator import Aggregator
+    from veneur_tpu.server.native_aggregator import NativeAggregator
+
+    rng = np.random.default_rng(11)
+    ml = _mk_list(rng)
+    data = ml.SerializeToString()
+
+    py = Aggregator(SPEC, BSPEC)
+    for m in ml.metrics:
+        import_into(py, m)
+
+    nat = NativeAggregator(SPEC, BSPEC)
+    total, errors = nat.import_pb_bytes(data)
+    assert total == len(ml.metrics)
+    assert errors == 0
+
+    a, b = _flush_of(py), _flush_of(nat)
+    assert set(a) == set(b), (set(a) ^ set(b))
+    for key in a:
+        for field in a[key]:
+            av, bv = a[key][field], b[key][field]
+            np.testing.assert_allclose(
+                av, bv, rtol=1e-5, atol=1e-6,
+                err_msg=f"{key} {field}")
+
+
+def test_native_import_imported_only_marking():
+    """A slot FIRST created by the import path is imported_only (the
+    Python path's host.py alloc imported=True marks every import-created
+    slot); a slot first created by the wire path is not."""
+    from veneur_tpu.server.native_aggregator import NativeAggregator
+    rng = np.random.default_rng(3)
+    nat = NativeAggregator(SPEC, BSPEC)
+    nat.feed(b"wire.c:1|c")        # wire-created slot first
+    nat.import_pb_bytes(_mk_list(rng).SerializeToString())
+    table = nat.table
+    table._drain()
+    assert all(m.imported_only for _s, m in table.get_meta("histogram"))
+    by_name = {m.name: m for _s, m in table.get_meta("counter")}
+    assert not by_name["wire.c"].imported_only
+    assert by_name["imp.c.0"].imported_only
+
+
+def test_native_import_staging_overflow_reenters():
+    """A MetricList bigger than the staging lanes emits mid-request and
+    re-enters at the reported boundary — nothing lost, counts exact."""
+    from veneur_tpu.server.native_aggregator import NativeAggregator
+    rng = np.random.default_rng(5)
+    small = BatchSpec(counter=16, gauge=8, status=8, set=16, histo=64,
+                      histo_stat=8)
+    nat = NativeAggregator(SPEC, small)
+    ml = _mk_list(rng, n_counters=100, n_gauges=20, n_timers=6, n_sets=0)
+    total, errors = nat.import_pb_bytes(ml.SerializeToString())
+    assert (total, errors) == (len(ml.metrics), 0)
+    out, table = nat.flush([0.5])
+    names = {m.name for _s, m in table.get_meta("counter")}
+    assert len(names) == 100
+    # every counter value exact despite the mid-request emits
+    vals = {m.name: float(np.asarray(out["counter"])[i])
+            for i, (_s, m) in enumerate(table.get_meta("counter"))}
+    for m in ml.metrics:
+        if m.WhichOneof("value") == "counter":
+            assert vals[m.name] == float(m.counter.value)
+
+
+def test_native_import_lane_full_at_entry_not_dropped():
+    """Staging already full when the request arrives (e.g. wire traffic
+    filled the lanes): the importer must emit and re-enter, never
+    misread the boundary stop as an undecodable tail (r05 review)."""
+    from veneur_tpu.server.native_aggregator import NativeAggregator
+    rng = np.random.default_rng(9)
+    tiny = BatchSpec(counter=4, gauge=8, status=8, set=16, histo=64,
+                     histo_stat=8)
+    nat = NativeAggregator(SPEC, tiny)
+    # fill the counter lane exactly to capacity via the wire path
+    for i in range(4):
+        nat.feed(b"wire.%d:1|c" % i)
+    ml = _mk_list(rng, n_counters=10, n_gauges=0, n_timers=0, n_sets=0)
+    total, errors = nat.import_pb_bytes(ml.SerializeToString())
+    assert (total, errors) == (len(ml.metrics), 0)
+    out, table = nat.flush([0.5])
+    names = {m.name for _s, m in table.get_meta("counter")}
+    assert {f"imp.c.{i}" for i in range(10)} <= names
+
+
+def test_native_import_malformed_tail_counted():
+    """Garbage after valid metrics: the valid prefix lands, the tail is
+    counted as one error instead of crashing the pipeline."""
+    from veneur_tpu.server.native_aggregator import NativeAggregator
+    rng = np.random.default_rng(7)
+    nat = NativeAggregator(SPEC, BSPEC)
+    ml = _mk_list(rng, n_counters=5, n_gauges=0, n_timers=0, n_sets=0)
+    data = ml.SerializeToString() + b"\x0a\xff\xff\xff\xff\x7f"
+    total, errors = nat.import_pb_bytes(data)
+    assert total == len(ml.metrics)   # the valid prefix all landed
+    assert errors == 1

@@ -304,6 +304,13 @@ class Batcher:
         self._bulk((self.h_slot, self.h_val, self.h_wt),
                    (slots, vals, wts), "nh", self.bspec.histo)
 
+    def add_histo_stats_bulk(self, slots, mns, mxs, recips):
+        """Imported-digest exact scalar stats, staged in slices (the
+        native import decoder drains these per request)."""
+        self._bulk((self.hs_slot, self.hs_min, self.hs_max,
+                    self.hs_recip), (slots, mns, mxs, recips), "nhs",
+                   self.bspec.histo_stat)
+
     def pending(self) -> int:
         return (self.nc + self.ng + self.nst + self.ns + self.nh
                 + self.nhs)

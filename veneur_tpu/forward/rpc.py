@@ -39,6 +39,21 @@ class ForwardClient:
         # trace headers either (flusher.go:474 forwardGRPC has no Inject)
         self._send(fpb.MetricList(metrics=metrics), timeout=timeout)
 
+    def send_serialized(self, data: bytes, timeout: float = 10.0,
+                        wait: bool = True):
+        """Send an ALREADY-serialized MetricList (sustained-absorption
+        benchmarking: client-side marshal cost out of the timed loop).
+        With wait=False returns a grpc future — callers overlap requests
+        the way a whole local fleet does against one global."""
+        if not hasattr(self, "_send_raw"):
+            self._send_raw = self._channel.unary_unary(
+                METHOD, request_serializer=bytes,
+                response_deserializer=empty_pb2.Empty.FromString)
+        if wait:
+            self._send_raw(data, timeout=timeout)
+            return None
+        return self._send_raw.future(data, timeout=timeout)
+
     def close(self):
         self._channel.close()
 
@@ -101,29 +116,39 @@ class HTTPForwardClient:
         pass
 
 
-def make_forward_service(handler: Callable[[List], None]):
+def make_forward_service(handler: Callable[[List], None],
+                         raw: bool = False):
     """A generic gRPC handler for the Forward service calling
     `handler(metrics)` per request (the shape of reference
-    internal/forwardtest/server.go)."""
+    internal/forwardtest/server.go). With `raw`, the request is NOT
+    deserialized — `handler(serialized_bytes)` receives the wire
+    MetricList for the native import decoder (vi_import), skipping the
+    Python protobuf object layer entirely."""
 
     def send_metrics(request: fpb.MetricList, context):
         handler(list(request.metrics))
         return empty_pb2.Empty()
 
+    def send_metrics_raw(request: bytes, context):
+        handler(request)
+        return empty_pb2.Empty()
+
     rpc_handler = grpc.method_handlers_generic_handler(
         "forwardrpc.Forward",
         {"SendMetrics": grpc.unary_unary_rpc_method_handler(
-            send_metrics,
-            request_deserializer=fpb.MetricList.FromString,
+            send_metrics_raw if raw else send_metrics,
+            request_deserializer=(bytes if raw
+                                  else fpb.MetricList.FromString),
             response_serializer=empty_pb2.Empty.SerializeToString)})
     return rpc_handler
 
 
 def serve(handler: Callable[[List], None], address: str = "127.0.0.1:0",
-          max_workers: int = 4):
+          max_workers: int = 4, raw: bool = False):
     """Start a Forward gRPC server; returns (server, bound_port)."""
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
-    server.add_generic_rpc_handlers((make_forward_service(handler),))
+    server.add_generic_rpc_handlers(
+        (make_forward_service(handler, raw=raw),))
     port = server.add_insecure_port(address)
     server.start()
     return server, port

@@ -90,6 +90,18 @@ def _build_and_load():
         lib.vt_hash64_batch.argtypes = [
             ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
             ctypes.POINTER(ctypes.c_uint64)]
+        lib.vi_import.restype = ctypes.c_int
+        lib.vi_import.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int)]
+        lib.vi_stats.restype = ctypes.c_int
+        lib.vi_stats.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int]
         _lib = lib
     except Exception as e:  # noqa: BLE001 — any failure => python fallback
         _load_err = str(e)
@@ -186,8 +198,9 @@ class NativeIngest:
         return (None if slot < 0 else slot), bool(was_new.value)
 
     def drain_new_keys(self) -> List[tuple]:
-        """[(kind, slot, scope, name, joined_tags)] allocated since the
-        last drain."""
+        """[(kind, slot, scope, name, joined_tags, imported)] allocated
+        since the last drain. The scope byte's bit 7 marks slots first
+        created by the native import path (imported_only labeling)."""
         n = _lib.vt_new_keys(self._h, self._keybuf,
                              len(self._keybuf))
         if n < 0:
@@ -200,7 +213,8 @@ class NativeIngest:
             kind = raw[off]
             slot = int.from_bytes(raw[off + 1:off + 5], "little",
                                   signed=True)
-            scope = raw[off + 5]
+            scope = raw[off + 5] & 0x7F
+            imported = bool(raw[off + 5] & 0x80)
             nl = int.from_bytes(raw[off + 6:off + 8], "little")
             name = raw[off + 8:off + 8 + nl].decode(
                 "utf-8", "surrogateescape")
@@ -209,8 +223,59 @@ class NativeIngest:
             tags = raw[off + 2:off + 2 + tl].decode(
                 "utf-8", "surrogateescape")
             off += 2 + tl
-            out.append((KIND_NAMES[kind], slot, scope, name, tags))
+            out.append((KIND_NAMES[kind], slot, scope, name, tags,
+                        imported))
         return out
+
+    def import_metriclist(self, data: bytes, offset: int = 0):
+        """Decode + stage a serialized forwardrpc.MetricList starting at
+        `offset` (the whole buffer is passed zero-copy; re-entry never
+        re-slices a multi-MB remainder). Returns
+        (handled_count, consumed_abs, fallback_spans, lane_full) —
+        consumed_abs is the absolute offset fully handled (re-enter
+        there after emitting when lane_full), fallback_spans is
+        [(abs_off, length)] of Metric submessages for the Python path."""
+        consumed = ctypes.c_int(0)
+        n_fb = ctypes.c_int(0)
+        full_stop = ctypes.c_int(0)
+        fb_cap = 1024
+        fb_off = (ctypes.c_int32 * fb_cap)()
+        fb_len = (ctypes.c_int32 * fb_cap)()
+        staged = _lib.vi_import(self._h, data, len(data), offset,
+                                ctypes.byref(consumed), fb_off, fb_len,
+                                fb_cap, ctypes.byref(n_fb),
+                                ctypes.byref(full_stop))
+        spans = [(fb_off[i], fb_len[i]) for i in range(n_fb.value)]
+        return (staged, consumed.value, spans, bool(full_stop.value))
+
+    def drain_import_stats(self):
+        """(slots, mins, maxes, recip_corrs) numpy arrays of the
+        per-imported-histogram scalar stats staged by import_metriclist."""
+        cap = 4096
+        slots = np.empty(cap, np.int32)
+        mns = np.empty(cap, np.float32)
+        mxs = np.empty(cap, np.float32)
+        rc = np.empty(cap, np.float32)
+        out = [[], [], [], []]
+        while True:
+            n = _lib.vi_stats(
+                self._h,
+                slots.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                mns.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                mxs.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                rc.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), cap)
+            if n <= 0:
+                break
+            out[0].append(slots[:n].copy())
+            out[1].append(mns[:n].copy())
+            out[2].append(mxs[:n].copy())
+            out[3].append(rc[:n].copy())
+            if n < cap:
+                break
+        if not out[0]:
+            z = np.empty(0, np.float32)
+            return np.empty(0, np.int32), z, z, z
+        return tuple(np.concatenate(x) for x in out)
 
     def drain_specials(self) -> List[bytes]:
         """Event/service-check lines the C++ parser escalated."""

@@ -166,8 +166,16 @@ struct NewKey {
   uint8_t kind;
   int32_t slot;
   uint8_t scope;
+  uint8_t imported;  // slot first created by the import path
   std::string name;
   std::string joined_tags;
+};
+
+// per-imported-histogram scalar stats (min/max/reciprocal-sum
+// correction), drained by Python into the histo_stat batch lane
+struct ImportStat {
+  int32_t slot;
+  float mn, mx, recip_corr;
 };
 
 struct Parser {
@@ -188,6 +196,10 @@ struct Parser {
 
   std::vector<NewKey> new_keys;
   std::deque<std::string> specials;  // _e{ / _sc lines for Python
+
+  // import path (vi_import): per-histogram stats + alloc marking
+  std::vector<ImportStat> import_stats;
+  bool alloc_imported = false;
 
   uint64_t processed = 0;
   uint64_t parse_errors = 0;
@@ -235,6 +247,7 @@ struct Parser {
     int32_t slot = (int32_t)(shard * t.per_shard + nxt);
     t.by_key.emplace(keybuf, slot);
     new_keys.push_back(NewKey{kind, slot, scope,
+                              (uint8_t)(alloc_imported ? 1 : 0),
                               std::string(name, name_len), joined});
     return slot;
   }
@@ -511,7 +524,9 @@ int vt_new_keys(void* hp, char* buf, int cap) {
   for (const auto& k : p->new_keys) {
     *w++ = (char)k.kind;
     memcpy(w, &k.slot, 4); w += 4;
-    *w++ = (char)k.scope;
+    // scope rides the low bits; bit 7 marks import-created slots
+    // (imported_only flush semantics, aggregation/host.py alloc)
+    *w++ = (char)(k.scope | (k.imported ? 0x80 : 0));
     uint16_t nl = (uint16_t)k.name.size();
     memcpy(w, &nl, 2); w += 2;
     memcpy(w, k.name.data(), nl); w += nl;
@@ -587,6 +602,380 @@ void vt_stats(void* hp, uint64_t* out) {
   out[1] = p->parse_errors;
   out[2] = p->counters.dropped + p->gauges.dropped + p->sets.dropped +
            p->histos.dropped;
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Native metricpb import decoder (vi_import): the global tier's gRPC
+// /forwardrpc.Forward/SendMetrics payload (a serialized
+// forwardrpc.MetricList — veneur_tpu/proto/{forwardrpc,metricpb,
+// tdigestpb}.proto, wire-compatible with the reference's
+// forwardrpc/forward.proto) decoded with a hand-rolled proto3 walker and
+// staged STRAIGHT into the batch lanes, the import-path mirror of the
+// wire parse path (reference importsrv/server.go:97 SendMetrics →
+// worker.go:438 ImportMetricGRPC). Counters, gauges, and
+// histogram/timer digests (the fleet bulk) stage natively; sets,
+// valueless metrics, and any type/value oneof mismatch are handed back
+// as (offset, length) spans for the Python slow path, which preserves
+// the reference's per-metric error accounting exactly.
+
+namespace {
+
+inline bool rd_varint(const char* p, int len, int* off, uint64_t* v) {
+  uint64_t out = 0;
+  int shift = 0;
+  while (*off < len && shift < 64) {
+    uint8_t b = (uint8_t)p[(*off)++];
+    out |= (uint64_t)(b & 0x7f) << shift;
+    if (!(b & 0x80)) {
+      *v = out;
+      return true;
+    }
+    shift += 7;
+  }
+  return false;
+}
+
+inline bool skip_field(const char* p, int len, int* off, int wt) {
+  uint64_t v;
+  switch (wt) {
+    case 0: return rd_varint(p, len, off, &v);
+    case 1: if (*off + 8 > len) return false; *off += 8; return true;
+    case 2:
+      if (!rd_varint(p, len, off, &v)) return false;
+      if (v > (uint64_t)(len - *off)) return false;
+      *off += (int)v;
+      return true;
+    case 5: if (*off + 4 > len) return false; *off += 4; return true;
+    default: return false;
+  }
+}
+
+inline double rd_double_fixed(const char* p) {
+  double d;
+  memcpy(&d, p, 8);
+  return d;
+}
+
+// enum Type names, capitalized — the digest hashes Type.String()
+// (reference importsrv/server.go:141-148 hashMetric)
+constexpr const char* kTypeNames[5] = {"Counter", "Gauge", "Histogram",
+                                       "Set", "Timer"};
+constexpr int kTypeNameLen[5] = {7, 5, 9, 3, 5};
+// metricpb.Type enum -> engine kind byte (convert.py _TYPE_NAMES)
+constexpr int kTypeKind[5] = {K_COUNTER, K_GAUGE, K_HISTO, K_SET, K_TIMER};
+
+struct MetricView {
+  const char* name = nullptr;
+  int name_len = 0;
+  uint64_t type = 0;
+  uint64_t scope = 0;
+  int which = 0;        // last value-oneof field seen (proto3: last wins)
+  const char* val = nullptr;
+  int val_len = 0;
+};
+
+// parse one metricpb.Metric submessage; tags collected into `tags`
+inline bool parse_metric_view(const char* p, int len, MetricView* m,
+                              std::vector<std::pair<const char*, size_t>>*
+                                  tags) {
+  int off = 0;
+  tags->clear();
+  while (off < len) {
+    uint64_t key;
+    if (!rd_varint(p, len, &off, &key)) return false;
+    int field = (int)(key >> 3), wt = (int)(key & 7);
+    if (wt == 2) {
+      uint64_t n;
+      if (!rd_varint(p, len, &off, &n)) return false;
+      if (n > (uint64_t)(len - off)) return false;
+      const char* body = p + off;
+      off += (int)n;
+      switch (field) {
+        case 1: m->name = body; m->name_len = (int)n; break;
+        case 2: tags->emplace_back(body, (size_t)n); break;
+        case 5: case 6: case 7: case 8:
+          m->which = field; m->val = body; m->val_len = (int)n; break;
+        default: break;
+      }
+    } else {
+      uint64_t v;
+      if (wt == 0) {
+        if (!rd_varint(p, len, &off, &v)) return false;
+        if (field == 3) m->type = v;
+        else if (field == 9) m->scope = v;
+      } else if (!skip_field(p, len, &off, wt)) {
+        return false;
+      }
+    }
+  }
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Decode + stage a serialized forwardrpc.MetricList. Returns the number
+// of metrics staged natively; *consumed reports how many input bytes
+// were fully handled (always a top-level field boundary — re-enter with
+// data+consumed after emitting when staging filled). Fallback spans
+// (Python slow path) are (offset-within-data, length) pairs of Metric
+// submessages; if fb_cap would overflow, decoding stops early.
+int vi_import(void* hp, const char* data, int len, int start,
+              int* consumed, int32_t* fb_off, int32_t* fb_len, int fb_cap,
+              int* n_fb, int* full_stop) {
+  auto* p = (Parser*)hp;
+  p->alloc_imported = true;
+  int staged = 0;   // metrics HANDLED natively (capacity drops included,
+                    // matching the Python path's imported_total)
+  *n_fb = 0;
+  *full_stop = 0;
+  int off = start;
+  *consumed = start;
+  while (off < len) {
+    int metric_start = off;
+    uint64_t key;
+    if (!rd_varint(data, len, &off, &key)) break;  // truncated tail
+    int field = (int)(key >> 3), wt = (int)(key & 7);
+    if (field != 1 || wt != 2) {      // unknown top-level field: skip
+      if (!skip_field(data, len, &off, wt)) break;
+      *consumed = off;
+      continue;
+    }
+    uint64_t n;
+    if (!rd_varint(data, len, &off, &n)) break;
+    if (n > (uint64_t)(len - off)) break;
+    const char* body = data + off;
+    int body_off = off;
+    off += (int)n;
+
+    MetricView m;
+    bool ok = parse_metric_view(body, (int)n, &m, &p->tag_views);
+    bool native = ok && m.name && m.type < 5 &&
+                  ((m.type == 0 && m.which == 5) ||     // Counter
+                   (m.type == 1 && m.which == 6) ||     // Gauge
+                   ((m.type == 2 || m.type == 4) && m.which == 7));
+    if (!native) {
+      if (*n_fb >= fb_cap) {    // drain fallbacks first, then re-enter
+        p->alloc_imported = false;
+        return staged;
+      }
+      fb_off[*n_fb] = body_off;
+      fb_len[(*n_fb)++] = (int)n;
+      *consumed = off;
+      continue;
+    }
+
+    // capacity check BEFORE staging so a metric never half-stages;
+    // histograms need one histo-lane row per centroid (count them)
+    int need_h = 0;
+    if (m.which == 7) {
+      // HistogramValue { tdigest.MergingDigestData t_digest = 1 }
+      int o2 = 0;
+      const char* hv = m.val;
+      uint64_t k2, n2;
+      const char* td = nullptr;
+      int td_len = 0;
+      while (o2 < m.val_len) {
+        if (!rd_varint(hv, m.val_len, &o2, &k2)) { td = nullptr; break; }
+        if ((k2 >> 3) == 1 && (k2 & 7) == 2) {
+          if (!rd_varint(hv, m.val_len, &o2, &n2) ||
+              n2 > (uint64_t)(m.val_len - o2)) { td = nullptr; break; }
+          td = hv + o2;
+          td_len = (int)n2;
+          o2 += (int)n2;
+        } else if (!skip_field(hv, m.val_len, &o2, (int)(k2 & 7))) {
+          td = nullptr;
+          break;
+        }
+      }
+      if (!td) {   // malformed digest wrapper -> Python (error counting)
+        if (*n_fb >= fb_cap) {
+          p->alloc_imported = false;
+          return staged;
+        }
+        fb_off[*n_fb] = body_off;
+        fb_len[(*n_fb)++] = (int)n;
+        *consumed = off;
+        continue;
+      }
+      m.val = td;             // walk the MergingDigestData directly
+      m.val_len = td_len;
+      int o3 = 0;
+      uint64_t k3, n3;
+      while (o3 < td_len) {
+        if (!rd_varint(td, td_len, &o3, &k3)) break;
+        if ((k3 >> 3) == 1 && (k3 & 7) == 2) {
+          if (!rd_varint(td, td_len, &o3, &n3) ||
+              n3 > (uint64_t)(td_len - o3)) break;
+          o3 += (int)n3;
+          need_h++;
+        } else if (!skip_field(td, td_len, &o3, (int)(k3 & 7))) {
+          break;
+        }
+      }
+      if ((uint32_t)need_h > p->bh) {  // digest larger than a whole
+        if (*n_fb >= fb_cap) {          // batch: Python path
+          p->alloc_imported = false;
+          return staged;
+        }
+        fb_off[*n_fb] = body_off;
+        fb_len[(*n_fb)++] = (int)n;
+        *consumed = off;
+        continue;
+      }
+    }
+    bool full = (m.which == 5 && p->nc >= p->bc) ||
+                (m.which == 6 && p->ng >= p->bg) ||
+                (m.which == 7 && p->nh + need_h > p->bh);
+    if (full) {
+      *consumed = metric_start;   // emit, then re-enter at this metric
+      *full_stop = 1;             // distinguishes from an undecodable
+      p->alloc_imported = false;  // boundary (which makes no progress
+      return staged;              // AND isn't a lane stop)
+    }
+
+    // digest: fnv1a-32 over name, Type.String(), then each tag
+    // (reference importsrv/server.go:141-148; convert.py metric_digest)
+    uint32_t digest = fnv32(m.name, (size_t)m.name_len, FNV32_OFFSET);
+    digest = fnv32(kTypeNames[m.type], (size_t)kTypeNameLen[m.type],
+                   digest);
+    p->joined.clear();
+    for (size_t i = 0; i < p->tag_views.size(); i++) {
+      digest = fnv32(p->tag_views[i].first, p->tag_views[i].second,
+                     digest);
+      if (i) p->joined.push_back(',');
+      p->joined.append(p->tag_views[i].first, p->tag_views[i].second);
+    }
+
+    int kind = kTypeKind[m.type];
+    // scope coercion (convert.py import_into / worker.go:442-447):
+    // counters/gauges arriving via import are global by definition;
+    // histos keep Global else collapse to mixed
+    uint8_t scope = (kind == K_COUNTER || kind == K_GAUGE)
+                        ? 2 : (m.scope == 2 ? 2 : 0);
+    KindTable* t = (kind == K_COUNTER) ? &p->counters
+                   : (kind == K_GAUGE) ? &p->gauges : &p->histos;
+    int32_t slot = p->slot_for(*t, (uint8_t)kind, scope, m.name,
+                               (size_t)m.name_len, digest);
+    if (slot < 0) {   // capacity drop, counted in t->dropped —
+      staged++;       // still a HANDLED metric (imported_total parity
+      p->processed++; // with the Python path, which counts before drops)
+      *consumed = off;
+      continue;
+    }
+
+    if (m.which == 5) {            // CounterValue { int64 value = 1 }
+      int o2 = 0;
+      uint64_t k2, v2 = 0;
+      while (o2 < m.val_len) {
+        if (!rd_varint(m.val, m.val_len, &o2, &k2)) break;
+        if ((k2 >> 3) == 1 && (k2 & 7) == 0) {
+          if (!rd_varint(m.val, m.val_len, &o2, &v2)) break;
+        } else if (!skip_field(m.val, m.val_len, &o2, (int)(k2 & 7))) {
+          break;
+        }
+      }
+      p->c_slot[p->nc] = slot;
+      p->c_inc[p->nc++] = (float)(double)(int64_t)v2;
+    } else if (m.which == 6) {     // GaugeValue { double value = 1 }
+      int o2 = 0;
+      uint64_t k2;
+      double v2 = 0;
+      while (o2 < m.val_len) {
+        if (!rd_varint(m.val, m.val_len, &o2, &k2)) break;
+        if ((k2 >> 3) == 1 && (k2 & 7) == 1) {
+          if (o2 + 8 > m.val_len) break;
+          v2 = rd_double_fixed(m.val + o2);
+          o2 += 8;
+        } else if (!skip_field(m.val, m.val_len, &o2, (int)(k2 & 7))) {
+          break;
+        }
+      }
+      p->g_slot[p->ng] = slot;
+      p->g_val[p->ng++] = (float)v2;
+    } else {                       // MergingDigestData (unwrapped above)
+      // proto3 elides default fields: absent min/max/reciprocalSum
+      // mean 0.0 on the wire, and the Python path stages exactly that
+      // (convert.py reads td.min etc., getting the proto3 default) —
+      // +-inf sentinels here would silently no-op the scatter-min/max
+      double mn = 0.0, mx = 0.0, recip = 0;
+      double readd_recip = 0;      // f32-cast sum like the Python path
+      bool all_nonzero = true;
+      int o3 = 0;
+      uint64_t k3, n3;
+      while (o3 < m.val_len) {
+        if (!rd_varint(m.val, m.val_len, &o3, &k3)) break;
+        int f3 = (int)(k3 >> 3), w3 = (int)(k3 & 7);
+        if (f3 == 1 && w3 == 2) {  // Centroid { mean=1 weight=2 }
+          if (!rd_varint(m.val, m.val_len, &o3, &n3) ||
+              n3 > (uint64_t)(m.val_len - o3)) break;
+          const char* c = m.val + o3;
+          o3 += (int)n3;
+          double mean = 0, weight = 0;
+          int oc = 0;
+          uint64_t kc;
+          while (oc < (int)n3) {
+            if (!rd_varint(c, (int)n3, &oc, &kc)) break;
+            int fc = (int)(kc >> 3);
+            if ((kc & 7) == 1 && oc + 8 <= (int)n3) {
+              double d = rd_double_fixed(c + oc);
+              oc += 8;
+              if (fc == 1) mean = d;
+              else if (fc == 2) weight = d;
+            } else if (!skip_field(c, (int)n3, &oc, (int)(kc & 7))) {
+              break;
+            }
+          }
+          float fm = (float)mean, fw = (float)weight;
+          if (fw > 0) {            // live-centroid filter (import_metric)
+            p->h_slot[p->nh] = slot;
+            p->h_val[p->nh] = fm;
+            p->h_wt[p->nh++] = fw;
+            if (fm == 0.0f) all_nonzero = false;
+            else readd_recip += (double)(fw / fm);
+          }
+        } else if (w3 == 1 && o3 + 8 <= m.val_len) {
+          double d = rd_double_fixed(m.val + o3);
+          o3 += 8;
+          if (f3 == 3) mn = d;
+          else if (f3 == 4) mx = d;
+          else if (f3 == 5) recip = d;
+        } else if (!skip_field(m.val, m.val_len, &o3, w3)) {
+          break;
+        }
+      }
+      double corr = all_nonzero ? recip - readd_recip : 0;
+      p->import_stats.push_back(ImportStat{slot, (float)mn, (float)mx,
+                                           (float)corr});
+    }
+    staged++;
+    p->processed++;
+    *consumed = off;
+  }
+  p->alloc_imported = false;
+  return staged;
+}
+
+// Drain the per-imported-histogram stats staged by vi_import. Returns
+// the count written (≤ cap); remaining entries stay queued.
+int vi_stats(void* hp, int32_t* slot, float* mn, float* mx, float* recip,
+             int cap) {
+  auto* p = (Parser*)hp;
+  int n = (int)p->import_stats.size();
+  if (n > cap) n = cap;
+  for (int i = 0; i < n; i++) {
+    const auto& s = p->import_stats[i];
+    slot[i] = s.slot;
+    mn[i] = s.mn;
+    mx[i] = s.mx;
+    recip[i] = s.recip_corr;
+  }
+  p->import_stats.erase(p->import_stats.begin(),
+                        p->import_stats.begin() + n);
+  return n;
 }
 
 }  // extern "C"

@@ -28,6 +28,7 @@ Known imprecisions, documented:
 
 from __future__ import annotations
 
+import logging
 from typing import List
 
 import numpy as np
@@ -38,6 +39,8 @@ from veneur_tpu.aggregation.state import TableSpec
 from veneur_tpu.native import NativeIngest
 from veneur_tpu.server.aggregator import Aggregator
 from veneur_tpu.server.sharded_aggregator import ShardedAggregator
+
+log = logging.getLogger("veneur_tpu.server.native_aggregator")
 
 
 class NativeKeyTable:
@@ -58,7 +61,8 @@ class NativeKeyTable:
     def _drain(self):
         if self._finalized:
             return
-        for kind, slot, scope, name, joined in self.eng.drain_new_keys():
+        for kind, slot, scope, name, joined, imported in \
+                self.eng.drain_new_keys():
             tname = self._TABLE(kind)
             if slot in self.by_slot[tname]:
                 # registered python-side with the exact tag tuple already
@@ -73,7 +77,8 @@ class NativeKeyTable:
             # digest) agree with the reference either way.
             m = SlotMeta(name=name,
                          tags=tuple(joined.split(",")) if joined else (),
-                         scope=scope, kind=kind, joined_tags=joined)
+                         scope=scope, kind=kind, joined_tags=joined,
+                         imported_only=imported)
             self.meta[tname].append((slot, m))
             self.by_slot[tname][slot] = m
 
@@ -202,6 +207,55 @@ class NativeAggregator(Aggregator):
 
     def extra_parse_errors(self) -> int:
         return self.eng.stats()["parse_errors"]
+
+    # -- native import path (global tier) ------------------------------
+    def import_pb_bytes(self, data: bytes):
+        """Decode + stage a serialized forwardrpc.MetricList with the
+        C++ engine (VERDICT r04 #5: the gRPC decode→slot path batched
+        the way wire ingest staging is; reference importsrv/server.go:97
+        SendMetrics). Counters/gauges/digests stage natively; sets,
+        valueless metrics, and oneof/type mismatches fall back to the
+        Python import_into path so error accounting matches the
+        reference's per-metric semantics. Returns (metrics, errors)."""
+        from veneur_tpu.forward.convert import import_into
+        from veneur_tpu.proto import metricpb_pb2 as mpb
+        total = 0
+        errors = 0
+        off = 0
+        while off < len(data):
+            staged, new_off, spans, lane_full = \
+                self.eng.import_metriclist(data, off)
+            total += staged + len(spans)
+            for so, sl in spans:
+                try:
+                    import_into(self, mpb.Metric.FromString(
+                        data[so:so + sl]))
+                except Exception as e:
+                    errors += 1
+                    log.warning("bad imported metric (native path): %s",
+                                e)
+            if new_off >= len(data):
+                break
+            if not lane_full and new_off == off and staged == 0 \
+                    and not spans:
+                # undecodable at a top-level boundary (NOT a lane stop):
+                # the Python deserializer would reject the whole request
+                # — count one error and drop the remainder
+                errors += 1
+                log.warning("undecodable MetricList tail at offset %d "
+                            "(%d bytes dropped)", off, len(data) - off)
+                break
+            # staging filled (or the fallback buffer did): free the
+            # lanes, then re-enter at the reported boundary
+            self._emit_native()
+            off = new_off
+        # per-digest exact min/max/recip ride the Python stats lane —
+        # scatter min/max/add are order-independent vs the centroid
+        # re-add, so batch boundaries don't matter
+        slots, mns, mxs, rcs = self.eng.drain_import_stats()
+        if len(slots):
+            self.batcher.add_histo_stats_bulk(slots, mns, mxs, rcs)
+        return total, errors
 
     # -- native UDP reader group ---------------------------------------------
     def readers_start(self, fds, max_len: int = 65536,

@@ -75,6 +75,12 @@ class _ImportBatch(list):
     thread (the ImportMetricChan of reference worker.go:55)."""
 
 
+class _ImportBytes(bytes):
+    """Queue item carrying a RAW serialized forwardrpc.MetricList for the
+    native import decoder (NativeAggregator.import_pb_bytes): the gRPC
+    thread never pays Python protobuf deserialization."""
+
+
 class _SpanMetricBatch(list):
     """Queue item carrying span-extracted UDPMetrics (ssfmetrics loop-back
     into L3, SURVEY §2.5)."""
@@ -392,6 +398,14 @@ class Server:
     def _dispatch_item_inner(self, item):
         if isinstance(item, FlushRequest):
             self._handle_flush_request(item)
+        elif isinstance(item, _ImportBytes):
+            t0 = time.perf_counter_ns()
+            n, errs = self.aggregator.import_pb_bytes(bytes(item))
+            self.imported_total += n
+            self.import_errors += errs
+            report_one(self.trace_client, ssf_samples.timing(
+                "veneur.import.response_duration_ns",
+                (time.perf_counter_ns() - t0) / 1e9, {"part": "merge"}))
         elif isinstance(item, _ImportBatch):
             from veneur_tpu.forward.convert import import_into
             # counted here on the single pipeline thread, not in the
@@ -900,8 +914,11 @@ class Server:
                 self.cfg.grpc_address
                 if "//" in self.cfg.grpc_address
                 else f"tcp://{self.cfg.grpc_address}")
+            native_import = hasattr(self.aggregator, "import_pb_bytes")
             self._grpc_server, self.grpc_port = rpc.serve(
-                self.import_metrics, f"{target[0]}:{target[1]}")
+                self.import_bytes if native_import
+                else self.import_metrics,
+                f"{target[0]}:{target[1]}", raw=native_import)
         # forwarding client, dialed once at start (server.go:843-851);
         # http(s):// addresses take the HTTP /import path unless
         # forward_use_grpc forces gRPC (flusher.go:84-95 dispatch)
@@ -948,6 +965,12 @@ class Server:
         """gRPC import entry: enqueue onto the pipeline thread
         (importsrv/server.go:102 SendMetrics → IngestMetrics)."""
         self.packet_queue.put(_ImportBatch(metrics))
+
+    def import_bytes(self, data: bytes) -> None:
+        """Raw-bytes gRPC import entry (native decode path): the
+        pipeline thread hands the serialized MetricList straight to the
+        C++ importer."""
+        self.packet_queue.put(_ImportBytes(data))
 
     def process_span_metrics(self, metrics: List) -> None:
         """Extraction-sink loop-back: span-derived UDPMetrics re-enter the
