@@ -144,6 +144,42 @@ def bench_worker_ingest(seconds):
     return _timeit(run, seconds, batch=len(metrics))
 
 
+def bench_worker_ingest_native(seconds):
+    """The COMPLETE native ingest cycle per core — wire bytes → C++
+    parse → key/slot → staged lanes → emit_into numpy (device dispatch
+    excluded; it overlaps on a real chip). This is the host feed's
+    per-core ceiling: the 50M samples/s north star is this number times
+    parse cores (see PARITY.md §host-feed scaling law)."""
+    from veneur_tpu import native
+    if not native.available():
+        return {"skipped": "native engine unavailable"}
+    from veneur_tpu.aggregation.host import BatchSpec
+    from veneur_tpu.aggregation.state import TableSpec
+    eng = native.NativeIngest(
+        TableSpec(counter_capacity=1 << 14, gauge_capacity=64,
+                  status_capacity=16, set_capacity=64,
+                  histo_capacity=1 << 8),
+        BatchSpec(counter=1 << 16, gauge=256, status=64, set=1 << 10,
+                  histo=1 << 12))
+    # realistic mixed packets: 10k-name counter replay traffic (config 1's
+    # model), 40 lines per datagram like the UDP path sees
+    rng = np.random.default_rng(1)
+    bufs = []
+    for _ in range(64):
+        ns = rng.integers(0, 10_000, 40)
+        bufs.append(b"\n".join(b"replay.counter.%d:1|c" % n for n in ns))
+    arrays = _native_arrays(eng)
+
+    def run():
+        for buf in bufs:
+            if eng.feed(buf):
+                eng.emit_into(arrays)
+        if eng.pending() > (1 << 15):
+            eng.emit_into(arrays)
+
+    return _timeit(run, seconds, batch=64 * 40)
+
+
 # -- full flush (server_test.go:1139 BenchmarkServerFlush) -------------------
 
 def bench_server_flush(seconds):
@@ -459,6 +495,7 @@ MICROS = {
     "parse_metric_native": bench_parse_metric_native,
     "parse_ssf": bench_parse_ssf,
     "worker_ingest": bench_worker_ingest,
+    "worker_ingest_native": bench_worker_ingest_native,
     "server_flush": bench_server_flush,
     "handle_ssf": bench_handle_ssf,
     "import_metrics": bench_import_metrics,
