@@ -190,6 +190,21 @@ def test_native_import_lane_full_at_entry_not_dropped():
     assert {f"imp.c.{i}" for i in range(10)} <= names
 
 
+def test_import_digest_consistent_hash_partition():
+    """reference importsrv/server_test.go:31 TestSendMetrics_ConsistentHash:
+    the exact 2-way partition of five known metrics pins the import hash
+    (fnv1a over name, Type.String(), tags) bit-for-bit — a mixed fleet
+    shards identically whichever implementation runs the global tier."""
+    from veneur_tpu.forward.convert import metric_digest
+    inputs = [("test.counter", mpb.Counter, ("tag:1",)),
+              ("test.gauge", mpb.Gauge, ()),
+              ("test.histogram", mpb.Histogram, ("type:histogram",)),
+              ("test.set", mpb.Set, ()),
+              ("test.gauge3", mpb.Gauge, ())]
+    assert [metric_digest(n, t, tags) % 2
+            for n, t, tags in inputs] == [0, 1, 1, 1, 0]
+
+
 def test_native_import_fuzz_no_crash():
     """vi_import parses untrusted network bytes: random mutations of
     valid MetricLists (truncate/flip/splice/insert/pure-random) must

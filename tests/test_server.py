@@ -122,13 +122,17 @@ def test_sample_rate_and_magic_tags(server):
     _send_udp(addr, [
         b"r.counter:1|c|@0.5",             # counts as 2
         b"scoped.gauge:4|g|#veneurlocalonly",
-    ])
-    _wait_processed(srv, 2)
+        b"r.timer:5|ms|@0.5",              # weight 2 (samplers_test.go:473
+        b"r.timer:15|ms|@0.5",             # TestHistoSampleRate: count is
+    ])                                     # the 1/rate-weighted total)
+    _wait_processed(srv, 4)
     srv.trigger_flush()
     m = by_name(sink.flushed)
     assert m["r.counter"].value == 2.0
     assert m["scoped.gauge"].value == 4.0
     assert m["scoped.gauge"].tags == []  # magic tag stripped
+    assert m["r.timer.count"].value == 4.0
+    assert m["r.timer.max"].value == 15.0   # max is the raw sample
 
 
 def test_events_and_service_checks(server):

@@ -63,6 +63,13 @@ def test_parse_ssf_name_tag_promotion_and_rate_normalization():
     assert got.name == "legacy.name"
     assert "name" not in got.tags
     assert got.metrics[0].sample_rate == 1.0
+    # regression_test.go:49-69 TestTagNameSetNameSet: with span.Name SET,
+    # the legacy tag neither overrides nor is deleted
+    span2 = make_span(name="real.name")
+    span2.tags["name"] = "legacy.name"
+    got2 = parse_ssf(span2.SerializeToString())
+    assert got2.name == "real.name"
+    assert got2.tags["name"] == "legacy.name"
 
 
 def test_valid_trace():
