@@ -223,8 +223,11 @@ def main():
             caps = []
             for path in glob.glob(os.path.join(
                     here, "benchmarks", "results", "*_tpu_capture_*.json")):
-                with open(path) as f:
-                    cap = json.load(f)
+                try:
+                    with open(path) as f:
+                        cap = json.load(f)
+                except (OSError, ValueError):
+                    continue   # one truncated file must not hide the rest
                 if cap.get("platform") == "tpu" and cap.get("value"):
                     caps.append((os.path.basename(path), cap))
             if caps:

@@ -63,6 +63,33 @@ def test_quantiles_parity_random_digests():
     np.testing.assert_allclose(got[live], want[live], rtol=2e-5, atol=2e-5)
 
 
+def test_quantiles_parity_production_width_halved_tile():
+    """The PRODUCTION row width (TableSpec().total_cells = 472 → c_pad
+    512) takes quantiles_rows' halved row-tile branch — which no other
+    case reaches; a grid/index-map bug there would only surface on
+    first-silicon runs (r05 review finding). Row count deliberately not
+    a multiple of the 128-row tile."""
+    from veneur_tpu.aggregation.state import TableSpec
+    c = TableSpec().total_cells
+    assert c > 232   # guard: this test exists to cross the 256 boundary
+    rng = np.random.default_rng(6)
+    r = 150          # pads to 256 rows at tile 128
+    mean = rng.lognormal(2.0, 1.0, (r, c)).astype(np.float32)
+    weight = (rng.uniform(0, 2, (r, c))
+              * (rng.uniform(size=(r, c)) < 0.6)).astype(np.float32)
+    weight[:, 0] = 1.0
+    live = np.where(weight > 0, mean, np.nan)
+    mn = np.nanmin(live, axis=1).astype(np.float32)
+    mx = np.nanmax(live, axis=1).astype(np.float32)
+    qs = np.asarray([0.0, 0.5, 0.99, 1.0], np.float32)
+    got = np.asarray(quantiles_rows(
+        jnp.asarray(mean), jnp.asarray(weight), jnp.asarray(mn),
+        jnp.asarray(mx), jnp.asarray(qs), interpret=True))
+    ref = _xla_rows(mean, weight, mn, mx, qs)
+    scale = np.maximum(np.abs(ref), 1e-6)
+    assert np.nanmax(np.abs(got - ref) / scale) < 1e-3
+
+
 def test_quantiles_parity_through_table():
     """End-to-end through td.quantiles' row flattening (leading batch
     shape preserved)."""

@@ -4,8 +4,9 @@ prefix-sum + piecewise-linear interpolation fused in VMEM.
 The XLA path (ops/tdigest.py quantiles) lowers to a generic variadic
 sort, a gather, and several elementwise passes — each a round-trip
 through HBM over the [rows, cells] arrays. Rows are independent and a
-row (≤256 cells after padding) fits comfortably in VMEM, so the whole
-reduction is one kernel: load a tile of rows, sort each row's
+row (512 cells after padding at the production 472-column layout) fits
+comfortably in VMEM, so the whole reduction is one kernel: load a tile
+of rows, sort each row's
 (mean, weight) pairs with a fixed bitonic network (static shapes — the
 digest's cell count is compile-time), cumsum, and evaluate the midpoint
 interpolation for every requested quantile without ever leaving VMEM.
@@ -53,7 +54,10 @@ from jax.experimental import pallas as pl
 
 log = logging.getLogger("veneur_tpu.ops.pallas_digest")
 
-ROW_TILE = 256  # rows per grid step; [256, 256] f32 tiles ≈ 256KB VMEM each
+# rows per grid step at ≤256 cells; quantiles_rows halves this beyond
+# 256 padded cells so the [tile, c_pad] f32 working set (inputs + sort
+# temporaries) stays ~constant (≈0.5MB/array) as rows widen
+ROW_TILE = 256
 
 
 def _next_pow2(n: int) -> int:
@@ -170,24 +174,29 @@ def quantiles_rows(mean, weight, mn, mx, qs, *, interpret: bool = False):
     r, c = mean.shape
     n_q = int(qs.shape[0])
     c_pad = max(_next_pow2(c), 128)
-    r_pad = ((r + ROW_TILE - 1) // ROW_TILE) * ROW_TILE
+    # Keep the per-step VMEM working set roughly constant as the cell
+    # count grows (exact-extreme protection widened production rows to
+    # 472 → c_pad 512): halve the row tile beyond 256 cells so the sort
+    # temporaries stay well inside VMEM on first-silicon runs.
+    row_tile = ROW_TILE if c_pad <= 256 else ROW_TILE // 2
+    r_pad = ((r + row_tile - 1) // row_tile) * row_tile
     if c_pad != c or r_pad != r:
         mean = jnp.pad(mean, ((0, r_pad - r), (0, c_pad - c)))
         weight = jnp.pad(weight, ((0, r_pad - r), (0, c_pad - c)))
         mn = jnp.pad(mn, (0, r_pad - r))
         mx = jnp.pad(mx, (0, r_pad - r))
-    grid = (r_pad // ROW_TILE,)
+    grid = (r_pad // row_tile,)
     out = pl.pallas_call(
         functools.partial(_quantile_kernel, n_q=n_q),
         grid=grid,
         in_specs=[
             pl.BlockSpec((n_q,), lambda i: (0,)),
-            pl.BlockSpec((ROW_TILE, c_pad), lambda i: (i, 0)),
-            pl.BlockSpec((ROW_TILE, c_pad), lambda i: (i, 0)),
-            pl.BlockSpec((ROW_TILE, 1), lambda i: (i, 0)),
-            pl.BlockSpec((ROW_TILE, 1), lambda i: (i, 0)),
+            pl.BlockSpec((row_tile, c_pad), lambda i: (i, 0)),
+            pl.BlockSpec((row_tile, c_pad), lambda i: (i, 0)),
+            pl.BlockSpec((row_tile, 1), lambda i: (i, 0)),
+            pl.BlockSpec((row_tile, 1), lambda i: (i, 0)),
         ],
-        out_specs=pl.BlockSpec((ROW_TILE, n_q), lambda i: (i, 0)),
+        out_specs=pl.BlockSpec((row_tile, n_q), lambda i: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((r_pad, n_q), jnp.float32),
         interpret=interpret,
     )(jnp.asarray(qs, jnp.float32), mean, weight,
