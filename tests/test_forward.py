@@ -393,6 +393,41 @@ def test_e2e_forwarding_indicator_metrics():
         glob.shutdown()
 
 
+def test_proxy_empty_and_unreachable_destinations_counted():
+    """reference proxysrv/server_test.go:65 TestNoDestinations / :73
+    TestUnreachableDestinations: an empty ring and all-unreachable
+    destinations are per-metric ERRORS (counted, never a crash and
+    never silent loss)."""
+    from veneur_tpu.forward.proxysrv import ProxyServer
+    from veneur_tpu.proto import metricpb_pb2 as mpb
+
+    def metric(i):
+        m = mpb.Metric(name=f"p.{i}", type=mpb.Counter)
+        m.counter.value = 1
+        return m
+
+    class StaticDisco:
+        def __init__(self, hosts):
+            self.hosts = hosts
+
+        def get_destinations_for_service(self, service):
+            return self.hosts
+
+    empty = ProxyServer(StaticDisco([]), service="s")
+    empty.handle([metric(i) for i in range(10)])
+    assert empty.errors == 10 and empty.forwarded == 0
+
+    # ports guaranteed closed: bind-then-close
+    import socket as _s
+    s1 = _s.socket(); s1.bind(("127.0.0.1", 0))
+    dead = f"127.0.0.1:{s1.getsockname()[1]}"
+    s1.close()
+    unreach = ProxyServer(StaticDisco([dead]), service="s")
+    unreach.refresh()
+    unreach.handle([metric(i) for i in range(10)])
+    assert unreach.errors == 10 and unreach.forwarded == 0
+
+
 def test_proxy_runtime_and_stats_emission():
     """Proxy self-telemetry (proxy.go:656 ReportRuntimeMetrics,
     :213-217 veneur_proxy. statsd namespace): runtime gauges carry the

@@ -68,6 +68,27 @@ def test_statsd_unix_stream(tmp_path):
         srv.shutdown()
 
 
+def test_resolve_addr_table():
+    """reference protocol/addr_test.go:9 TestListenAddr: the scheme →
+    (network, address) table, incl. tcp6 collapsing to tcp, abstract
+    unix names, and unixgram."""
+    from veneur_tpu.server.server import resolve_addr
+    assert resolve_addr("udp://127.0.0.1:8200") == \
+        ("udp", ("127.0.0.1", 8200))
+    assert resolve_addr("tcp://:8200")[0] == "tcp"
+    assert resolve_addr("tcp://:8200")[1][1] == 8200
+    assert resolve_addr("tcp6://[::1]:8200") == ("tcp", ("::1", 8200))
+    assert resolve_addr("unix:///tmp/foo.sock") == \
+        ("unix", "/tmp/foo.sock")
+    assert resolve_addr("unix:@abstract.sock") == \
+        ("unix", "@abstract.sock")
+    assert resolve_addr("unixgram:///tmp/foo.sock") == \
+        ("unixgram", "/tmp/foo.sock")
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        resolve_addr("carrier-pigeon://coop:1")
+
+
 def test_statsd_abstract_socket():
     """'@name' binds the Linux abstract namespace: nothing on the
     filesystem, no lock file (networking.go:304 isAbstractSocket)."""

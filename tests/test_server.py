@@ -135,6 +135,27 @@ def test_sample_rate_and_magic_tags(server):
     assert m["r.timer.max"].value == 15.0   # max is the raw sample
 
 
+def test_global_accepts_histograms_over_udp():
+    """reference flusher_test.go:148 TestGlobalAcceptsHistogramsOverUDP:
+    a GLOBAL instance hit directly over the wire by a mixed-scope
+    histogram flushes its aggregates (nowhere to forward; the direct
+    hit means it is not imported_only) alongside percentiles."""
+    sink = DebugMetricSink()
+    srv = Server(small_config(), metric_sinks=[sink])  # no forward_address
+    assert not srv.cfg.is_local
+    srv.start()
+    try:
+        _send_udp(srv.local_addr(), [b"g.histo:20|h"])
+        _wait_processed(srv, 1)
+        srv.trigger_flush()
+        m = by_name(sink.flushed)
+        assert m["g.histo.min"].value == 20.0
+        assert m["g.histo.count"].value == 1.0
+        assert "g.histo.50percentile" in m
+    finally:
+        srv.shutdown()
+
+
 def test_events_and_service_checks(server):
     srv, sink = server
     addr = srv.local_addr()
