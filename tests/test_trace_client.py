@@ -1,0 +1,129 @@
+"""Trace client backends (reference trace/client_test.go: TestUDP,
+TestReconnectUNIX/Buffered, TestDropStatistics) — UDP datagram delivery,
+stream reconnect-after-poison, and backpressure drop counting."""
+
+import socket
+import threading
+import time
+
+import pytest
+
+from veneur_tpu.proto import ssf_pb2
+from veneur_tpu.protocol.wire import parse_ssf, read_ssf
+from veneur_tpu.trace.client import (Client, PacketBackend, StreamBackend,
+                                     report_one)
+from veneur_tpu.samplers import ssf_samples
+
+
+def _span(i=1):
+    return ssf_pb2.SSFSpan(version=0, trace_id=i, id=i + 1, service="svc",
+                           name="op", start_timestamp=1, end_timestamp=2)
+
+
+def test_udp_packet_backend_delivers():
+    """client_test.go:59 TestUDP: one SSF protobuf per datagram."""
+    rx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    rx.bind(("127.0.0.1", 0))
+    rx.settimeout(5)
+    cl = Client(PacketBackend(rx.getsockname()))
+    try:
+        cl.record(_span(7))
+        cl.flush()
+        got = parse_ssf(rx.recv(65536))
+        assert got.trace_id == 7 and got.service == "svc"
+    finally:
+        cl.close()
+        rx.close()
+
+
+def test_stream_backend_reconnects_after_peer_reset():
+    """client_test.go:231 TestReconnectUNIX: the poison span is dropped,
+    the NEXT span arrives over a fresh connection (backend.go stream
+    semantics, linear backoff)."""
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(4)
+    srv.settimeout(10)
+    cl = Client(StreamBackend(srv.getsockname()))
+    try:
+        conn1, _ = None, None
+        cl.record(_span(1))
+        conn1, _ = srv.accept()
+        conn1.settimeout(5)
+        f1 = conn1.makefile("rb")
+        assert read_ssf(f1).trace_id == 1
+        # hard-kill the server side; the client's next send hits the
+        # dead socket (poison, dropped) and reconnects for the one after
+        conn1.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                         b"\x01\x00\x00\x00\x00\x00\x00\x00")
+        f1.close()       # makefile dups the fd: close BOTH or no RST
+        conn1.close()
+        deadline = time.time() + 10
+        got = None
+        i = 2
+        while time.time() < deadline and got is None:
+            cl.record(_span(i))
+            cl.flush(timeout=1.0)
+            i += 1
+            try:
+                srv.settimeout(0.2)
+                conn2, _ = srv.accept()
+                conn2.settimeout(5)
+                got = read_ssf(conn2.makefile("rb"))
+                conn2.close()
+            except socket.timeout:
+                continue
+        assert got is not None, "client never reconnected"
+        assert got.trace_id >= 2
+        assert cl.errors >= 1        # the poison span was counted
+    finally:
+        cl.close()
+        srv.close()
+
+
+def test_client_drop_statistics_on_full_buffer():
+    """client_test.go:434 TestDropStatistics: a full record buffer drops
+    non-blockingly and counts, successes count separately."""
+    release = threading.Event()
+
+    class Blocking:
+        def __init__(self):
+            self.sent = []
+
+        def send(self, span):
+            release.wait(5)
+            self.sent.append(span)
+
+        def close(self):
+            pass
+
+    cl = Client(Blocking(), capacity=1)
+    try:
+        assert cl.record(_span(1))        # worker picks this up, blocks
+        time.sleep(0.1)
+        assert cl.record(_span(2))        # fills the 1-slot queue
+        assert not cl.record(_span(3))    # ErrWouldBlock equivalent
+        assert cl.dropped == 1
+        release.set()
+        cl.flush()
+        assert cl.sent == 2
+    finally:
+        cl.close()
+
+
+def test_report_one_metrics_only_span():
+    """trace/metrics/client.go:21 ReportOne: the carrier span holds only
+    metrics — no trace identity fields."""
+    rx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    rx.bind(("127.0.0.1", 0))
+    rx.settimeout(5)
+    cl = Client(PacketBackend(rx.getsockname()))
+    try:
+        assert report_one(cl, ssf_samples.count("c.x", 3))
+        cl.flush()
+        got = parse_ssf(rx.recv(65536))
+        assert got.trace_id == 0 and len(got.metrics) == 1
+        assert got.metrics[0].name == "c.x"
+    finally:
+        cl.close()
+        rx.close()
