@@ -135,6 +135,16 @@ def test_sample_rate_and_magic_tags(server):
     assert m["r.timer.max"].value == 15.0   # max is the raw sample
 
 
+def test_tick_delay_aligns_to_interval():
+    """reference server_test.go:994 TestCalculateTickerDelay: at
+    11:45:26.371 with a 10s interval, the next aligned tick is 3.629s
+    out."""
+    from veneur_tpu.server.server import tick_delay
+    import calendar
+    now = calendar.timegm((2014, 11, 12, 11, 45, 26)) + 0.371
+    assert tick_delay(10.0, now) == pytest.approx(3.629, abs=1e-6)
+
+
 def test_global_accepts_histograms_over_udp():
     """reference flusher_test.go:148 TestGlobalAcceptsHistogramsOverUDP:
     a GLOBAL instance hit directly over the wire by a mixed-scope

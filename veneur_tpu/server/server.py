@@ -111,6 +111,13 @@ def unix_bind_address(path: str) -> str:
     return "\0" + path[1:] if path.startswith("@") else path
 
 
+def tick_delay(interval: float, now: float) -> float:
+    """Seconds until the next wall-clock multiple of `interval`
+    (reference server.go:866 CalculateTickDelay; pinned by its test's
+    11:45:26.371 @ 10s → 3.629s case)."""
+    return interval - (now % interval)
+
+
 def _native_available() -> bool:
     from veneur_tpu import native
     return native.available()
@@ -986,8 +993,7 @@ class Server:
             # align the first tick to a wall-clock multiple of the
             # interval for downstream bucketing convenience
             # (server.go:866-870 CalculateTickDelay)
-            delay = self.interval - (time.time() % self.interval)
-            if self._shutdown.wait(delay):
+            if self._shutdown.wait(tick_delay(self.interval, time.time())):
                 return
             self.trigger_flush(wait=False)
         while not self._shutdown.wait(self.interval):
