@@ -1,0 +1,220 @@
+"""Chaos tests: FaultInjector-driven end-to-end failure drills.
+
+Each test arms the process-global FAULTS injector, runs a real server
+over loopback, and asserts the reliability layer contains the blast:
+a flaky sink recovers via retry, a dead forward target trips the
+breaker, a slow sink is skipped (not queued behind), and a flush-worker
+fault fails exactly one interval. FAULTS is process-global state, so
+every test resets it in a finally block.
+
+Tier-1 discipline: deterministic (seeded policies, counted faults), no
+sleep longer than the polling helpers' 50ms tick, JAX on CPU via
+conftest."""
+
+import subprocess
+import sys
+import threading
+import pathlib
+
+import grpc
+import pytest
+
+from tests.test_server import (_send_udp, _wait_processed, _wait_until,
+                               by_name, small_config)
+from veneur_tpu.reliability.faults import (FAULTS, FLUSH_WORKER,
+                                           SINK_FLUSH)
+from veneur_tpu.reliability.policy import OPEN
+from veneur_tpu.server.server import Server
+from veneur_tpu.sinks.base import MetricSink
+from veneur_tpu.sinks.debug import DebugMetricSink
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    """Faults are process-global: never let one test's arming leak."""
+    FAULTS.reset()
+    yield
+    FAULTS.reset()
+
+
+def test_flaky_sink_recovers_via_retry():
+    """One injected sink-flush failure + sink_retry_max=2: the interval's
+    data still lands, and the fan-out counts exactly one retry."""
+    sink = DebugMetricSink()
+    srv = Server(small_config(sink_retry_max=2, sink_retry_base_ms=1),
+                 metric_sinks=[sink])
+    srv.start()
+    try:
+        _send_udp(srv.local_addr(), [b"chaos.count:4|c"])
+        _wait_processed(srv, 1)
+        FAULTS.arm(SINK_FLUSH, error=True, times=1, match="debug")
+        assert srv.trigger_flush()
+        assert FAULTS.fired(SINK_FLUSH) == 1
+        m = by_name(sink.flushed)
+        assert m["chaos.count"].value == 4.0
+        assert srv._fanout_retries.get("debug") == 1
+        assert srv._sink_flush_errors.get("debug") is None
+    finally:
+        srv.shutdown()
+
+
+def test_dead_forward_target_trips_breaker_and_redials():
+    """Forwarding at a closed port: the first interval fails (and the
+    UNAVAILABLE redial fires), the breaker opens at threshold 1, and the
+    second interval is refused by the open circuit without dialing."""
+    # grab a port nothing listens on
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    dead_port = s.getsockname()[1]
+    s.close()
+
+    srv = Server(small_config(
+        forward_address=f"127.0.0.1:{dead_port}",
+        circuit_failure_threshold=1,
+        circuit_cooldown_s=600.0),
+        metric_sinks=[DebugMetricSink()])
+    srv.start()
+    try:
+        _send_udp(srv.local_addr(), [b"chaos.timer:10|ms"])
+        _wait_processed(srv, 1)
+        assert srv.trigger_flush()
+        _wait_until(lambda: srv.forward_errors >= 1,
+                    what="first forward failure")
+        assert srv._forward_breaker.state == OPEN
+        assert srv._forward_client.reconnects_total >= 1
+        redials = srv._forward_client.reconnects_total
+
+        _send_udp(srv.local_addr(), [b"chaos.timer:20|ms"])
+        _wait_processed(srv, 2)
+        assert srv.trigger_flush()
+        _wait_until(lambda: srv.forward_errors >= 2,
+                    what="circuit-open forward refusal")
+        # the open circuit short-circuits BEFORE the client: no new dial
+        assert srv._forward_client.reconnects_total == redials
+        assert srv.forward_sends_total == 0
+    finally:
+        srv.shutdown()
+
+
+def test_forward_client_reconnects_and_recovers():
+    """Satellite (a): a send failing with UNAVAILABLE replaces the gRPC
+    channel, and once a peer listens on the address again the SAME client
+    object delivers."""
+    from veneur_tpu.forward.rpc import ForwardClient
+
+    glob = Server(small_config(grpc_address="127.0.0.1:0"),
+                  metric_sinks=[DebugMetricSink()])
+    glob.start()
+    port = glob.grpc_port
+    client = ForwardClient(f"127.0.0.1:{port}")
+    try:
+        client.send_metrics([], timeout=30.0)
+        assert client.reconnects_total == 0
+        old_channel = client._channel
+
+        glob.shutdown()
+        with pytest.raises(grpc.RpcError):
+            client.send_metrics([], timeout=5.0)
+        assert client.reconnects_total == 1
+        assert client._channel is not old_channel
+
+        # a new global on the same address: the redialed channel reaches
+        # it with no further intervention
+        glob2 = Server(small_config(grpc_address=f"127.0.0.1:{port}"),
+                       metric_sinks=[DebugMetricSink()])
+        glob2.start()
+        try:
+            client.send_metrics([], timeout=30.0)
+            assert client.reconnects_total == 1
+        finally:
+            glob2.shutdown()
+    finally:
+        client.close()
+
+
+class _BlockingSink(MetricSink):
+    """First flush parks on an Event; later flushes return instantly."""
+    name = "blocky"
+
+    def __init__(self):
+        self.release = threading.Event()
+        self.calls = 0
+
+    def flush(self, metrics):
+        self.calls += 1
+        if self.calls == 1:
+            self.release.wait(30.0)
+
+
+def test_slow_sink_is_skipped_not_queued():
+    """Existing containment under chaos: while one sink flush is wedged,
+    later intervals skip that sink (counted) instead of stacking
+    threads, and ingest keeps flowing."""
+    sink = _BlockingSink()
+    srv = Server(small_config(interval="200ms"), metric_sinks=[sink])
+    srv.start()
+    try:
+        _send_udp(srv.local_addr(), [b"slow.count:1|c"])
+        _wait_processed(srv, 1)
+        # first flush wedges the sink; the barrier budget (= interval)
+        # expires and the flush completes without it
+        assert srv.trigger_flush()
+        assert sink.calls == 1
+        _send_udp(srv.local_addr(), [b"slow.count:1|c"])
+        _wait_processed(srv, 2)
+        assert srv.trigger_flush()
+        _wait_until(lambda: srv.sink_flushes_skipped >= 1,
+                    what="slow-sink skip accounting")
+        assert sink.calls == 1   # no second thread entered the sink
+    finally:
+        sink.release.set()
+        srv.shutdown()
+
+
+def test_flush_worker_fault_fails_one_interval_only():
+    """A fault in the flush worker fails THAT flush request (visibly:
+    trigger_flush -> False) and nothing else; the next interval is
+    healthy because state was already swapped."""
+    sink = DebugMetricSink()
+    srv = Server(small_config(), metric_sinks=[sink])
+    srv.start()
+    try:
+        FAULTS.arm(FLUSH_WORKER, error=True, times=1)
+        _send_udp(srv.local_addr(), [b"boom.count:9|c"])
+        _wait_processed(srv, 1)
+        assert srv.trigger_flush() is False
+        assert FAULTS.fired(FLUSH_WORKER) == 1
+        # the faulted interval's state was swapped before the fault —
+        # its data is gone by design, but the pipeline is intact
+        _send_udp(srv.local_addr(), [b"after.count:2|c"])
+        _wait_processed(srv, 2)
+        assert srv.trigger_flush() is True
+        assert by_name(sink.flushed)["after.count"].value == 2.0
+    finally:
+        srv.shutdown()
+
+
+def test_fault_injection_config_key_arms_on_start():
+    """The `fault_injection` config key (same grammar as
+    VENEUR_FAULT_INJECTION) arms the injector during start()."""
+    srv = Server(small_config(fault_injection="flush.worker:error:1"),
+                 metric_sinks=[DebugMetricSink()])
+    srv.start()
+    try:
+        _send_udp(srv.local_addr(), [b"cfg.count:1|c"])
+        _wait_processed(srv, 1)
+        assert srv.trigger_flush() is False
+        assert srv.trigger_flush() is True
+    finally:
+        srv.shutdown()
+
+
+def test_egress_paths_have_no_silent_excepts():
+    """Satellite (f): the bare-except lint over the egress surface runs
+    clean — every handler logs or counts what it catches."""
+    script = (pathlib.Path(__file__).resolve().parent.parent
+              / "scripts" / "check_no_bare_except.py")
+    proc = subprocess.run([sys.executable, str(script)],
+                          capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -1,0 +1,383 @@
+"""Unit tests for the reliability layer (veneur_tpu/reliability/) plus the
+end-to-end spill-merge acceptance check: forwarded percentiles and set
+cardinalities after a 2-interval forward outage equal a never-failed run.
+
+Everything unit-level runs in virtual time — injected clocks and sleeps,
+no wall-clock waits."""
+
+import threading
+
+import pytest
+
+from veneur_tpu.reliability.faults import (FORWARD_SEND, SINK_FLUSH,
+                                           FAULTS, FaultInjector,
+                                           InjectedFault)
+from veneur_tpu.reliability.policy import (CLOSED, HALF_OPEN, OPEN,
+                                           CircuitBreaker, CircuitOpenError,
+                                           RetryPolicy)
+from veneur_tpu.reliability.spill import ForwardSpillBuffer
+
+
+class VirtualClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def sleep(self, dt):
+        self.t += dt
+
+
+# -- RetryPolicy --------------------------------------------------------------
+
+def test_backoff_deterministic_and_bounded():
+    p = RetryPolicy(max_retries=5, base_ms=100, max_ms=800, jitter=0.5,
+                    seed=7)
+    delays = [p.backoff(i) for i in range(6)]
+    # same (seed, attempt) -> same delay, always
+    assert delays == [p.backoff(i) for i in range(6)]
+    # envelope: base*2^i capped at max_ms, jitter adds [0, 50%)
+    for i, d in enumerate(delays):
+        base = min(0.1 * 2 ** i, 0.8)
+        assert base <= d < base * 1.5
+    # a different seed decorrelates the schedule
+    assert delays != [RetryPolicy(max_retries=5, base_ms=100, max_ms=800,
+                                  jitter=0.5, seed=8).backoff(i)
+                      for i in range(6)]
+
+
+def test_run_retries_then_succeeds_with_virtual_sleep():
+    clock = VirtualClock()
+    p = RetryPolicy(max_retries=3, base_ms=100, seed=1)
+    calls = []
+    retries = []
+
+    def fn():
+        calls.append(clock.t)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert p.run(fn, sleep=clock.sleep, clock=clock,
+                 on_retry=lambda a, e, d: retries.append((a, d))) == "ok"
+    assert len(calls) == 3
+    # the virtual clock advanced by exactly the deterministic backoffs
+    assert retries == [(0, p.backoff(0)), (1, p.backoff(1))]
+    assert clock.t == pytest.approx(p.backoff(0) + p.backoff(1))
+
+
+def test_run_exhaustion_reraises():
+    clock = VirtualClock()
+    p = RetryPolicy(max_retries=2, base_ms=10, seed=0)
+    calls = []
+
+    def fn():
+        calls.append(1)
+        raise ValueError("permanent")
+
+    with pytest.raises(ValueError):
+        p.run(fn, sleep=clock.sleep, clock=clock)
+    assert len(calls) == 3   # initial + 2 retries
+
+
+def test_run_respects_overall_deadline():
+    clock = VirtualClock()
+    p = RetryPolicy(max_retries=10, base_ms=1000, jitter=0.0, seed=0,
+                    deadline_s=2.5)
+    calls = []
+
+    def fn():
+        calls.append(1)
+        raise OSError("down")
+
+    with pytest.raises(OSError):
+        p.run(fn, sleep=clock.sleep, clock=clock)
+    # backoffs 1s, 2s: the 2s retry would overshoot the 2.5s deadline,
+    # so only the 1s one runs -> 2 calls total
+    assert len(calls) == 2
+    assert clock.t <= 2.5
+
+
+def test_run_never_retries_into_open_circuit():
+    p = RetryPolicy(max_retries=5, base_ms=10, seed=0)
+    calls = []
+
+    def fn():
+        calls.append(1)
+        raise CircuitOpenError("open")
+
+    with pytest.raises(CircuitOpenError):
+        p.run(fn, sleep=lambda d: pytest.fail("must not sleep"))
+    assert len(calls) == 1
+
+
+# -- CircuitBreaker -----------------------------------------------------------
+
+def test_breaker_state_machine():
+    clock = VirtualClock()
+    b = CircuitBreaker(failure_threshold=3, cooldown_s=30.0, clock=clock)
+    assert b.state == CLOSED and b.allow()
+
+    b.record_failure()
+    b.record_failure()
+    assert b.state == CLOSED and b.allow()   # below threshold
+    b.record_failure()
+    assert b.state == OPEN
+    assert not b.allow()
+    assert b.opens_total == 1 and b.rejected_total == 1
+
+    # cooldown expiry: state reads half-open, ONE probe admitted
+    clock.t += 30.0
+    assert b.state == HALF_OPEN
+    assert b.allow()          # the probe
+    assert not b.allow()      # second caller refused while probe in flight
+    b.record_failure()        # probe failed -> re-open for another cooldown
+    assert b.state == OPEN and b.opens_total == 2
+    assert not b.allow()
+
+    clock.t += 30.0
+    assert b.allow()
+    b.record_success()        # probe succeeded -> closed, counters reset
+    assert b.state == CLOSED
+    b.record_failure()
+    b.record_failure()
+    assert b.state == CLOSED  # failure count restarted after success
+
+
+def test_breaker_success_resets_failure_streak():
+    b = CircuitBreaker(failure_threshold=2, clock=VirtualClock())
+    b.record_failure()
+    b.record_success()
+    b.record_failure()
+    assert b.state == CLOSED  # never two CONSECUTIVE failures
+
+
+# -- ForwardSpillBuffer -------------------------------------------------------
+
+class FakeMetric:
+    def __init__(self, name, nbytes=100):
+        self.name = name
+        self._n = nbytes
+
+    def ByteSize(self):
+        return self._n
+
+
+def test_spill_roundtrip_and_byte_cap():
+    clock = VirtualClock()
+    buf = ForwardSpillBuffer(max_bytes=250, max_age_s=60.0, clock=clock)
+    buf.add([FakeMetric("a"), FakeMetric("b")])
+    assert buf.bytes == 200 and len(buf) == 2
+    # third payload exceeds the cap -> oldest ("a") evicted
+    buf.add([FakeMetric("c")])
+    assert buf.bytes == 200
+    assert buf.dropped_capacity == 1
+    drained = buf.drain()
+    assert [m.name for m in drained] == ["b", "c"]
+    assert buf.bytes == 0 and len(buf) == 0
+    assert buf.spilled_total == 3 and buf.dropped_total == 1
+
+
+def test_spill_age_expiry():
+    clock = VirtualClock()
+    buf = ForwardSpillBuffer(max_bytes=10_000, max_age_s=60.0, clock=clock)
+    buf.add([FakeMetric("old")])
+    clock.t += 61.0
+    buf.add([FakeMetric("fresh")])
+    drained = buf.drain()
+    assert [m.name for m in drained] == ["fresh"]
+    assert buf.dropped_age == 1
+    assert buf.dropped_total == 1
+
+
+def test_spill_rejects_nonpositive_cap():
+    with pytest.raises(ValueError):
+        ForwardSpillBuffer(max_bytes=0)
+
+
+# -- FaultInjector ------------------------------------------------------------
+
+def test_fault_injector_error_times_and_reset():
+    fi = FaultInjector()
+    fi.arm(SINK_FLUSH, error=True, times=2)
+    with pytest.raises(InjectedFault):
+        fi.inject(SINK_FLUSH)
+    with pytest.raises(InjectedFault):
+        fi.inject(SINK_FLUSH)
+    fi.inject(SINK_FLUSH)         # exhausted -> no-op
+    assert fi.fired(SINK_FLUSH) == 2
+    fi.reset()
+    fi.inject(SINK_FLUSH)         # disarmed -> no-op
+    assert fi.fired(SINK_FLUSH) == 0
+
+
+def test_fault_injector_latency_uses_injected_sleep():
+    slept = []
+    fi = FaultInjector(sleep=slept.append)
+    fi.arm(FORWARD_SEND, latency_s=0.25)
+    fi.inject(FORWARD_SEND)
+    fi.inject(FORWARD_SEND)
+    assert slept == [0.25, 0.25]
+
+
+def test_fault_injector_match_filters_by_name():
+    fi = FaultInjector()
+    fi.arm(SINK_FLUSH, error=True, match="datadog")
+    fi.inject(SINK_FLUSH, name="debug")   # no match -> no-op
+    with pytest.raises(InjectedFault):
+        fi.inject(SINK_FLUSH, name="datadog")
+
+
+def test_fault_injector_spec_grammar():
+    fi = FaultInjector(sleep=lambda d: None)
+    fi.configure("sink.flush:error:2, forward.send:latency:0.05:1")
+    with pytest.raises(InjectedFault):
+        fi.inject(SINK_FLUSH)
+    fi.inject(FORWARD_SEND)
+    fi.inject(FORWARD_SEND)       # times=1: second is a no-op
+    assert fi.fired(FORWARD_SEND) == 1
+    for bad in ("noseparator", "p:latency", "p:bogusmode:1"):
+        with pytest.raises(ValueError):
+            FaultInjector().configure(bad)
+
+
+# -- ResilientSink harness ----------------------------------------------------
+
+def test_resilient_post_passthrough_when_unconfigured():
+    from veneur_tpu.sinks.base import ResilientSink
+
+    s = ResilientSink()
+    assert not s.resilience_configured
+    assert s.resilient_post(lambda: 41 + 1) == 42
+    with pytest.raises(OSError):
+        s.resilient_post(lambda: (_ for _ in ()).throw(OSError("x")))
+
+
+def test_resilient_post_retries_and_records_breaker():
+    from veneur_tpu.sinks.base import ResilientSink
+
+    clock = VirtualClock()
+    s = ResilientSink()
+    s.configure_resilience(
+        RetryPolicy(max_retries=3, base_ms=0.001, seed=0),
+        CircuitBreaker(failure_threshold=2, cooldown_s=30.0, clock=clock))
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 2:
+            raise OSError("transient")
+        return "sent"
+
+    assert s.resilient_post(flaky) == "sent"
+    assert s.retries_total == 1
+    assert s.breaker.state == CLOSED
+
+    # two terminal failures trip the shared breaker, then posts are
+    # refused with CircuitOpenError and counted
+    def dead():
+        raise OSError("down")
+
+    for _ in range(2):
+        with pytest.raises(OSError):
+            s.resilient_post(dead)
+    assert s.breaker.state == OPEN
+    with pytest.raises(CircuitOpenError):
+        s.resilient_post(dead)
+    assert s.posts_skipped_open == 1
+
+
+# -- spill-merge acceptance: outage == no outage ------------------------------
+
+def test_spill_merge_equals_fault_free_run():
+    """ISSUE PR1 acceptance: force forward failure for 2 consecutive
+    intervals; the 3rd interval's forward carries the spilled sketch
+    payloads, and the global tier's percentiles / set cardinalities /
+    counter sums equal a run that never failed."""
+    from tests.test_server import _send_udp, _wait_processed, _wait_until
+    from tests.test_server import by_name, small_config
+    from veneur_tpu.server.server import Server
+    from veneur_tpu.sinks.debug import DebugMetricSink
+
+    chunks = [
+        [f"rel.timer:{v}|ms".encode() for v in range(1, 41)]
+        + [f"rel.set:u{i}|s".encode() for i in range(20)]
+        + [b"rel.count:5|c|#veneurglobalonly"],
+        [f"rel.timer:{v}|ms".encode() for v in range(41, 81)]
+        + [f"rel.set:u{i}|s".encode() for i in range(10, 30)]
+        + [b"rel.count:7|c|#veneurglobalonly"],
+        [f"rel.timer:{v}|ms".encode() for v in range(81, 121)]
+        + [f"rel.set:u{i}|s".encode() for i in range(25, 45)]
+        + [b"rel.count:11|c|#veneurglobalonly"],
+    ]
+    n_per_chunk = len(chunks[0])
+
+    def run_tier(fail_intervals):
+        gsink = DebugMetricSink()
+        glob = Server(small_config(grpc_address="127.0.0.1:0"),
+                      metric_sinks=[gsink])
+        glob.start()
+        local = Server(small_config(
+            forward_address=f"127.0.0.1:{glob.grpc_port}",
+            forward_spill_max_bytes=1 << 20,
+            forward_spill_max_age_s=600.0),
+            metric_sinks=[DebugMetricSink()])
+        local.start()
+        try:
+            if fail_intervals:
+                FAULTS.arm(FORWARD_SEND, error=True, times=fail_intervals)
+            sent = 0
+            for i, chunk in enumerate(chunks):
+                _send_udp(local.local_addr(), chunk)
+                sent += n_per_chunk
+                _wait_processed(local, sent)
+                assert local.trigger_flush()
+                if fail_intervals and i < fail_intervals:
+                    # outage interval: the forward failed and its payload
+                    # (plus any prior spill) is back in the buffer
+                    _wait_until(lambda: len(local.forward_spill) > 0
+                                and local.forward_errors >= i + 1,
+                                what=f"spill after faulted interval {i}")
+                else:
+                    # a completed send means the batch is already in the
+                    # global's pipeline queue (the gRPC handler enqueues
+                    # before replying), so a trigger_flush enqueued later
+                    # flushes state that includes it — FIFO ordering is
+                    # the synchronization, not import counters (which the
+                    # local's own forwarded self-telemetry would inflate)
+                    want = i + 1 - fail_intervals
+                    _wait_until(
+                        lambda: local.forward_sends_total >= want
+                        and len(local.forward_spill) == 0,
+                        what=f"forward of interval {i}")
+            assert glob.trigger_flush()
+            if fail_intervals:
+                assert local.forward_errors == fail_intervals
+                assert local.forward_spill.spilled_total > 0
+                assert local.forward_spill.dropped_total == 0
+            return by_name(gsink.flushed)
+        finally:
+            FAULTS.reset()
+            local.shutdown()
+            glob.shutdown()
+
+    try:
+        faulted = run_tier(fail_intervals=2)
+        clean = run_tier(fail_intervals=0)
+    finally:
+        FAULTS.reset()
+
+    # counters are exact sums either way
+    assert faulted["rel.count"].value == clean["rel.count"].value == 23.0
+    # HLL register folds are order-independent: exact equality
+    assert faulted["rel.set"].value == clean["rel.set"].value
+    assert faulted["rel.set"].value == pytest.approx(45, rel=0.1)
+    # digest merges may associate differently across batch boundaries:
+    # allow float slack, but the quantiles must agree tightly
+    for q in ("50", "99"):
+        name = f"rel.timer.{q}percentile"
+        assert faulted[name].value == pytest.approx(clean[name].value,
+                                                    rel=1e-3)
+    assert faulted["rel.timer.50percentile"].value == pytest.approx(
+        60.5, rel=0.05)

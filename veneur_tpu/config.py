@@ -107,6 +107,18 @@ class Config:
     flush_file: str = ""
     flush_watchdog_missed_flushes: int = 0
 
+    # resilience layer (veneur_tpu/reliability/; this framework's
+    # addition). Reference-compatible defaults: 0 retries / threshold 0 /
+    # 0 spill bytes keep every egress path single-attempt and
+    # drop-on-failure, exactly today's behavior.
+    sink_retry_max: int = 0            # retries per egress call (0 = off)
+    sink_retry_base_ms: int = 100      # first backoff step
+    circuit_failure_threshold: int = 0  # consecutive failures (0 = off)
+    circuit_cooldown_s: float = 30.0   # open -> half-open probe delay
+    forward_spill_max_bytes: int = 0   # merge-on-retry buffer (0 = off)
+    forward_spill_max_age_s: float = 60.0
+    fault_injection: str = ""          # chaos spec (reliability/faults.py)
+
     # debug
     debug: bool = False
     debug_flushed_metrics: bool = False

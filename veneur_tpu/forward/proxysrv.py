@@ -16,6 +16,8 @@ import threading
 from typing import Dict, List, Optional
 
 from veneur_tpu.forward.rpc import ForwardClient, serve
+from veneur_tpu.reliability.faults import FAULTS, PROXY_FORWARD
+from veneur_tpu.reliability.policy import CircuitBreaker
 from veneur_tpu.utils.hashing import fnv1a_64, splitmix64
 
 
@@ -60,11 +62,19 @@ class ProxyServer:
     (proxysrv/server.go:273 destForMetric keyed on MetricKey.String())."""
 
     def __init__(self, discoverer, service: str = "veneur-global",
-                 refresh_interval: float = 0.0, replicas: int = 128):
+                 refresh_interval: float = 0.0, replicas: int = 128,
+                 failure_threshold: int = 0, cooldown_s: float = 30.0):
         self.discoverer = discoverer
         self.service = service
         self.refresh_interval = refresh_interval
         self.replicas = replicas
+        # per-destination breakers (failure_threshold=0 disables): a dead
+        # global otherwise eats a full send timeout per batch per interval
+        # while its ring partition backs up behind it
+        self.failure_threshold = failure_threshold
+        self.cooldown_s = cooldown_s
+        self._breakers: Dict[str, CircuitBreaker] = {}
+        self.rejected_open = 0
         self._ring = HashRing([], replicas)
         self._conns: Dict[str, ForwardClient] = {}
         self._lock = threading.Lock()
@@ -104,12 +114,24 @@ class ProxyServer:
             for dest in list(self._conns):
                 if dest not in self._ring.destinations:
                     self._conns.pop(dest).close()
+            for dest in list(self._breakers):
+                if dest not in self._ring.destinations:
+                    del self._breakers[dest]
 
     def _conn(self, dest: str) -> ForwardClient:
         with self._lock:
             if dest not in self._conns:
                 self._conns[dest] = ForwardClient(dest)
             return self._conns[dest]
+
+    def _breaker(self, dest: str) -> Optional[CircuitBreaker]:
+        if self.failure_threshold <= 0:
+            return None
+        with self._lock:
+            if dest not in self._breakers:
+                self._breakers[dest] = CircuitBreaker(
+                    self.failure_threshold, self.cooldown_s)
+            return self._breakers[dest]
 
     # -- forwarding ---------------------------------------------------------
     def handle(self, metrics: List):
@@ -126,12 +148,22 @@ class ProxyServer:
                 continue
             by_dest.setdefault(dest, []).append(m)
         for dest, batch in by_dest.items():
+            breaker = self._breaker(dest)
+            if breaker is not None and not breaker.allow():
+                self.errors += len(batch)
+                self.rejected_open += len(batch)
+                continue
             try:
+                FAULTS.inject(PROXY_FORWARD, name=dest)
                 self._conn(dest).send_metrics(batch)
                 self.forwarded += len(batch)
                 self._count_dest(dest, "grpc", len(batch))
+                if breaker is not None:
+                    breaker.record_success()
             except Exception as e:
                 self.errors += len(batch)
+                if breaker is not None:
+                    breaker.record_failure()
                 log.warning("proxy forward to %s failed: %s", dest, e)
 
     def _count_dest(self, dest: str, protocol: str, n: int) -> None:
@@ -169,12 +201,22 @@ class ProxyServer:
         """ProxyMetrics (proxy.go:580): hash-split, then one POST per
         destination, counting errors per batch like the gRPC path."""
         for dest, batch in self.handle_json(json_metrics).items():
+            breaker = self._breaker(dest)
+            if breaker is not None and not breaker.allow():
+                self.errors += len(batch)
+                self.rejected_open += len(batch)
+                continue
             try:
+                FAULTS.inject(PROXY_FORWARD, name=dest)
                 self._post_import(dest, batch)
                 self.forwarded += len(batch)
                 self._count_dest(dest, "http", len(batch))
+                if breaker is not None:
+                    breaker.record_success()
             except Exception as e:
                 self.errors += len(batch)
+                if breaker is not None:
+                    breaker.record_failure()
                 log.warning("proxy POST to %s failed: %s", dest, e)
 
     def start_http(self, address: str = "127.0.0.1:0") -> int:

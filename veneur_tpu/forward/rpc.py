@@ -7,6 +7,7 @@ reference local one."""
 from __future__ import annotations
 
 import logging
+import threading
 from concurrent import futures
 from typing import Callable, List
 
@@ -14,6 +15,7 @@ import grpc
 from google.protobuf import empty_pb2
 
 from veneur_tpu.proto import forwardrpc_pb2 as fpb
+from veneur_tpu.reliability.faults import FAULTS, FORWARD_SEND
 
 log = logging.getLogger("veneur_tpu.forward.rpc")
 
@@ -22,22 +24,59 @@ METHOD = "/forwardrpc.Forward/SendMetrics"
 
 class ForwardClient:
     """Forwarding client (reference flusher.go:474 forwardGRPC; single Dial
-    at Start, server.go:843-851)."""
+    at Start, server.go:843-851).
 
-    def __init__(self, address: str):
+    Unlike the reference's one-Dial-forever channel, a send that fails
+    with UNAVAILABLE tears the channel down and redials before the next
+    attempt: grpc-python channels can wedge permanently after the peer
+    restarts, and a local that never re-resolves its global is an outage
+    that survives the outage. `wait_for_ready` queues RPCs while the
+    channel (re)connects instead of failing fast."""
+
+    def __init__(self, address: str, wait_for_ready: bool = False):
         self.address = address
-        self._channel = grpc.insecure_channel(address)
+        self.wait_for_ready = wait_for_ready
+        self.reconnects_total = 0
+        self._lock = threading.Lock()
+        self._connect()
+
+    def _connect(self) -> None:
+        self._channel = grpc.insecure_channel(self.address)
         self._send = self._channel.unary_unary(
             METHOD,
             request_serializer=fpb.MetricList.SerializeToString,
             response_deserializer=empty_pb2.Empty.FromString)
+        self._send_raw = None   # rebuilt lazily against the new channel
+
+    def reconnect(self) -> None:
+        """Replace the channel (and its cached callables) with a fresh
+        dial. Safe under concurrent sends: they hold a reference to the
+        old callable and merely fail once more."""
+        with self._lock:
+            old = self._channel
+            self._connect()
+            self.reconnects_total += 1
+        log.warning("forward channel to %s recreated after UNAVAILABLE "
+                    "(%d reconnects)", self.address, self.reconnects_total)
+        try:
+            old.close()
+        except Exception as e:
+            log.debug("closing stale forward channel: %s", e)
 
     def send_metrics(self, metrics: List, timeout: float = 10.0,
                      parent_span=None, trace_client=None) -> None:
         # parent_span/trace_client accepted for interface parity with the
         # HTTP client; the reference's gRPC forward doesn't propagate
         # trace headers either (flusher.go:474 forwardGRPC has no Inject)
-        self._send(fpb.MetricList(metrics=metrics), timeout=timeout)
+        FAULTS.inject(FORWARD_SEND, name=self.address)
+        try:
+            self._send(fpb.MetricList(metrics=metrics), timeout=timeout,
+                       wait_for_ready=self.wait_for_ready)
+        except grpc.RpcError as e:
+            code = e.code() if hasattr(e, "code") else None
+            if code == grpc.StatusCode.UNAVAILABLE:
+                self.reconnect()
+            raise
 
     def send_serialized(self, data: bytes, timeout: float = 10.0,
                         wait: bool = True):
@@ -45,17 +84,20 @@ class ForwardClient:
         benchmarking: client-side marshal cost out of the timed loop).
         With wait=False returns a grpc future — callers overlap requests
         the way a whole local fleet does against one global."""
-        if not hasattr(self, "_send_raw"):
-            self._send_raw = self._channel.unary_unary(
-                METHOD, request_serializer=bytes,
-                response_deserializer=empty_pb2.Empty.FromString)
+        with self._lock:
+            if self._send_raw is None:
+                self._send_raw = self._channel.unary_unary(
+                    METHOD, request_serializer=bytes,
+                    response_deserializer=empty_pb2.Empty.FromString)
+            send_raw = self._send_raw
         if wait:
-            self._send_raw(data, timeout=timeout)
+            send_raw(data, timeout=timeout)
             return None
-        return self._send_raw.future(data, timeout=timeout)
+        return send_raw.future(data, timeout=timeout)
 
     def close(self):
-        self._channel.close()
+        with self._lock:
+            self._channel.close()
 
 
 class HTTPForwardClient:
@@ -67,9 +109,14 @@ class HTTPForwardClient:
     json_body=False for the deflated-protobuf MetricList body instead
     (this framework's compact v2-over-HTTP variant)."""
 
-    def __init__(self, address: str, json_body: bool = True):
+    def __init__(self, address: str, json_body: bool = True,
+                 retry_policy=None):
         self.address = address.rstrip("/")
         self.json_body = json_body
+        # reliability.policy.RetryPolicy (or None = single attempt);
+        # applied per-POST inside traced_post so every attempt re-runs
+        # the whole connect/send/status pipeline
+        self.retry_policy = retry_policy
         if not self.address.startswith(("http://", "https://")):
             self.address = "http://" + self.address
 
@@ -110,7 +157,8 @@ class HTTPForwardClient:
         from veneur_tpu.forward.tracedhttp import traced_post
         traced_post(f"{self.address}/import", zlib.compress(body), headers,
                     timeout=timeout, parent_span=parent_span,
-                    trace_client=trace_client, action="forward")
+                    trace_client=trace_client, action="forward",
+                    retry_policy=self.retry_policy)
 
     def close(self):
         pass

@@ -32,6 +32,7 @@ import ssl
 from typing import Dict, Optional, Tuple
 from urllib.parse import urlparse
 
+from veneur_tpu.reliability.faults import FAULTS, HTTP_POST
 from veneur_tpu.samplers import ssf_samples
 
 
@@ -61,14 +62,33 @@ class _SpanChain:
 
 def traced_post(url: str, body: bytes, headers: Dict[str, str],
                 timeout: float = 10.0, parent_span=None,
-                trace_client=None, action: str = "forward"
-                ) -> Tuple[int, bytes]:
+                trace_client=None, action: str = "forward",
+                retry_policy=None) -> Tuple[int, bytes]:
     """POST `body` to `url`, emitting the reference's connection-event
     span chain as children of a roundtrip span under `parent_span`
     (no-ops when parent_span/trace_client are None). Returns
     (status, response body); raises on connection errors and on any
     non-2xx status — redirects are never followed (a followed 301
-    would silently drop the forward body)."""
+    would silently drop the forward body).
+
+    `retry_policy` (reliability.policy.RetryPolicy) reruns the whole
+    attempt — DNS, connect, send, status check — with its backoff; each
+    attempt emits its own span chain, so retried forwards are visible as
+    repeated http.post spans rather than one long mystery gap."""
+    if retry_policy is None:
+        return _traced_post_once(url, body, headers, timeout, parent_span,
+                                 trace_client, action)
+    return retry_policy.run(
+        lambda: _traced_post_once(url, body, headers, timeout, parent_span,
+                                  trace_client, action))
+
+
+def _traced_post_once(url: str, body: bytes, headers: Dict[str, str],
+                      timeout: float, parent_span, trace_client,
+                      action: str) -> Tuple[int, bytes]:
+    # inside the retry loop so an armed `times=N` fault exhausts after N
+    # attempts — the recover-after-retries chaos scenario
+    FAULTS.inject(HTTP_POST, name=url)
     u = urlparse(url)
     host = u.hostname or ""
     tls = u.scheme == "https"

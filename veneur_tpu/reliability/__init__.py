@@ -1,0 +1,17 @@
+"""Shared resilience layer for every egress path.
+
+The reference bills veneur as distributed and fault-tolerant, yet its
+flush->sink and flush->forward paths are single-attempt: one failed POST
+drops an interval. This package holds the pieces that close that gap —
+pure-Python, injectable-clock, so everything is testable in virtual time:
+
+- policy:  RetryPolicy (exponential backoff + deterministic seeded
+           jitter) and CircuitBreaker (closed -> open -> half-open).
+- spill:   ForwardSpillBuffer — failed forwards keep their mergeable
+           sketch payloads and merge into the NEXT interval's forward
+           batch losslessly (t-digests merge, HLL registers fold with
+           max, counters add), instead of the reference's drop.
+- faults:  a process-global FaultInjector with named injection points in
+           the egress paths, so chaos tests force errors, latency, and
+           partial failures deterministically. Default no-op.
+"""

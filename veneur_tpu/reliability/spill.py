@@ -1,0 +1,105 @@
+"""Forward spill buffer: merge-on-retry instead of drop-on-failure.
+
+The Go reference drops a failed forward's payload — one gRPC error loses
+an interval of sketch state. Our forward payloads are MERGEABLE
+(metricpb.Metric: t-digest centroids merge, HLL registers fold with max,
+counters add — PAPERS.md, Dunning t-digests), so a failed forward can be
+held and merged into the NEXT interval's forward batch losslessly: the
+receiving global tier imports metric-by-metric and merges by key, so
+shipping interval N's sketches alongside interval N+1's reproduces the
+exact state a never-failed run would have built.
+
+The buffer is bounded by bytes and by age; when a cap is hit the OLDEST
+payloads drop first and every drop is counted — degradation is
+observable, never silent (veneur.forward.spill_bytes /
+veneur.forward.spill.dropped_total in self-telemetry).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from typing import Callable, List
+
+log = logging.getLogger("veneur_tpu.reliability.spill")
+
+
+class ForwardSpillBuffer:
+    """Holds forwardable metricpb.Metric payloads across failed intervals.
+
+    Thread-safe: forwards run on fire-and-forget aux threads and a slow
+    failing forward can overlap the next interval's.
+    """
+
+    def __init__(self, max_bytes: int, max_age_s: float = 60.0,
+                 clock: Callable[[], float] = time.time):
+        if max_bytes <= 0:
+            raise ValueError("max_bytes must be > 0")
+        self.max_bytes = int(max_bytes)
+        self.max_age_s = float(max_age_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._entries: deque = deque()   # (spilled_at, metric, nbytes)
+        self._bytes = 0
+        self.spilled_total = 0       # metrics ever spilled
+        self.dropped_capacity = 0    # metrics evicted by the byte cap
+        self.dropped_age = 0         # metrics expired by max_age_s
+
+    @property
+    def bytes(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    @property
+    def dropped_total(self) -> int:
+        with self._lock:
+            return self.dropped_capacity + self.dropped_age
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def add(self, metrics: List, now: float = None) -> None:
+        """Spill a failed forward's payload. Evicts oldest-first when the
+        byte cap is exceeded (a single over-cap payload evicts itself —
+        the cap is a hard bound, not a suggestion)."""
+        if not metrics:
+            return
+        now = self._clock() if now is None else now
+        with self._lock:
+            for m in metrics:
+                nb = m.ByteSize()
+                self._entries.append((now, m, nb))
+                self._bytes += nb
+                self.spilled_total += 1
+            evicted = 0
+            while self._bytes > self.max_bytes and self._entries:
+                _, _, nb = self._entries.popleft()
+                self._bytes -= nb
+                self.dropped_capacity += 1
+                evicted += 1
+        if evicted:
+            log.warning("forward spill over %d bytes: dropped %d oldest "
+                        "payloads", self.max_bytes, evicted)
+
+    def drain(self, now: float = None) -> List:
+        """Take everything still fresh for merging into the next forward
+        batch; expired payloads are dropped and counted. The buffer is
+        emptied either way — a re-failed send re-spills via add()."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            out, expired = [], 0
+            for spilled_at, m, _nb in self._entries:
+                if now - spilled_at > self.max_age_s:
+                    expired += 1
+                else:
+                    out.append(m)
+            self._entries.clear()
+            self._bytes = 0
+            self.dropped_age += expired
+        if expired:
+            log.warning("forward spill: dropped %d payloads older than "
+                        "%.0fs", expired, self.max_age_s)
+        return out

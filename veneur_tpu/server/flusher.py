@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import threading
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -116,10 +117,14 @@ class MetricFrame:
     # memoized intermetrics(): several materializing consumers (plugins,
     # object-only sinks via the base-class default) may share one frame —
     # each rebuilding ~per-metric objects would multiply the exact cost
-    # the frame exists to avoid. Benign race: concurrent builders produce
-    # equivalent lists, last write wins.
+    # the frame exists to avoid. Lock-guarded lazy init: the old "benign"
+    # race let N concurrent sink threads each pay the full materialization
+    # (and briefly hold N copies of a 10M-object list); now exactly one
+    # builds and the rest share it.
     _materialized: object = dataclasses.field(
         default=None, repr=False, compare=False)
+    _mat_lock: object = dataclasses.field(
+        default_factory=threading.Lock, repr=False, compare=False)
 
     def __len__(self):
         return sum(len(s.names) for s in self.segments)
@@ -143,13 +148,18 @@ class MetricFrame:
                        m.message if is_status else "", p[0], p[1], p[2])
 
     def intermetrics(self) -> List[InterMetric]:
+        # double-checked: the unlocked read is safe (attribute store is a
+        # single bytecode under the GIL) and keeps the post-build hot path
+        # lock-free
         if self._materialized is None:
-            ts = self.timestamp
-            self._materialized = [
-                InterMetric(name, ts, value, tags, mtype, message,
-                            host, sinks)
-                for name, value, mtype, message, tags, sinks, host
-                in self.rows()]
+            with self._mat_lock:
+                if self._materialized is None:
+                    ts = self.timestamp
+                    self._materialized = [
+                        InterMetric(name, ts, value, tags, mtype, message,
+                                    host, sinks)
+                        for name, value, mtype, message, tags, sinks, host
+                        in self.rows()]
         return self._materialized
 
 

@@ -29,8 +29,12 @@ from typing import List, Optional
 from veneur_tpu.aggregation.host import BatchSpec
 from veneur_tpu.aggregation.state import TableSpec
 from veneur_tpu.config import Config
+from veneur_tpu.reliability.faults import FAULTS, FLUSH_WORKER
+from veneur_tpu.reliability.policy import (CircuitBreaker, CircuitOpenError,
+                                           RetryPolicy)
 from veneur_tpu.samplers import parser, ssf_samples
 from veneur_tpu.samplers.intermetric import InterMetric
+from veneur_tpu.sinks.base import ResilientSink, dispatch_flush
 from veneur_tpu.trace.client import report_one
 from veneur_tpu.server.aggregator import Aggregator
 from veneur_tpu.server.flusher import generate_intermetrics
@@ -259,9 +263,52 @@ class Server:
         # sink.* conventions (an untagged total can't say WHICH sink)
         self._sink_flush_errors: dict = {}
         self.forward_errors = 0
+        # completed forward sends (same lock discipline as forward_errors:
+        # overlapping aux-thread forwards make += lossy)
+        self.forward_sends_total = 0
         # (duration_ns, n_metrics) per forward POST, success or failure;
         # guarded by _sink_stats_lock with the other flush telemetry
         self._forward_stats: list = []
+
+        # -- resilience layer (veneur_tpu/reliability/) -------------------
+        # All knobs default off: no policy, no breakers, no spill — every
+        # egress path keeps the reference's single-attempt drop-on-failure
+        # behavior byte for byte.
+        from veneur_tpu.utils.hashing import fnv1a_64
+        self.retry_policy = None
+        if cfg.sink_retry_max > 0:
+            # hostname-derived seed: deterministic per instance, but a
+            # fleet's retry storms decorrelate across hosts
+            self.retry_policy = RetryPolicy(
+                max_retries=cfg.sink_retry_max,
+                base_ms=cfg.sink_retry_base_ms,
+                seed=fnv1a_64(cfg.hostname.encode()))
+        # one breaker per sink INSTANCE, shared between the fan-out gate
+        # and the sink's own ResilientSink harness so veneur.circuit.state
+        # reads a single state machine per destination
+        self._sink_breakers: dict = {}      # id(sink) -> CircuitBreaker
+        self._forward_breaker = None
+        if cfg.circuit_failure_threshold > 0:
+            for s in self.metric_sinks + self.span_sinks:
+                self._sink_breakers[id(s)] = CircuitBreaker(
+                    cfg.circuit_failure_threshold, cfg.circuit_cooldown_s)
+            if cfg.is_local and cfg.forward_address:
+                self._forward_breaker = CircuitBreaker(
+                    cfg.circuit_failure_threshold, cfg.circuit_cooldown_s)
+        if self.retry_policy is not None or self._sink_breakers:
+            for s in self.metric_sinks + self.span_sinks:
+                if isinstance(s, ResilientSink):
+                    s.configure_resilience(self.retry_policy,
+                                           self._sink_breakers.get(id(s)))
+        self.forward_spill = None
+        if cfg.forward_spill_max_bytes > 0:
+            from veneur_tpu.reliability.spill import ForwardSpillBuffer
+            self.forward_spill = ForwardSpillBuffer(
+                cfg.forward_spill_max_bytes, cfg.forward_spill_max_age_s)
+        # fan-out retry counts per sink (plain sinks only; ResilientSink
+        # sinks count their own) + forward retries, under _sink_stats_lock
+        self._fanout_retries: dict = {}
+        self.forward_retries_total = 0
         self._packets_received = 0
         self._packets_dropped_py = 0
         self._packets_toolong_py = 0
@@ -744,6 +791,13 @@ class Server:
 
     def start(self):
         """reference server.go:771 Start + networking.go:19 StartStatsd."""
+        # chaos: env overrides config; both use the reliability/faults.py
+        # spec grammar. Armed BEFORE any listener or flush thread exists
+        # so the very first interval can be faulted.
+        fault_spec = (os.environ.get("VENEUR_FAULT_INJECTION", "")
+                      or self.cfg.fault_injection)
+        if fault_spec:
+            FAULTS.configure(fault_spec)
         if self.cfg.sentry_dsn:
             from veneur_tpu.utils import crash
             crash.setup(self.cfg.sentry_dsn)
@@ -935,12 +989,19 @@ class Server:
             addr = self.cfg.forward_address
             is_http = addr.startswith(("http://", "https://"))
             if is_http and not self.cfg.forward_use_grpc:
+                # no retry_policy here: _send_forward wraps BOTH client
+                # kinds uniformly (and counts retries); the client-level
+                # hook stays for embedders driving the client directly
                 self._forward_client = HTTPForwardClient(addr)
             else:
                 for prefix in ("http://", "https://", "grpc://", "tcp://"):
                     if addr.startswith(prefix):
                         addr = addr[len(prefix):]
-                self._forward_client = ForwardClient(addr)
+                # with retries configured, queue RPCs while the channel
+                # (re)connects instead of failing fast — a reconnect after
+                # UNAVAILABLE then succeeds within the same flush
+                self._forward_client = ForwardClient(
+                    addr, wait_for_ready=self.cfg.sink_retry_max > 0)
         self._redact_secrets()
 
     _SECRET_FIELDS = (
@@ -1047,6 +1108,9 @@ class Server:
                 req.finish(ok, detail)
 
     def _do_flush(self, state, table, stats, swapped_at):
+        # chaos hook: a fault here exercises the failed-flush containment
+        # in _flush_worker (state already swapped; next interval clean)
+        FAULTS.inject(FLUSH_WORKER)
         flush_t0 = time.perf_counter()
         # stamp with the interval's swap time, not the job's run time — a
         # queued interval must not shift into the next time bucket
@@ -1131,7 +1195,10 @@ class Server:
                 # so id() is stable)
                 prev = self._sink_threads.get(id(s))
                 if prev is not None and prev.is_alive():
-                    self.sink_flushes_skipped += 1
+                    # under _sink_stats_lock now that breaker skips bump
+                    # the same counter from sink threads
+                    with self._sink_stats_lock:
+                        self.sink_flushes_skipped += 1
                     log.warning("sink %s: previous flush still running; "
                                 "skipping this interval", s.name)
                     continue
@@ -1218,6 +1285,10 @@ class Server:
                "veneur.flush.intervals_deferred_total":
                    stats["intervals_deferred"],
                "veneur.flush.sink_flushes_skipped_total":
+                   stats.get("sink_flushes_skipped", 0),
+               # the short alias the fault-tolerance docs use; same
+               # counter (slow-sink containment + breaker refusals)
+               "veneur.flush.skipped_total":
                    stats.get("sink_flushes_skipped", 0),
                "veneur.spans_received_total": stats["spans_received"],
                "veneur.worker.span.hit_chan_cap":
@@ -1310,6 +1381,43 @@ class Server:
             samples.append(ssf_samples.timing(
                 "veneur.sink.metric_flush_total_duration_ns", total_ns / 1e9,
                 tags))
+        # resilience telemetry: retry counts (fan-out + each sink's own
+        # harness + forward), breaker state gauges, spill occupancy —
+        # all deltas vs _last_stats so an idle configuration emits nothing
+        retries = {}
+        with self._sink_stats_lock:
+            for name, n in self._fanout_retries.items():
+                retries[name] = retries.get(name, 0) + n
+            if self.forward_retries_total:
+                retries["forward"] = self.forward_retries_total
+        for s in self.metric_sinks + self.span_sinks:
+            own = getattr(s, "retries_total", 0)
+            if own:
+                retries[s.name] = retries.get(s.name, 0) + own
+        for name, total in sorted(retries.items()):
+            key = f"veneur.sink.retries_total|{name}"
+            delta = total - self._last_stats.get(key, 0)
+            self._last_stats[key] = total
+            if delta:
+                samples.append(ssf_samples.count(
+                    "veneur.sink.retries_total", delta, {"sink": name}))
+        breakers = [(s.name, self._sink_breakers[id(s)])
+                    for s in self.metric_sinks + self.span_sinks
+                    if id(s) in self._sink_breakers]
+        if self._forward_breaker is not None:
+            breakers.append(("forward", self._forward_breaker))
+        for name, breaker in breakers:
+            samples.append(ssf_samples.gauge(
+                "veneur.circuit.state", float(breaker.state),
+                {"sink": name}))
+        if self.forward_spill is not None:
+            samples.append(ssf_samples.gauge(
+                "veneur.forward.spill_bytes",
+                float(self.forward_spill.bytes)))
+            cur["veneur.forward.spill.spilled_total"] = \
+                self.forward_spill.spilled_total
+            cur["veneur.forward.spill.dropped_total"] = \
+                self.forward_spill.dropped_total
         for name, total in cur.items():
             delta = total - self._last_stats.get(name, 0)
             self._last_stats[name] = total
@@ -1388,16 +1496,38 @@ class Server:
         from veneur_tpu.forward.convert import export_metrics
         t0 = time.perf_counter_ns()
         n_metrics = 0
+        metrics = []
         try:
             metrics = export_metrics(
                 raw, table, compression=self.aggregator.spec.compression,
                 hll_precision=self.aggregator.spec.hll_precision)
+            if self.forward_spill is not None:
+                # payloads spilled by failed intervals ride ahead of this
+                # interval's batch; the global tier merges by key, so the
+                # combined import equals what a never-failed run built
+                spilled = self.forward_spill.drain()
+                if spilled:
+                    log.info("forward: merging %d spilled payloads into "
+                             "this batch", len(spilled))
+                    metrics = spilled + metrics
             n_metrics = len(metrics)
             if metrics:
-                self._forward_client.send_metrics(
-                    metrics, timeout=self.interval, parent_span=span,
-                    trace_client=self.trace_client)
+                if (self._forward_breaker is not None
+                        and not self._forward_breaker.allow()):
+                    raise CircuitOpenError("forward: circuit open")
+                self._send_forward(metrics, span)
+                if self._forward_breaker is not None:
+                    self._forward_breaker.record_success()
+                with self._reader_fold_lock:
+                    self.forward_sends_total += 1
         except Exception as e:
+            if (self._forward_breaker is not None
+                    and not isinstance(e, CircuitOpenError)):
+                self._forward_breaker.record_failure()
+            if self.forward_spill is not None and metrics:
+                # keep the interval's (and any re-failed spilled) sketches
+                # for the next attempt instead of dropping them
+                self.forward_spill.add(metrics)
             # concurrent forwards (one aux thread per interval; a slow
             # failure can overlap the next interval's) make += lossy —
             # serialize the counter under the existing fold lock
@@ -1417,18 +1547,73 @@ class Server:
                 self._forward_stats.append(
                     (time.perf_counter_ns() - t0, n_metrics))
 
+    def _send_forward(self, metrics, span) -> None:
+        """One forward send under the retry policy. The HTTP client
+        carries the policy itself (each attempt re-runs the whole
+        traced_post pipeline), so only wrap clients without one — a
+        double wrap would square the attempt count."""
+
+        def once():
+            self._forward_client.send_metrics(
+                metrics, timeout=self.interval, parent_span=span,
+                trace_client=self.trace_client)
+
+        if (self.retry_policy is None
+                or getattr(self._forward_client, "retry_policy", None)
+                is not None):
+            once()
+            return
+
+        def on_retry(attempt, exc, delay):
+            with self._sink_stats_lock:
+                self.forward_retries_total += 1
+            log.warning("forward attempt %d failed: %s; retrying in "
+                        "%.3fs", attempt + 1, exc, delay)
+
+        self.retry_policy.run(once, on_retry=on_retry)
+
     def _flush_sink(self, sink, metrics, parent=None):
         """metrics is a List[InterMetric] or a flusher.MetricFrame —
-        frames only reach sinks that declared accepts_frames."""
+        frames only reach sinks that declared accepts_frames.
+
+        Resilience split: a sink with its OWN configured harness
+        (ResilientSink) retries and records breaker outcomes per network
+        call internally, so the fan-out must neither gate on the shared
+        breaker (it would consume the half-open probe the sink's own
+        allow() then misses) nor wrap the flush in a second retry loop
+        (attempts would multiply). Plain sinks get whole-flush retry and
+        breaker accounting here."""
+        # ResilientSink KafkaSpanSink etc. live in span_sinks; only
+        # metric sinks reach this fan-out, but check the type anyway
+        own = (isinstance(sink, ResilientSink)
+               and sink.resilience_configured)
+        breaker = self._sink_breakers.get(id(sink))
+        if not own and breaker is not None and not breaker.allow():
+            with self._sink_stats_lock:
+                self.sink_flushes_skipped += 1
+            log.warning("sink %s: circuit %s; skipping this interval",
+                        sink.name, breaker.state_name)
+            return
         span = parent.child(f"flush.sink.{sink.name}") if parent else None
         t0 = time.perf_counter_ns()
         ok = True
         try:
-            from veneur_tpu.server.flusher import MetricFrame
-            if isinstance(metrics, MetricFrame):
-                sink.flush_frame(metrics)
+            if own or self.retry_policy is None:
+                dispatch_flush(sink, metrics)
             else:
-                sink.flush(metrics)
+                def on_retry(attempt, exc, delay):
+                    with self._sink_stats_lock:
+                        self._fanout_retries[sink.name] = (
+                            self._fanout_retries.get(sink.name, 0) + 1)
+                    log.warning("sink %s flush attempt %d failed: %s; "
+                                "retrying in %.3fs", sink.name,
+                                attempt + 1, exc, delay)
+
+                self.retry_policy.run(
+                    lambda: dispatch_flush(sink, metrics),
+                    on_retry=on_retry)
+            if not own and breaker is not None:
+                breaker.record_success()
         except Exception as e:
             ok = False
             if span is not None:
@@ -1436,6 +1621,8 @@ class Server:
             with self._sink_stats_lock:
                 self._sink_flush_errors[sink.name] = (
                     self._sink_flush_errors.get(sink.name, 0) + 1)
+            if not own and breaker is not None:
+                breaker.record_failure()
             log.warning("sink %s flush failed: %s", sink.name, e)
         finally:
             # the centrally-measured sink.* conventions
@@ -1581,5 +1768,7 @@ class Server:
         try:
             import jax
             jax.block_until_ready(self.aggregator.state)
-        except Exception:
-            pass
+        except Exception as e:
+            # best-effort quiesce: a torn-down backend raising here is
+            # expected during interpreter exit, but say so
+            log.debug("final device quiesce skipped: %s", e)

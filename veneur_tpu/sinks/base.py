@@ -8,9 +8,15 @@ exclusion (SetExcludedTags) is wired from `tags_exclude` with the
 
 from __future__ import annotations
 
-from typing import Iterable, List
+import logging
+import threading
+from typing import Callable, Iterable, List, Optional
 
+from veneur_tpu.reliability.faults import FAULTS, SINK_FLUSH
+from veneur_tpu.reliability.policy import CircuitOpenError
 from veneur_tpu.samplers.intermetric import InterMetric
+
+log = logging.getLogger("veneur_tpu.sinks")
 
 
 class MetricSink:
@@ -60,3 +66,83 @@ class SpanSink:
 def filter_acceptable(metrics: List[InterMetric], sink_name: str):
     """reference sinks/sinks.go:51 IsAcceptableMetric applied batch-wise."""
     return [m for m in metrics if m.is_acceptable_to(sink_name)]
+
+
+def dispatch_flush(sink, payload) -> None:
+    """THE flush dispatch every fan-out path goes through: the `sink.flush`
+    fault-injection point, then frame-vs-list routing. Keeping it here (not
+    in server.py) means chaos tests hit the same seam any embedding does."""
+    FAULTS.inject(SINK_FLUSH, name=sink.name)
+    from veneur_tpu.server.flusher import MetricFrame
+    if isinstance(payload, MetricFrame):
+        sink.flush_frame(payload)
+    else:
+        sink.flush(payload)
+
+
+class ResilientSink:
+    """Mixin giving egress sinks (Datadog/SignalFx/Splunk/Kafka) a shared
+    retry/breaker harness around their individual network calls.
+
+    Unconfigured (the default), resilient_post() is a bare passthrough —
+    today's single-attempt behavior, byte for byte. The server wires
+    configure_resilience() from the sink_retry_* / circuit_* config keys;
+    retrying HERE (per POST/produce) rather than around the whole flush
+    avoids re-serializing and re-sending chunks that already landed.
+
+    When a sink handles its own retries this way, the server fan-out does
+    NOT wrap its flush in a second retry loop (resilience_configured is
+    the signal) — otherwise errors would multiply attempts.
+    """
+
+    retry_policy = None
+    breaker = None
+    retries_total = 0        # drained by server self-telemetry per interval
+    posts_skipped_open = 0   # refused by an open breaker
+
+    def configure_resilience(self, policy, breaker=None) -> None:
+        self.retry_policy = policy
+        self.breaker = breaker
+        self._resilience_lock = threading.Lock()
+        self.retries_total = 0
+        self.posts_skipped_open = 0
+
+    @property
+    def resilience_configured(self) -> bool:
+        return self.retry_policy is not None or self.breaker is not None
+
+    def resilient_post(self, fn: Callable, what: str = ""):
+        """Run one network call under the sink's policy/breaker. Terminal
+        failure re-raises — call sites keep their existing log-and-continue
+        (or raise) semantics unchanged."""
+        breaker = self.breaker
+        if breaker is not None and not breaker.allow():
+            with self._resilience_lock:
+                self.posts_skipped_open += 1
+            raise CircuitOpenError(
+                f"{getattr(self, 'name', 'sink')} {what}: circuit open")
+        policy = self.retry_policy
+        if policy is None:
+            try:
+                return fn()
+            except Exception:
+                if breaker is not None:
+                    breaker.record_failure()
+                raise
+        name = getattr(self, "name", "sink")
+
+        def on_retry(attempt, exc, delay):
+            with self._resilience_lock:
+                self.retries_total += 1
+            log.warning("sink %s %s attempt %d failed: %s; retrying in "
+                        "%.3fs", name, what, attempt + 1, exc, delay)
+
+        try:
+            result = policy.run(fn, on_retry=on_retry)
+        except Exception:
+            if breaker is not None:
+                breaker.record_failure()
+            raise
+        if breaker is not None:
+            breaker.record_success()
+        return result

@@ -18,12 +18,13 @@ import zlib
 from typing import List
 
 from veneur_tpu.samplers.intermetric import COUNTER, STATUS, InterMetric
-from veneur_tpu.sinks.base import MetricSink, filter_acceptable
+from veneur_tpu.sinks.base import (MetricSink, ResilientSink,
+                                   filter_acceptable)
 
 log = logging.getLogger("veneur_tpu.sinks.datadog")
 
 
-class DatadogMetricSink(MetricSink):
+class DatadogMetricSink(ResilientSink, MetricSink):
     name = "datadog"
 
     def __init__(self, api_key: str, hostname: str, api_url: str,
@@ -133,17 +134,22 @@ class DatadogMetricSink(MetricSink):
         self._post_checks(checks)
 
     def _post_json(self, path, payload, what):
-        """The one deflate-JSON POST used by series, checks and events;
-        errors are logged, never fatal."""
+        """The one deflate-JSON POST used by series, checks and events,
+        run under the sink's retry/breaker harness (a passthrough when
+        unconfigured); terminal errors are logged, never fatal."""
         url = f"{self.api_url}{path}?api_key={self.api_key}"
         req = urllib.request.Request(
             url, data=zlib.compress(json.dumps(payload).encode()),
             method="POST",
             headers={"Content-Type": "application/json",
                      "Content-Encoding": "deflate"})
-        try:
+
+        def once():
             with urllib.request.urlopen(req, timeout=10) as resp:
                 resp.read()
+
+        try:
+            self.resilient_post(once, what=what)
         except Exception as e:
             log.error("datadog %s flush failed: %s", what, e)
 

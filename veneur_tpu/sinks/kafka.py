@@ -19,7 +19,8 @@ import logging
 import math
 from typing import Callable, Optional
 
-from veneur_tpu.sinks.base import MetricSink, SpanSink, filter_acceptable
+from veneur_tpu.sinks.base import (MetricSink, ResilientSink, SpanSink,
+                                   filter_acceptable)
 
 log = logging.getLogger("veneur_tpu.sinks.kafka")
 
@@ -48,7 +49,7 @@ def _default_producer(broker: str) -> Callable:
             "injected producer callable")
 
 
-class KafkaMetricSink(MetricSink):
+class KafkaMetricSink(ResilientSink, MetricSink):
     name = "kafka"
 
     def __init__(self, broker: str, metric_topic: str,
@@ -87,13 +88,15 @@ class KafkaMetricSink(MetricSink):
                           if m.sinks is not None else None),
             }).encode()
             try:
-                self.produce(topic, m.name.encode(), value)
+                self.resilient_post(
+                    lambda: self.produce(topic, m.name.encode(), value),
+                    what="produce")
                 self.flushed += 1
             except Exception as e:
                 log.error("kafka produce failed: %s", e)
 
 
-class KafkaSpanSink(SpanSink):
+class KafkaSpanSink(ResilientSink, SpanSink):
     name = "kafka"
 
     def __init__(self, broker: str, span_topic: str,
@@ -133,7 +136,9 @@ class KafkaSpanSink(SpanSink):
         else:
             value = span.SerializeToString()
         try:
-            self.produce(self.span_topic, key, value)
+            self.resilient_post(
+                lambda: self.produce(self.span_topic, key, value),
+                what="produce")
             self.sent += 1
         except Exception as e:
             log.error("kafka span produce failed: %s", e)

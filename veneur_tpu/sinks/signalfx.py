@@ -21,7 +21,8 @@ from typing import Dict, List
 
 from veneur_tpu.samplers.intermetric import (
     COUNTER, SINK_ONLY_TAG_PREFIX, InterMetric)
-from veneur_tpu.sinks.base import MetricSink, filter_acceptable
+from veneur_tpu.sinks.base import (MetricSink, ResilientSink,
+                                   filter_acceptable)
 
 # the dimension KEY the routing tag produces ("veneursinkonly:x" and the
 # bare "veneursinkonly" both partition to this)
@@ -36,7 +37,7 @@ _TOKEN_PAGE_LIMIT = 200
 log = logging.getLogger("veneur_tpu.sinks.signalfx")
 
 
-class SignalFxMetricSink(MetricSink):
+class SignalFxMetricSink(ResilientSink, MetricSink):
     name = "signalfx"
 
     def __init__(self, api_key: str, endpoint: str, hostname: str,
@@ -224,9 +225,13 @@ class SignalFxMetricSink(MetricSink):
             data=json.dumps(events).encode(), method="POST",
             headers={"Content-Type": "application/json",
                      "X-SF-Token": self.api_key})
-        try:
+
+        def once():
             with urllib.request.urlopen(req, timeout=10) as resp:
                 resp.read()
+
+        try:
+            self.resilient_post(once, what="event")
         except Exception as e:
             log.error("signalfx event flush failed: %s", e)
 
@@ -273,8 +278,12 @@ class SignalFxMetricSink(MetricSink):
             data=json.dumps(body).encode(), method="POST",
             headers={"Content-Type": "application/json",
                      "X-SF-Token": token})
-        try:
+
+        def once():
             with urllib.request.urlopen(req, timeout=10) as resp:
                 resp.read()
+
+        try:
+            self.resilient_post(once, what="datapoint")
         except Exception as e:
             log.error("signalfx flush failed: %s", e)

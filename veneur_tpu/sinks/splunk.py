@@ -32,14 +32,14 @@ import time
 import urllib.request
 from typing import List
 
-from veneur_tpu.sinks.base import SpanSink
+from veneur_tpu.sinks.base import ResilientSink, SpanSink
 
 log = logging.getLogger("veneur_tpu.sinks.splunk")
 
 _now = time.monotonic
 
 
-class SplunkSpanSink(SpanSink):
+class SplunkSpanSink(ResilientSink, SpanSink):
     name = "splunk"
 
     def __init__(self, hec_address: str, token: str, hostname: str,
@@ -240,7 +240,8 @@ class SplunkSpanSink(SpanSink):
         body = "\n".join(json.dumps(e) for e in batch).encode()
         headers = {"Authorization": f"Splunk {self.token}",
                    "Content-Type": "application/json"}
-        try:
+
+        def once():
             if self._pinned_hostname:
                 self._post_pinned(body, headers)
             else:
@@ -249,6 +250,9 @@ class SplunkSpanSink(SpanSink):
                 with urllib.request.urlopen(
                         req, timeout=self.send_timeout) as resp:
                     resp.read()
+
+        try:
+            self.resilient_post(once, what="hec")
             self.submitted += len(batch)
         except Exception as e:
             log.error("splunk HEC submit failed: %s", e)
