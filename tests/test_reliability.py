@@ -174,7 +174,7 @@ def test_spill_roundtrip_and_byte_cap():
     assert buf.bytes == 200
     assert buf.dropped_capacity == 1
     drained = buf.drain()
-    assert [m.name for m in drained] == ["b", "c"]
+    assert [m.name for _, m in drained] == ["b", "c"]
     assert buf.bytes == 0 and len(buf) == 0
     assert buf.spilled_total == 3 and buf.dropped_total == 1
 
@@ -186,9 +186,38 @@ def test_spill_age_expiry():
     clock.t += 61.0
     buf.add([FakeMetric("fresh")])
     drained = buf.drain()
-    assert [m.name for m in drained] == ["fresh"]
+    assert [m.name for _, m in drained] == ["fresh"]
     assert buf.dropped_age == 1
     assert buf.dropped_total == 1
+
+
+def test_spill_readd_preserves_original_timestamps():
+    """A re-failed send must NOT reset a payload's age: max_age_s bounds
+    staleness since the FIRST failure, so during an outage longer than
+    max_age_s the drain/readd cycle still expires old payloads instead
+    of restamping them forever."""
+    clock = VirtualClock()
+    buf = ForwardSpillBuffer(max_bytes=10_000, max_age_s=60.0, clock=clock)
+    buf.add([FakeMetric("old")])
+    # three failed retry cycles, 25s apart: each drain returns the entry
+    # still stamped t=0, and readd keeps that stamp
+    for _ in range(2):
+        clock.t += 25.0
+        entries = buf.drain()
+        assert [(ts, m.name) for ts, m in entries] == [(0.0, "old")]
+        buf.readd(entries)
+    clock.t += 25.0                  # now 75s past the original spill
+    assert buf.drain() == []
+    assert buf.dropped_age == 1
+    assert buf.spilled_total == 1    # readd never re-counts
+    # readd still enforces the byte cap, oldest-first
+    buf.add([FakeMetric("a"), FakeMetric("b", nbytes=9_900)])
+    entries = buf.drain()
+    buf.readd(entries)
+    assert buf.dropped_capacity == 0 and len(buf) == 2
+    buf.add([FakeMetric("c", nbytes=50)])
+    assert buf.dropped_capacity == 1
+    assert [m.name for _, m in buf.drain()] == ["b", "c"]
 
 
 def test_spill_rejects_nonpositive_cap():
@@ -286,6 +315,70 @@ def test_resilient_post_retries_and_records_breaker():
     with pytest.raises(CircuitOpenError):
         s.resilient_post(dead)
     assert s.posts_skipped_open == 1
+
+
+def test_resilient_post_breaker_only_success_resets():
+    """circuit_failure_threshold > 0 with sink_retry_max = 0 — the combo
+    server.py wires with retries disabled. Success must still reach
+    record_success(): sporadic non-consecutive failures may not
+    accumulate into a trip, and a successful half-open probe must close
+    the breaker (not wedge it half-open forever)."""
+    from veneur_tpu.sinks.base import ResilientSink
+
+    clock = VirtualClock()
+    s = ResilientSink()
+    s.configure_resilience(
+        None, CircuitBreaker(failure_threshold=2, cooldown_s=30.0,
+                             clock=clock))
+    assert s.resilience_configured
+
+    def dead():
+        raise OSError("down")
+
+    # alternating fail/success never trips: success resets the streak
+    for _ in range(3):
+        with pytest.raises(OSError):
+            s.resilient_post(dead)
+        assert s.resilient_post(lambda: "sent") == "sent"
+        assert s.breaker.state == CLOSED
+
+    # trip it, cool down, then a SUCCESSFUL probe must close the
+    # circuit and allow the very next post through
+    for _ in range(2):
+        with pytest.raises(OSError):
+            s.resilient_post(dead)
+    assert s.breaker.state == OPEN
+    clock.t += 30.0
+    assert s.resilient_post(lambda: "probe") == "probe"
+    assert s.breaker.state == CLOSED
+    assert s.resilient_post(lambda: "next") == "next"
+    assert s.retries_total == 0      # no policy -> never retried
+
+
+def test_kafka_flush_short_circuits_on_open_breaker():
+    """Once the breaker opens mid-batch, the rest of the batch is
+    skipped with ONE log line — not one CircuitOpenError per message."""
+    from veneur_tpu.samplers.intermetric import InterMetric
+    from veneur_tpu.sinks.kafka import KafkaMetricSink
+
+    calls = []
+
+    def producer(topic, key, value):
+        calls.append(key)
+        raise OSError("broker down")
+
+    sink = KafkaMetricSink("b:9092", "metrics", producer=producer)
+    sink.configure_resilience(
+        None, CircuitBreaker(failure_threshold=2, cooldown_s=30.0,
+                             clock=VirtualClock()))
+    metrics = [InterMetric(name=f"m{i}", timestamp=1, value=1.0,
+                           tags=[], type="gauge") for i in range(50)]
+    sink.flush(metrics)
+    # two failures trip the breaker; the 48 remaining messages are
+    # refused once collectively, not attempted/logged individually
+    assert len(calls) == 2
+    assert sink.posts_skipped_open == 1
+    assert sink.flushed == 0
 
 
 # -- spill-merge acceptance: outage == no outage ------------------------------

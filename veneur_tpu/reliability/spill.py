@@ -62,32 +62,56 @@ class ForwardSpillBuffer:
             return len(self._entries)
 
     def add(self, metrics: List, now: float = None) -> None:
-        """Spill a failed forward's payload. Evicts oldest-first when the
-        byte cap is exceeded (a single over-cap payload evicts itself —
-        the cap is a hard bound, not a suggestion)."""
+        """Spill a failed forward's payload, stamped with the CURRENT
+        clock. Evicts oldest-first when the byte cap is exceeded (a
+        single over-cap payload evicts itself — the cap is a hard bound,
+        not a suggestion)."""
         if not metrics:
             return
         now = self._clock() if now is None else now
         with self._lock:
-            for m in metrics:
-                nb = m.ByteSize()
-                self._entries.append((now, m, nb))
-                self._bytes += nb
-                self.spilled_total += 1
-            evicted = 0
-            while self._bytes > self.max_bytes and self._entries:
-                _, _, nb = self._entries.popleft()
-                self._bytes -= nb
-                self.dropped_capacity += 1
-                evicted += 1
+            self.spilled_total += len(metrics)
+            evicted = self._extend_locked(
+                (now, m, m.ByteSize()) for m in metrics)
         if evicted:
             log.warning("forward spill over %d bytes: dropped %d oldest "
                         "payloads", self.max_bytes, evicted)
 
+    def readd(self, entries: List) -> None:
+        """Return previously drained (spilled_at, metric) entries after a
+        re-failed send, keeping their ORIGINAL spill timestamps — so
+        max_age_s bounds total staleness since the first failure, not
+        time since the last retry. Re-adds are not re-counted in
+        spilled_total."""
+        if not entries:
+            return
+        with self._lock:
+            evicted = self._extend_locked(
+                (ts, m, m.ByteSize()) for ts, m in entries)
+        if evicted:
+            log.warning("forward spill over %d bytes: dropped %d oldest "
+                        "payloads", self.max_bytes, evicted)
+
+    def _extend_locked(self, triples) -> int:
+        """Append (spilled_at, metric, nbytes) triples and enforce the
+        byte cap; returns the evicted count. Caller holds the lock and
+        must keep appends time-ordered (oldest entries re-add first)."""
+        for t in triples:
+            self._entries.append(t)
+            self._bytes += t[2]
+        evicted = 0
+        while self._bytes > self.max_bytes and self._entries:
+            _, _, nb = self._entries.popleft()
+            self._bytes -= nb
+            self.dropped_capacity += 1
+            evicted += 1
+        return evicted
+
     def drain(self, now: float = None) -> List:
-        """Take everything still fresh for merging into the next forward
-        batch; expired payloads are dropped and counted. The buffer is
-        emptied either way — a re-failed send re-spills via add()."""
+        """Take everything still fresh as (spilled_at, metric) pairs for
+        merging into the next forward batch; expired payloads are dropped
+        and counted. The buffer is emptied either way — a re-failed send
+        returns the pairs via readd(), preserving their timestamps."""
         now = self._clock() if now is None else now
         with self._lock:
             out, expired = [], 0
@@ -95,7 +119,7 @@ class ForwardSpillBuffer:
                 if now - spilled_at > self.max_age_s:
                     expired += 1
                 else:
-                    out.append(m)
+                    out.append((spilled_at, m))
             self._entries.clear()
             self._bytes = 0
             self.dropped_age += expired

@@ -1496,38 +1496,50 @@ class Server:
         from veneur_tpu.forward.convert import export_metrics
         t0 = time.perf_counter_ns()
         n_metrics = 0
-        metrics = []
+        fresh = []
+        spilled = []
         try:
-            metrics = export_metrics(
+            fresh = export_metrics(
                 raw, table, compression=self.aggregator.spec.compression,
                 hll_precision=self.aggregator.spec.hll_precision)
-            if self.forward_spill is not None:
-                # payloads spilled by failed intervals ride ahead of this
-                # interval's batch; the global tier merges by key, so the
-                # combined import equals what a never-failed run built
-                spilled = self.forward_spill.drain()
-                if spilled:
-                    log.info("forward: merging %d spilled payloads into "
-                             "this batch", len(spilled))
-                    metrics = spilled + metrics
-            n_metrics = len(metrics)
-            if metrics:
+            n_metrics = len(fresh)
+            if fresh or (self.forward_spill is not None
+                         and len(self.forward_spill)):
+                # breaker gate BEFORE the spill drain: while the circuit
+                # is open, buffered payloads stay put (no per-interval
+                # drain/re-spill churn) and only this interval's fresh
+                # batch joins them in the except arm below
                 if (self._forward_breaker is not None
                         and not self._forward_breaker.allow()):
                     raise CircuitOpenError("forward: circuit open")
-                self._send_forward(metrics, span)
-                if self._forward_breaker is not None:
-                    self._forward_breaker.record_success()
-                with self._reader_fold_lock:
-                    self.forward_sends_total += 1
+                if self.forward_spill is not None:
+                    # (spilled_at, metric) pairs from failed intervals
+                    # ride ahead of this interval's batch; the global
+                    # tier merges by key, so the combined import equals
+                    # what a never-failed run built
+                    spilled = self.forward_spill.drain()
+                    if spilled:
+                        log.info("forward: merging %d spilled payloads "
+                                 "into this batch", len(spilled))
+                metrics = [m for _, m in spilled] + fresh
+                n_metrics = len(metrics)
+                if metrics:
+                    self._send_forward(metrics, span)
+                    if self._forward_breaker is not None:
+                        self._forward_breaker.record_success()
+                    with self._reader_fold_lock:
+                        self.forward_sends_total += 1
         except Exception as e:
             if (self._forward_breaker is not None
                     and not isinstance(e, CircuitOpenError)):
                 self._forward_breaker.record_failure()
-            if self.forward_spill is not None and metrics:
-                # keep the interval's (and any re-failed spilled) sketches
-                # for the next attempt instead of dropping them
-                self.forward_spill.add(metrics)
+            if self.forward_spill is not None:
+                # keep the sketches for the next attempt instead of
+                # dropping them; re-failed spilled entries keep their
+                # ORIGINAL timestamps (readd first — they are oldest)
+                # so max_age_s bounds total staleness
+                self.forward_spill.readd(spilled)
+                self.forward_spill.add(fresh)
             # concurrent forwards (one aux thread per interval; a slow
             # failure can overlap the next interval's) make += lossy —
             # serialize the counter under the existing fold lock

@@ -124,11 +124,18 @@ class ResilientSink:
         policy = self.retry_policy
         if policy is None:
             try:
-                return fn()
+                result = fn()
             except Exception:
                 if breaker is not None:
                     breaker.record_failure()
                 raise
+            # success must reset the breaker even without a retry policy:
+            # otherwise sporadic (non-consecutive) failures accumulate to
+            # a spurious trip, and a successful half-open probe would
+            # leave _probe_in_flight set — wedging the breaker half-open
+            if breaker is not None:
+                breaker.record_success()
+            return result
         name = getattr(self, "name", "sink")
 
         def on_retry(attempt, exc, delay):

@@ -19,6 +19,7 @@ import logging
 import math
 from typing import Callable, Optional
 
+from veneur_tpu.reliability.policy import CircuitOpenError
 from veneur_tpu.sinks.base import (MetricSink, ResilientSink, SpanSink,
                                    filter_acceptable)
 
@@ -92,6 +93,11 @@ class KafkaMetricSink(ResilientSink, MetricSink):
                     lambda: self.produce(topic, m.name.encode(), value),
                     what="produce")
                 self.flushed += 1
+            except CircuitOpenError as e:
+                # the breaker refuses every remaining message in the
+                # batch too — one warning, not thousands of error lines
+                log.warning("kafka: %s; skipping rest of batch", e)
+                break
             except Exception as e:
                 log.error("kafka produce failed: %s", e)
 
