@@ -1,0 +1,368 @@
+"""PR: pipeline telemetry layer (ISSUE satellites c + e).
+
+Covers: the thread-safe registry primitives, Prometheus exposition-format
+conformance (HELP/TYPE, label escaping, summary quantiles, counter
+monotonicity across flushes), the dogfood round-trip (cli/prometheus.py
+scraping a live server's own /metrics and translating deltas), the
+flush-trace span tree behind flush_trace_enabled, and the metric-name
+lint over the tree.
+"""
+
+import json
+import pathlib
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from veneur_tpu.cli.prometheus import (Translator, make_fetcher,
+                                       parse_exposition, scrape_once)
+from veneur_tpu.config import Config
+from veneur_tpu.observability import (TelemetryRegistry, TIMER_QUANTILES,
+                                      render_prometheus)
+from veneur_tpu.server.server import Server
+from veneur_tpu.sinks.debug import DebugMetricSink, DebugSpanSink
+
+
+def small_config(**kw):
+    defaults = dict(
+        interval="10s", hostname="testbox", metric_max_length=4096,
+        read_buffer_size_bytes=2097152, percentiles=[0.5, 0.99],
+        aggregates=["min", "max", "count"],
+        statsd_listen_addresses=["udp://127.0.0.1:0"],
+        tpu_counter_capacity=256, tpu_gauge_capacity=64,
+        tpu_status_capacity=16, tpu_set_capacity=16, tpu_histo_capacity=64,
+        tpu_batch_counter=512, tpu_batch_gauge=128, tpu_batch_status=16,
+        tpu_batch_set=64, tpu_batch_histo=512)
+    defaults.update(kw)
+    return Config(**defaults)
+
+
+def _send_udp(addr, lines):
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.sendto(b"\n".join(lines), addr)
+    s.close()
+
+
+def _wait_processed(srv, n, timeout=60.0):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        if srv.aggregator.processed >= n:
+            return
+        time.sleep(0.02)
+    raise TimeoutError(f"only {srv.aggregator.processed} processed")
+
+
+# -- registry primitives ----------------------------------------------------
+
+def test_counter_is_atomic_across_threads():
+    """Satellite (b): the lost-increment race `x += 1` has under
+    concurrent writers cannot happen through the registry counter."""
+    reg = TelemetryRegistry()
+    c = reg.counter("veneur.test.atomic_total")
+    n_threads, per_thread = 8, 1000
+
+    def spin():
+        for _ in range(per_thread):
+            c.inc()
+
+    threads = [threading.Thread(target=spin) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value() == n_threads * per_thread
+
+
+def test_counter_labels_and_negative_rejection():
+    reg = TelemetryRegistry()
+    c = reg.counter("veneur.test.by_sink_total", labelnames=("sink",))
+    c.inc(sink="a")
+    c.inc(2, sink="b")
+    assert c.value(sink="a") == 1
+    assert c.value(sink="b") == 2
+    with pytest.raises(ValueError):
+        c.inc(-1, sink="a")
+
+
+def test_timer_quantiles_via_tdigest():
+    reg = TelemetryRegistry()
+    t = reg.timer("veneur.test.duration_ns")
+    for v in range(1, 2001):   # > fold batch, forces a device fold
+        t.observe(float(v))
+    (lv, st), = t.snapshot()
+    assert lv == ()
+    assert st.count == 2000
+    assert st.sum == pytest.approx(2001 * 1000)
+    assert set(st.quantiles) == set(TIMER_QUANTILES)
+    assert st.quantiles[0.5] == pytest.approx(1000, rel=0.1)
+    assert st.quantiles[0.99] == pytest.approx(1980, rel=0.05)
+
+
+def test_registry_conflicting_reregistration_raises():
+    reg = TelemetryRegistry()
+    reg.counter("veneur.test.one_total")
+    with pytest.raises(ValueError):
+        reg.gauge("veneur.test.one_total")
+
+
+# -- exposition format ------------------------------------------------------
+
+def test_render_escapes_label_values_and_names():
+    reg = TelemetryRegistry()
+    c = reg.counter("veneur.test.weird-name.total", labelnames=("path",),
+                    help='a "quoted" help\nwith newline')
+    c.inc(path='C:\\temp\n"x"')
+    text = render_prometheus(reg)
+    # dots and dashes sanitize to underscores; label value escapes \ " \n
+    assert "veneur_test_weird_name_total" in text
+    assert '{path="C:\\\\temp\\n\\"x\\""}' in text
+    # HELP newline escaped, not literal
+    assert '# HELP veneur_test_weird_name_total ' \
+           'a "quoted" help\\nwith newline' in text
+    types, samples = parse_exposition(text)
+    assert types["veneur_test_weird_name_total"] == "counter"
+    (name, labels, value), = samples
+    assert value == 1.0
+
+
+def test_render_summary_shape():
+    reg = TelemetryRegistry()
+    t = reg.timer("veneur.test.lat_ns", labelnames=("phase",))
+    for v in (1.0, 2.0, 3.0):
+        t.observe(v, phase="x")
+    text = render_prometheus(reg)
+    assert "# TYPE veneur_test_lat_ns summary" in text
+    for q in ("0.5", "0.95", "0.99"):
+        assert f'veneur_test_lat_ns{{phase="x",quantile="{q}"}}' in text
+    assert 'veneur_test_lat_ns_sum{phase="x"} 6' in text
+    assert 'veneur_test_lat_ns_count{phase="x"} 3' in text
+
+
+# -- live server: /metrics conformance + dogfood round-trip -----------------
+
+@pytest.fixture
+def prom_server():
+    sink = DebugMetricSink()
+    srv = Server(small_config(http_address="127.0.0.1:0",
+                              prometheus_metrics_enabled=True),
+                 metric_sinks=[sink])
+    srv.start()
+    yield srv, sink
+    srv.shutdown()
+
+
+def _scrape(srv):
+    url = f"http://127.0.0.1:{srv.http_port}/metrics"
+    with urllib.request.urlopen(url) as resp:
+        assert resp.status == 200
+        assert resp.headers["Content-Type"].startswith("text/plain")
+        return resp.read().decode()
+
+
+def test_metrics_endpoint_conformance(prom_server):
+    srv, sink = prom_server
+    _send_udp(srv.local_addr(), [b"obs.count:5|c", b"obs.gauge:2|g"])
+    _wait_processed(srv, 2)
+    assert srv.trigger_flush(wait=True)
+    text = _scrape(srv)
+    types, samples = parse_exposition(text)
+    by_name = {}
+    for name, labels, value in samples:
+        by_name.setdefault(name, []).append((labels, value))
+
+    # every sample line belongs to a TYPEd family
+    for name in by_name:
+        base = name
+        for suffix in ("_sum", "_count"):
+            if name.endswith(suffix) and name[:-len(suffix)] in types:
+                base = name[:-len(suffix)]
+        assert base in types, f"untyped family for {name}"
+
+    # PR-1 reliability counters are present (registered even when idle)
+    assert "veneur_flush_skipped_total" in by_name
+    # per-phase flush timers with the three quantiles
+    phases = {lbl["phase"] for lbl, _ in
+              by_name["veneur_flush_phase_duration_ns"]}
+    assert {"ingest_drain", "device_update", "frame_build",
+            "sink_fanout", "total"} <= phases
+    quantiles = {lbl["quantile"] for lbl, _ in
+                 by_name["veneur_flush_phase_duration_ns"]}
+    assert quantiles == {"0.5", "0.95", "0.99"}
+    assert types["veneur_flush_phase_duration_ns"] == "summary"
+    # per-sink timer
+    sinks = {lbl["sink"] for lbl, _ in
+             by_name["veneur_sink_flush_duration_ns"]}
+    assert "debug" in sinks
+    # a labeled series from the reliability collectors would render here;
+    # h2d/device families exist
+    assert "veneur_device_steps_total" in by_name
+
+    # duplicate series are invalid exposition
+    seen = set()
+    for name, labels, _ in samples:
+        key = (name, tuple(sorted(labels.items())))
+        assert key not in seen, f"duplicate series {key}"
+        seen.add(key)
+
+
+def test_metrics_counters_monotonic_across_flushes(prom_server):
+    srv, _ = prom_server
+    _send_udp(srv.local_addr(), [b"mono.a:1|c"])
+    _wait_processed(srv, 1)
+    assert srv.trigger_flush(wait=True)
+    _, s1 = parse_exposition(_scrape(srv))
+    _send_udp(srv.local_addr(), [b"mono.a:1|c", b"mono.b:1|c"])
+    _wait_processed(srv, 3)
+    assert srv.trigger_flush(wait=True)
+    types, s2 = parse_exposition(_scrape(srv))
+    v1 = {(n, tuple(sorted(l.items()))): v for n, l, v in s1}
+    for n, l, v in s2:
+        if types.get(n) != "counter":
+            continue
+        key = (n, tuple(sorted(l.items())))
+        if key in v1:
+            assert v >= v1[key], f"counter {key} went backwards"
+    # and the packet counter actually advanced (one more datagram sent)
+    pk = ("veneur_packets_received_total", ())
+    v2 = {(n, tuple(sorted(l.items()))): v for n, l, v in s2}
+    assert v2[pk] >= v1[pk] + 1
+
+
+def test_metrics_endpoint_404_when_disabled():
+    srv = Server(small_config(http_address="127.0.0.1:0"),
+                 metric_sinks=[DebugMetricSink()])
+    srv.start()
+    try:
+        url = f"http://127.0.0.1:{srv.http_port}/metrics"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(url)
+        assert ei.value.code == 404
+    finally:
+        srv.shutdown()
+
+
+def test_prometheus_cli_scrapes_own_metrics(prom_server):
+    """Satellite (c) dogfood: the bundled veneur-tpu-prometheus poller
+    scrapes this server's /metrics and the translated counter deltas
+    match what the registry advanced by."""
+    srv, _ = prom_server
+    url = f"http://127.0.0.1:{srv.http_port}/metrics"
+    fetch = make_fetcher(url)
+    tr = Translator()
+    assert scrape_once(fetch, tr) == []   # first poll primes the cache
+
+    k = 7
+    _send_udp(srv.local_addr(),
+              [b"dog.c%d:1|c" % i for i in range(k)])
+    _wait_processed(srv, k)
+    assert srv.trigger_flush(wait=True)
+    packets = [p.decode() for p in scrape_once(fetch, tr)]
+    # the counter delta for packets_received equals what we sent (one
+    # datagram here)
+    recv = [p for p in packets
+            if p.startswith("veneur_packets_received_total:")]
+    assert recv and recv[0] == "veneur_packets_received_total:1|c"
+    # processed advanced by at least the k ingested metrics (the flush's
+    # own self-telemetry loops back through the pipeline and is counted
+    # too, so >= not ==)
+    proc = [p for p in packets
+            if p.startswith("veneur_worker_metrics_processed_total:")]
+    assert proc
+    assert float(proc[0].split(":")[1].split("|")[0]) >= k
+    # summaries arrive as quantile gauges
+    assert any(p.startswith("veneur_flush_phase_duration_ns:")
+               and "|g|#" in p and "quantile:0.5" in p for p in packets)
+
+
+def test_stats_exposes_telemetry_map(prom_server):
+    srv, _ = prom_server
+    assert srv.trigger_flush(wait=True)
+    url = f"http://127.0.0.1:{srv.http_port}/stats"
+    st = json.loads(urllib.request.urlopen(url).read())
+    tel = st["telemetry"]
+    # satellite (a): PR-1 reliability names ride in /stats
+    assert "veneur.flush.skipped_total" in tel
+    assert "veneur.flush.completed_total" in tel
+    assert tel["veneur.flush.completed_total"] >= 1
+    assert any(k.startswith("veneur.flush.phase_duration_ns") for k in tel)
+
+
+# -- flush trace ------------------------------------------------------------
+
+def _wait_span_names(ssink, want, timeout=30.0):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        names = {sp.name for sp in list(ssink.spans)}
+        if want <= names:
+            return names
+        time.sleep(0.05)
+    raise TimeoutError(f"spans seen: {sorted(names)}; wanted {sorted(want)}")
+
+
+def test_flush_trace_span_tree():
+    sink = DebugMetricSink()
+    ssink = DebugSpanSink()
+    srv = Server(small_config(flush_trace_enabled=True),
+                 metric_sinks=[sink], span_sinks=[ssink])
+    srv.start()
+    try:
+        _send_udp(srv.local_addr(), [b"tr.a:1|c", b"tr.b:3|ms"])
+        _wait_processed(srv, 2)
+        assert srv.trigger_flush(wait=True)
+        # spans loop back through the pipeline; the NEXT flush delivers
+        # them to span sinks
+        assert srv.trigger_flush(wait=True)
+        want = {"flush", "flush.ingest_drain", "flush.device_update",
+                "flush.frame_build", "flush.sinks", "flush.sink.debug"}
+        _wait_span_names(ssink, want)
+        spans = {sp.name: sp for sp in list(ssink.spans)}
+        root = spans["flush"]
+        for name in want - {"flush"}:
+            sp = spans[name]
+            assert sp.trace_id == root.trace_id, name
+            assert sp.parent_id != 0, name
+        # phase tags: rows on frame_build + root, h2d on drain + root
+        assert "rows" in spans["flush.frame_build"].tags
+        assert "rows" in spans["flush.sink.debug"].tags
+        assert "h2d_bytes" in spans["flush.ingest_drain"].tags
+        assert "rows" in spans["flush"].tags
+        assert "h2d_bytes" in spans["flush"].tags
+        # the reconstructed drain span precedes (or equals) root start
+        drain = spans["flush.ingest_drain"]
+        assert drain.start_timestamp == root.start_timestamp
+        assert drain.end_timestamp >= drain.start_timestamp
+    finally:
+        srv.shutdown()
+
+
+def test_flush_trace_off_by_default():
+    sink = DebugMetricSink()
+    ssink = DebugSpanSink()
+    srv = Server(small_config(), metric_sinks=[sink], span_sinks=[ssink])
+    srv.start()
+    try:
+        _send_udp(srv.local_addr(), [b"off.a:1|c"])
+        _wait_processed(srv, 1)
+        assert srv.trigger_flush(wait=True)
+        assert srv.trigger_flush(wait=True)
+        _wait_span_names(ssink, {"flush"})
+        names = {sp.name for sp in list(ssink.spans)}
+        assert "flush.ingest_drain" not in names
+        assert "flush.frame_build" not in names
+    finally:
+        srv.shutdown()
+
+
+# -- satellite (e): the lint itself -----------------------------------------
+
+def test_metric_names_are_registered_once_and_documented():
+    script = (pathlib.Path(__file__).resolve().parent.parent
+              / "scripts" / "check_metric_names.py")
+    proc = subprocess.run([sys.executable, str(script)],
+                          capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -120,6 +120,16 @@ def make_fetcher(url, cert=None, key=None, cacert=None, socket_path=None,
     return fetch
 
 
+def scrape_once(fetch, translator):
+    """One poll: fetch → parse → translate → statsd packets. The first
+    call primes the translator's delta cache, so counters emit nothing
+    until the second poll (translate.go cache semantics). Shared by the
+    polling loop below and the server's own /metrics round-trip test —
+    a veneur-tpu server can scrape ITSELF through this path."""
+    types, samples = parse_exposition(fetch())
+    return translator.translate(types, samples)
+
+
 class Translator:
     """Stateful poll-to-statsd translation with the counter delta cache
     (translate.go cache semantics)."""
@@ -243,12 +253,10 @@ def main(argv=None):
     polls = 0
     while True:
         try:
-            types, samples = parse_exposition(fetch())
-            packets = tr.translate(types, samples)
+            packets = scrape_once(fetch, tr)
             for p in packets:
                 sock.sendto(p, addr)
-            log.info("poll %d: %d samples -> %d packets", polls,
-                     len(samples), len(packets))
+            log.info("poll %d: %d packets", polls, len(packets))
         except Exception as e:
             log.warning("poll failed: %s", e)
         polls += 1

@@ -119,6 +119,13 @@ class Config:
     forward_spill_max_age_s: float = 60.0
     fault_injection: str = ""          # chaos spec (reliability/faults.py)
 
+    # observability (veneur_tpu/observability/). Both switches default
+    # OFF with zero hot-path overhead (a single attribute check / a 404):
+    # the telemetry registry itself always runs — it IS the counter store.
+    prometheus_metrics_enabled: bool = False  # serve GET /metrics
+    flush_trace_enabled: bool = False  # per-phase span tree + row/byte tags
+    self_timer_compression: float = 50.0  # t-digest delta for self-timers
+
     # debug
     debug: bool = False
     debug_flushed_metrics: bool = False

@@ -1,0 +1,8 @@
+"""veneur_tpu.observability: telemetry registry, Prometheus exposition,
+and JAX runtime telemetry (see registry.py for the design)."""
+
+from veneur_tpu.observability.registry import (Counter, Gauge,  # noqa: F401
+                                               TelemetryRegistry, Timer,
+                                               TIMER_QUANTILES)
+from veneur_tpu.observability.export import (  # noqa: F401
+    render_prometheus)

@@ -1,0 +1,69 @@
+"""JAX runtime telemetry: jit recompile counts and compile wall time.
+
+The single biggest silent perf cliff in this codebase is an accidental
+recompile of the ingest/flush programs (a shape-static argument that
+isn't, a new batch geometry) — the whole TPU-first design is "one
+resident executable per batch". jax.monitoring fires a duration event
+(`.../backend_compile_duration`) every time XLA actually compiles, so a
+recompile storm shows up as a climbing counter instead of a mysterious
+10x flush-latency regression.
+
+The listener is process-global and idempotent (jax.monitoring has no
+unregister; multiple Server instances in one process — the test suite —
+must not stack listeners). Servers export the accumulators through
+registry callbacks, so every server's /metrics reports the same
+process-wide truth.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+log = logging.getLogger("veneur_tpu.observability.jax")
+
+_lock = threading.Lock()
+_installed = False
+_compiles_total = 0
+_compile_seconds_total = 0.0
+
+# substring match: the exact event path has varied across jax versions
+# (/jax/core/compile/backend_compile_duration today)
+_COMPILE_EVENT = "backend_compile_duration"
+
+
+def _on_duration(event: str, duration_secs: float, **_kw) -> None:
+    global _compiles_total, _compile_seconds_total
+    if _COMPILE_EVENT not in event:
+        return
+    with _lock:
+        _compiles_total += 1
+        _compile_seconds_total += float(duration_secs)
+
+
+def install() -> bool:
+    """Register the compile listener once per process; safe to call from
+    every Server.__init__. Returns False when jax.monitoring is absent
+    (the accumulators then just stay 0)."""
+    global _installed
+    with _lock:
+        if _installed:
+            return True
+        try:
+            from jax import monitoring
+            monitoring.register_event_duration_secs_listener(_on_duration)
+        except Exception as e:
+            log.debug("jax.monitoring unavailable: %s", e)
+            return False
+        _installed = True
+        return True
+
+
+def compiles_total() -> int:
+    with _lock:
+        return _compiles_total
+
+
+def compile_time_ns_total() -> float:
+    with _lock:
+        return _compile_seconds_total * 1e9

@@ -57,6 +57,10 @@ class FaultInjector:
         self._lock = threading.Lock()
         self._rules: Dict[str, _Rule] = {}
         self._armed = False   # fast-path gate, read without the lock
+        self.injected_total = 0   # faults actually fired, across all
+        #                           points (exported by the telemetry
+        #                           registry so a chaos drill is visible
+        #                           in /metrics next to its victims)
 
     def arm(self, point: str, *, error: bool = False, latency_s: float = 0.0,
             times: Optional[int] = None, match: Optional[str] = None,
@@ -93,6 +97,7 @@ class FaultInjector:
                     return
                 rule.times -= 1
             rule.fired += 1
+            self.injected_total += 1
             latency, raise_error, msg = (rule.latency_s, rule.error,
                                          rule.message)
         if latency > 0:

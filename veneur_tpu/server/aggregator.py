@@ -53,6 +53,11 @@ class Aggregator:
         self.processed = 0
         self.dropped_capacity = 0
         self.h2d_bytes = 0  # packed ingest bytes shipped to the device
+        # device-step accounting for /metrics (observability/): dispatch
+        # wall time (host-side; XLA execution is async) and a monotonic
+        # step count — _steps resets every swap, steps_total never does
+        self.step_ns = 0
+        self.steps_total = 0
 
     def extra_parse_errors(self) -> int:
         """Parse errors counted below the Python layer (native engine)."""
@@ -63,10 +68,13 @@ class Aggregator:
         # one packed H2D transfer per step; compaction rides the same
         # program via the control word (step.py pack_batch rationale)
         self._steps += 1
+        self.steps_total += 1
         flat = pack_batch(batch, self._steps % self.compact_every == 0)
         self.h2d_bytes += flat.nbytes
+        t0 = time.perf_counter_ns()
         self.state = ingest_step_packed(
             self.state, flat, spec=self.spec, sizes=batch_sizes(batch))
+        self.step_ns += time.perf_counter_ns() - t0
 
     def process_metric(self, m: UDPMetric) -> None:
         """reference worker.go:344 ProcessMetric: switch on type+scope,

@@ -109,8 +109,25 @@ def start_http_server(server, address) -> "http.server.ThreadingHTTPServer":
                     "flush_count": server.flush_count,
                     "spans_received": server.span_pipeline.spans_received,
                     "spans_dropped": server.span_pipeline.spans_dropped,
+                    # the full registry, flattened — every counter/gauge
+                    # plus timer count/sum, labeled series keyed
+                    # name{k=v,...}
+                    "telemetry": server.metrics.flat_values(),
                 }).encode()
                 self._reply(200, body, "application/json")
+            elif self.path == "/metrics":
+                # Prometheus text exposition of the telemetry registry,
+                # scrapeable by cli/prometheus.py (or any Prometheus).
+                # Off by default: the endpoint 404s unless configured, so
+                # an unaware deployment exposes nothing new.
+                if not getattr(server.cfg, "prometheus_metrics_enabled",
+                               False):
+                    self._reply(404, b"prometheus_metrics_enabled is off")
+                    return
+                from veneur_tpu.observability import render_prometheus
+                server._c_metrics_scrapes.inc()
+                self._reply(200, render_prometheus(server.metrics).encode(),
+                            "text/plain; version=0.0.4")
             elif self.path == "/debug/pprof/threads":
                 self._reply(200, _thread_dump(), "text/plain")
             elif self.path.startswith("/debug/pprof/profile"):
@@ -231,7 +248,9 @@ def start_http_server(server, address) -> "http.server.ThreadingHTTPServer":
                 try:
                     metrics.append(from_json_metric(jm))
                 except Exception as e:
-                    server.import_errors += 1
+                    # registry counter: atomic under concurrent HTTP
+                    # import threads (import_errors is a read-only view)
+                    server._c_import_errors.inc()
                     log.warning("bad JSONMetric %s: %s",
                                 jm.get("name") if isinstance(jm, dict)
                                 else jm, e)

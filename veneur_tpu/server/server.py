@@ -244,17 +244,66 @@ class Server:
         # device-state snapshot, so a backlogged flush worker must drop
         # intervals rather than grow without limit.
         self._flush_jobs: "queue.Queue" = queue.Queue(maxsize=4)
-        self.flush_intervals_deferred = 0
         self.last_flush = time.time()
         self.last_flush_done = time.time()
-        self.flush_count = 0
         # slow-sink containment (flush-worker thread only)
         self._sink_threads: dict = {}
-        self.sink_flushes_skipped = 0
-        self.parse_errors = 0
-        self.import_errors = 0
-        self.internal_errors = 0   # _dispatch_item backstop catches
-        self.imported_total = 0
+
+        # -- telemetry registry (veneur_tpu/observability/) ---------------
+        # THE source of truth for self-observation: /stats, the
+        # self-metric flush, and GET /metrics all read it. The scattered
+        # integer attributes it replaces live on as read-only properties
+        # (parse_errors, imported_total, ...) so embedders and tests keep
+        # their read surface unchanged; every write goes through an
+        # atomic Counter.inc() — which also fixes the lost-increment race
+        # on imported_total (+= from the gRPC and HTTP import threads).
+        from veneur_tpu.observability import TelemetryRegistry, jaxruntime
+        self.metrics = TelemetryRegistry(
+            timer_compression=float(cfg.self_timer_compression or 50.0))
+        self._flush_trace = bool(cfg.flush_trace_enabled)
+        M = self.metrics
+        self._c_parse_errors = M.counter(
+            "veneur.parse_errors_total",
+            "statsd/SSF payloads that failed to parse (Python layer)")
+        self._c_import_errors = M.counter(
+            "veneur.import.errors_total",
+            "imported metrics rejected by /import or gRPC ingest")
+        self._c_internal_errors = M.counter(
+            "veneur.pipeline.internal_errors_total",
+            "work items caught by the pipeline thread's backstop")
+        self._c_imported = M.counter(
+            "veneur.import.metrics_total",
+            "metrics accepted from the forward/import tier")
+        self._c_forward_errors = M.counter(
+            "veneur.forward.error_total", "failed forward sends")
+        self._c_forward_sends = M.counter(
+            "veneur.forward.sends_total", "completed forward sends")
+        self._c_forward_retries = M.counter(
+            "veneur.forward.retries_total", "forward send retry attempts")
+        self._c_flush_count = M.counter(
+            "veneur.flush.completed_total",
+            "flush intervals run to completion (success or failure)")
+        self._c_intervals_deferred = M.counter(
+            "veneur.flush.intervals_deferred_total",
+            "intervals deferred because the flush worker was backlogged")
+        self._c_sink_skips = M.counter(
+            "veneur.flush.skipped_total",
+            "per-sink interval flushes skipped (slow sink / open circuit)")
+        self._c_metrics_scrapes = M.counter(
+            "veneur.metrics.scrapes_total", "GET /metrics scrapes served")
+        self._t_flush_phase = M.timer(
+            "veneur.flush.phase_duration_ns",
+            "per-phase flush wall time, sketched by the in-house t-digest",
+            labelnames=("phase",))
+        self._t_sink_flush = M.timer(
+            "veneur.sink.flush_duration_ns",
+            "one sink flush call, success or failure",
+            labelnames=("sink",))
+        jaxruntime.install()
+        # h2d_bytes high-water at the last flush report, for per-interval
+        # byte tags on the flush trace (flush worker thread only)
+        self._h2d_reported = 0
+
         # per-metric-sink flush accounting for the sink.* conventions
         # (sinks/sinks.go:11-29), accumulated by sink flush threads
         self._sink_stats_lock = threading.Lock()
@@ -262,10 +311,6 @@ class Server:
         # README: veneur.flush.error_total, per sink like the other
         # sink.* conventions (an untagged total can't say WHICH sink)
         self._sink_flush_errors: dict = {}
-        self.forward_errors = 0
-        # completed forward sends (same lock discipline as forward_errors:
-        # overlapping aux-thread forwards make += lossy)
-        self.forward_sends_total = 0
         # (duration_ns, n_metrics) per forward POST, success or failure;
         # guarded by _sink_stats_lock with the other flush telemetry
         self._forward_stats: list = []
@@ -306,9 +351,8 @@ class Server:
             self.forward_spill = ForwardSpillBuffer(
                 cfg.forward_spill_max_bytes, cfg.forward_spill_max_age_s)
         # fan-out retry counts per sink (plain sinks only; ResilientSink
-        # sinks count their own) + forward retries, under _sink_stats_lock
+        # sinks count their own), under _sink_stats_lock
         self._fanout_retries: dict = {}
-        self.forward_retries_total = 0
         self._packets_received = 0
         self._packets_dropped_py = 0
         self._packets_toolong_py = 0
@@ -344,6 +388,142 @@ class Server:
         self.grpc_port = None
         self._httpd = None
         self.http_port = None
+        # last: every attribute a collector closes over now exists
+        self._register_collectors()
+
+    def _register_collectors(self) -> None:
+        """Read-through registry collectors for values owned elsewhere:
+        packet counters folded from the C++ reader group, aggregator
+        device accounting, the reliability layer's breakers and spill
+        buffer, process-wide JAX compile telemetry. Evaluated only at
+        collect time (/metrics scrape, /stats, self-metric flush) — zero
+        hot-path cost. Native-engine sub-Python parse errors are NOT
+        read here (the engine's stats call must not interleave with
+        feed(); they reach self-telemetry via the pipeline-thread
+        snapshot instead)."""
+        from veneur_tpu.observability import jaxruntime
+        from veneur_tpu.reliability.faults import FAULTS
+        M = self.metrics
+        M.callback("veneur.packets_received_total",
+                   lambda: self.packets_received, kind="counter",
+                   help="datagrams delivered (Python + C++ readers)")
+        M.callback("veneur.packets_dropped_total",
+                   lambda: self.packets_dropped, kind="counter",
+                   help="datagrams lost to backpressure after delivery")
+        M.callback("veneur.packet.error_toolong_total",
+                   lambda: self.packets_toolong, kind="counter",
+                   help="datagrams dropped whole: over metric_max_length")
+        M.callback("veneur.worker.metrics_processed_total",
+                   lambda: self.aggregator.processed, kind="counter",
+                   help="metrics staged into the device table")
+        M.callback("veneur.worker.metrics_dropped_total",
+                   lambda: self.aggregator.dropped_capacity, kind="counter",
+                   help="metrics dropped at table capacity")
+        M.callback("veneur.spans_received_total",
+                   lambda: self.span_pipeline.spans_received, kind="counter",
+                   help="SSF spans accepted by the span pipeline")
+        M.callback("veneur.device.h2d_bytes_total",
+                   lambda: getattr(self.aggregator, "h2d_bytes", 0),
+                   kind="counter",
+                   help="packed ingest bytes shipped host-to-device")
+        M.callback("veneur.device.step_ns_total",
+                   lambda: getattr(self.aggregator, "step_ns", 0),
+                   kind="counter",
+                   help="device ingest-step dispatch wall time (host side)")
+        M.callback("veneur.device.steps_total",
+                   lambda: getattr(self.aggregator, "steps_total", 0),
+                   kind="counter", help="device ingest steps dispatched")
+        M.callback("veneur.jax.compiles_total", jaxruntime.compiles_total,
+                   kind="counter",
+                   help="XLA backend compiles observed, process-wide")
+        M.callback("veneur.jax.compile_time_ns_total",
+                   jaxruntime.compile_time_ns_total, kind="counter",
+                   help="wall time spent inside XLA backend compiles")
+        M.callback("veneur.faults.injected_total",
+                   lambda: FAULTS.injected_total, kind="counter",
+                   help="chaos faults fired by the process-global injector")
+        # reliability layer (PR 1) — the same collectors
+        # _report_self_metrics deltas against, so JSON stats, the
+        # self-metric flush, and /metrics can never disagree
+        M.callback("veneur.sink.retries_total", self._collect_sink_retries,
+                   kind="counter", labelnames=("sink",),
+                   help="egress retries per destination "
+                        "(fan-out + sink harness + forward)")
+        M.callback("veneur.sink.posts_skipped_open_total",
+                   self._collect_posts_skipped, kind="counter",
+                   labelnames=("sink",),
+                   help="sink network calls refused by an open circuit")
+        M.callback("veneur.circuit.state", self._collect_circuit_state,
+                   kind="gauge", labelnames=("sink",),
+                   help="breaker state: 0 closed / 1 half-open / 2 open")
+        M.callback("veneur.circuit.opens_total",
+                   self._collect_circuit_opens, kind="counter",
+                   labelnames=("sink",),
+                   help="closed-to-open breaker transitions")
+        M.callback("veneur.forward.spill_bytes",
+                   lambda: (self.forward_spill.bytes
+                            if self.forward_spill is not None else None),
+                   help="mergeable sketch bytes awaiting re-forward")
+        M.callback("veneur.forward.spill.spilled_total",
+                   lambda: (self.forward_spill.spilled_total
+                            if self.forward_spill is not None else None),
+                   kind="counter",
+                   help="metrics spilled after failed forwards")
+        M.callback("veneur.forward.spill.dropped_total",
+                   lambda: (self.forward_spill.dropped_total
+                            if self.forward_spill is not None else None),
+                   kind="counter",
+                   help="spilled metrics dropped at the cap or max age")
+
+    # -- registry collector helpers -----------------------------------------
+    def _breaker_list(self):
+        out = [(s.name, self._sink_breakers[id(s)])
+               for s in self.metric_sinks + self.span_sinks
+               if id(s) in self._sink_breakers]
+        if self._forward_breaker is not None:
+            out.append(("forward", self._forward_breaker))
+        return out
+
+    def _collect_circuit_state(self):
+        # fold same-named sink instances to the WORST state — duplicate
+        # label sets are invalid exposition
+        by_name: dict = {}
+        for name, b in self._breaker_list():
+            by_name[name] = max(by_name.get(name, 0), b.state)
+        return [((name,), float(v)) for name, v in sorted(by_name.items())]
+
+    def _collect_circuit_opens(self):
+        by_name: dict = {}
+        for name, b in self._breaker_list():
+            by_name[name] = by_name.get(name, 0) + b.opens_total
+        return [((name,), float(v)) for name, v in sorted(by_name.items())
+                if v]
+
+    def _collect_sink_retries(self):
+        totals: dict = {}
+        with self._sink_stats_lock:
+            for name, n in self._fanout_retries.items():
+                totals[name] = totals.get(name, 0) + n
+        fwd = self._c_forward_retries.value()
+        if fwd:
+            totals["forward"] = totals.get("forward", 0) + fwd
+        for s in self.metric_sinks + self.span_sinks:
+            if isinstance(s, ResilientSink):
+                own = s.reliability_counters()[0]
+            else:
+                own = getattr(s, "retries_total", 0)
+            if own:
+                totals[s.name] = totals.get(s.name, 0) + own
+        return [((name,), float(n)) for name, n in sorted(totals.items())]
+
+    def _collect_posts_skipped(self):
+        totals: dict = {}
+        for s in self.metric_sinks + self.span_sinks:
+            if isinstance(s, ResilientSink):
+                n = s.reliability_counters()[1]
+                if n:
+                    totals[s.name] = totals.get(s.name, 0) + n
+        return [((name,), float(n)) for name, n in sorted(totals.items())]
 
     # -- tag exclusion wiring (server.go:1467-1510) -------------------------
     def _wire_excluded_tags(self):
@@ -381,7 +561,7 @@ class Server:
                 m = parser.parse_metric(packet)
                 self.aggregator.process_metric(m)
         except parser.ParseError as e:
-            self.parse_errors += 1
+            self._c_parse_errors.inc()
             log.debug("bad packet %r: %s", packet[:64], e)
 
     def _process_packets(self, data: bytes) -> None:
@@ -443,7 +623,7 @@ class Server:
             # request that died mid-handling must still release its
             # waiter instead of letting trigger_flush block out its
             # whole budget.
-            self.internal_errors += 1
+            self._c_internal_errors.inc()
             log.exception("pipeline item failed (server continues); "
                           "item=%r", type(item).__name__)
             if isinstance(item, FlushRequest):
@@ -455,8 +635,9 @@ class Server:
         elif isinstance(item, _ImportBytes):
             t0 = time.perf_counter_ns()
             n, errs = self.aggregator.import_pb_bytes(bytes(item))
-            self.imported_total += n
-            self.import_errors += errs
+            self._c_imported.inc(n)
+            if errs:
+                self._c_import_errors.inc(errs)
             report_one(self.trace_client, ssf_samples.timing(
                 "veneur.import.response_duration_ns",
                 (time.perf_counter_ns() - t0) / 1e9, {"part": "merge"}))
@@ -466,7 +647,7 @@ class Server:
             # multi-threaded gRPC handler, so concurrent imports can't
             # lose increments (importsrv/server.go:130 import.metrics_total)
             t0 = time.perf_counter_ns()
-            self.imported_total += len(item)
+            self._c_imported.inc(len(item))
             for metric in item:
                 try:
                     import_into(self.aggregator, metric)
@@ -474,7 +655,7 @@ class Server:
                     # counted into self-telemetry so a mixed fleet sees
                     # incompatible payloads (e.g. foreign sketch bytes)
                     # instead of silently losing them
-                    self.import_errors += 1
+                    self._c_import_errors.inc()
                     log.warning("bad imported metric %s: %s",
                                 metric.name, e)
             # README §Monitoring: import.response_duration_ns part:merge
@@ -502,22 +683,31 @@ class Server:
         # for a fully wedged worker). Only the pipeline thread puts jobs,
         # so full() → put_nowait cannot race into queue.Full.
         if self._flush_jobs.full():
-            self.flush_intervals_deferred += 1
+            self._c_intervals_deferred.inc()
             log.warning("flush worker backlogged; interval deferred "
                         "(state retained)")
             req.finish(False, "deferred: flush worker backlogged")
             return
         now = time.time()
         self.last_flush = now
+        # the ingest-drain phase: how long the interval's device state
+        # takes to detach from the hot path (the only flush work that
+        # blocks ingest) — timed here, surfaced as the flush trace's
+        # first child span and the phase=ingest_drain timer
+        swap_t0 = time.perf_counter_ns()
         try:
             state, table = self.aggregator.swap()
         except Exception as e:
             log.exception("flush swap failed")
             req.finish(False, f"swap failed: {e}")
             return
+        swap_ns = time.perf_counter_ns() - swap_t0
+        self._t_flush_phase.observe(swap_ns, phase="ingest_drain")
         # snapshot pipeline-owned counters here: the native engine's
         # stats call isn't safe to interleave with feed()
         stats = {
+            "swap_ns": swap_ns,
+            "h2d_bytes": getattr(self.aggregator, "h2d_bytes", 0),
             "packets_received": self.packets_received,
             "packets_dropped": self.packets_dropped,
             "packets_toolong": self.packets_toolong,
@@ -636,6 +826,50 @@ class Server:
                 n += self.aggregator.reader_counters()["toolong"]
         return n
 
+    # -- registry-backed compatibility accessors ----------------------------
+    # The plain counter attributes these replaced were read by embedders,
+    # tests and httpapi; keep the names as int views over the registry.
+
+    @property
+    def parse_errors(self) -> int:
+        return int(self._c_parse_errors.value())
+
+    @property
+    def import_errors(self) -> int:
+        return int(self._c_import_errors.value())
+
+    @property
+    def internal_errors(self) -> int:
+        return int(self._c_internal_errors.value())
+
+    @property
+    def imported_total(self) -> int:
+        return int(self._c_imported.value())
+
+    @property
+    def forward_errors(self) -> int:
+        return int(self._c_forward_errors.value())
+
+    @property
+    def forward_sends_total(self) -> int:
+        return int(self._c_forward_sends.value())
+
+    @property
+    def forward_retries_total(self) -> int:
+        return int(self._c_forward_retries.value())
+
+    @property
+    def flush_count(self) -> int:
+        return int(self._c_flush_count.value())
+
+    @property
+    def flush_intervals_deferred(self) -> int:
+        return int(self._c_intervals_deferred.value())
+
+    @property
+    def sink_flushes_skipped(self) -> int:
+        return int(self._c_sink_skips.value())
+
     def _ssf_udp_reader(self, sock: socket.socket):
         """One SSF span protobuf per datagram (server.go:1125
         ReadSSFPacketSocket -> HandleTracePacket)."""
@@ -654,7 +888,7 @@ class Server:
             try:
                 span = parse_ssf(data)
             except Exception:
-                self.parse_errors += 1
+                self._c_parse_errors.inc()
                 continue
             self.span_pipeline.handle_span(span, ssf_format="packet")
 
@@ -694,11 +928,11 @@ class Server:
                 buf += data
                 while len(buf) >= 5:
                     if buf[0] != 0:
-                        self.parse_errors += 1
+                        self._c_parse_errors.inc()
                         return  # unknown frame version: poisoned
                     (length,) = struct.unpack(">I", buf[1:5])
                     if length > MAX_SSF_PACKET_LENGTH:
-                        self.parse_errors += 1
+                        self._c_parse_errors.inc()
                         return  # oversized frame: poisoned
                     if len(buf) < 5 + length:
                         break
@@ -706,7 +940,7 @@ class Server:
                     try:
                         span = parse_ssf(body)
                     except Exception:
-                        self.parse_errors += 1
+                        self._c_parse_errors.inc()
                         continue
                     self.span_pipeline.handle_span(span,
                                                    ssf_format="framed")
@@ -750,12 +984,12 @@ class Server:
                 *lines, buf = buf.split(b"\n")
                 for line in lines:
                     if len(line) > limit:
-                        self.parse_errors += 1
+                        self._c_parse_errors.inc()
                         continue
                     if line:
                         self.packet_queue.put(line)
                 if len(buf) > limit:  # oversized line w/o newline: drop conn
-                    self.parse_errors += 1
+                    self._c_parse_errors.inc()
                     return
 
     def _tls_context(self):
@@ -1104,7 +1338,7 @@ class Server:
                 log.exception("flush failed")
             finally:
                 self.last_flush_done = time.time()
-                self.flush_count += 1
+                self._c_flush_count.inc()
                 req.finish(ok, detail)
 
     def _do_flush(self, state, table, stats, swapped_at):
@@ -1121,24 +1355,48 @@ class Server:
         # tracer.StartSpan("flush") + StartSpanFromContext per stage)
         from veneur_tpu.trace.tracer import Span
         root = Span("flush", service="veneur")
+        trace = self._flush_trace
+        swap_ns = int(stats.get("swap_ns", 0))
+        # h2d bytes shipped THIS interval (the aggregator counter is
+        # lifetime-cumulative; the flush worker is the only reader of
+        # _h2d_reported, so the delta needs no lock)
+        h2d_total = int(stats.get("h2d_bytes", 0))
+        h2d_delta = max(0, h2d_total - self._h2d_reported)
+        self._h2d_reported = h2d_total
+        if trace:
+            # the swap already ran on the pipeline thread before this job
+            # was queued; backdate the root by its duration and replay it
+            # as the first child so the trace covers the whole interval
+            root.start_ns -= swap_ns
+            drain = root.child("flush.ingest_drain", start_ns=root.start_ns)
+            drain.set_tag("h2d_bytes", str(h2d_delta))
+            if self.trace_client is not None:
+                self.trace_client.record(
+                    drain.finish(root.start_ns + swap_ns))
 
         def stage(name):
             return root.child(f"flush.{name}")
 
-        sp = stage("compute")
+        dev_t0 = time.perf_counter_ns()
+        sp = stage("device_update")
+        raw = None
         if self._forward_client is not None:
             flush_arrays, table, raw = self.aggregator.compute_flush(
                 state, table, self.cfg.percentiles, want_raw=True)
-            sp.client_finish(self.trace_client)
+        else:
+            flush_arrays, table = self.aggregator.compute_flush(
+                state, table, self.cfg.percentiles)
+        self._t_flush_phase.observe(time.perf_counter_ns() - dev_t0,
+                                    phase="device_update")
+        if trace:
+            sp.set_tag("h2d_bytes", str(h2d_delta))
+        sp.client_finish(self.trace_client)
+        if self._forward_client is not None:
             # fire-and-forget, concurrent with sink flushes
             # (flusher.go:84-95); _forward logs and counts its own errors,
             # and the flush thread must never block on a slow global tier
             fsp = stage("forward")
             self._spawn_aux(self._forward_traced, fsp, raw, table)
-        else:
-            flush_arrays, table = self.aggregator.compute_flush(
-                state, table, self.cfg.percentiles)
-            sp.client_finish(self.trace_client)
 
         if self.cfg.count_unique_timeseries:
             from veneur_tpu.server.flusher import unique_timeseries
@@ -1168,12 +1426,19 @@ class Server:
             generate = generate_frame
         else:
             generate = generate_intermetrics
+        fb_t0 = time.perf_counter_ns()
+        fbsp = stage("frame_build") if trace else None
         final = generate(
             flush_arrays, table,
             percentiles=self.cfg.percentiles,
             aggregates=self.cfg.aggregates,
             is_local=self.cfg.is_local,
             timestamp=ts, hostname=self.hostname)
+        self._t_flush_phase.observe(time.perf_counter_ns() - fb_t0,
+                                    phase="frame_build")
+        if fbsp is not None:
+            fbsp.set_tag("rows", str(len(final)))
+            fbsp.client_finish(self.trace_client)
         if final:
             # parallel sink flushes + barrier with a per-interval join
             # budget (flusher.go:105-115). Slow-sink containment:
@@ -1185,6 +1450,7 @@ class Server:
             #   aux set so shutdown still joins it (abandoning a thread
             #   inside gRPC/JAX at teardown aborts the process); daemon
             #   so a truly wedged one cannot block interpreter exit
+            fan_t0 = time.perf_counter_ns()
             sinks_span = stage("sinks")
             sinks_span.set_tag("metrics", str(len(final)))
             threads = []
@@ -1195,10 +1461,7 @@ class Server:
                 # so id() is stable)
                 prev = self._sink_threads.get(id(s))
                 if prev is not None and prev.is_alive():
-                    # under _sink_stats_lock now that breaker skips bump
-                    # the same counter from sink threads
-                    with self._sink_stats_lock:
-                        self.sink_flushes_skipped += 1
+                    self._c_sink_skips.inc()
                     log.warning("sink %s: previous flush still running; "
                                 "skipping this interval", s.name)
                     continue
@@ -1221,6 +1484,8 @@ class Server:
                         self._aux_threads = [
                             x for x in self._aux_threads if x.is_alive()]
                         self._aux_threads.append(t)
+            self._t_flush_phase.observe(time.perf_counter_ns() - fan_t0,
+                                        phase="sink_fanout")
             sinks_span.client_finish(self.trace_client)
             # plugins run post-flush (flusher.go:117-131)
             psp = stage("plugins") if self.plugins else None
@@ -1243,6 +1508,12 @@ class Server:
         # into its own pipeline.
         self._report_self_metrics(len(final), time.perf_counter() - flush_t0,
                                   stats, final=final)
+        # total = downstream work + the pipeline-thread swap it rode in on
+        self._t_flush_phase.observe(
+            (time.perf_counter() - flush_t0) * 1e9 + swap_ns, phase="total")
+        if trace:
+            root.set_tag("rows", str(len(final)))
+            root.set_tag("h2d_bytes", str(h2d_delta))
         root.client_finish(self.trace_client)
 
     def _forward_traced(self, span, raw, table):
@@ -1381,43 +1652,31 @@ class Server:
             samples.append(ssf_samples.timing(
                 "veneur.sink.metric_flush_total_duration_ns", total_ns / 1e9,
                 tags))
-        # resilience telemetry: retry counts (fan-out + each sink's own
-        # harness + forward), breaker state gauges, spill occupancy —
-        # all deltas vs _last_stats so an idle configuration emits nothing
-        retries = {}
-        with self._sink_stats_lock:
-            for name, n in self._fanout_retries.items():
-                retries[name] = retries.get(name, 0) + n
-            if self.forward_retries_total:
-                retries["forward"] = self.forward_retries_total
-        for s in self.metric_sinks + self.span_sinks:
-            own = getattr(s, "retries_total", 0)
-            if own:
-                retries[s.name] = retries.get(s.name, 0) + own
-        for name, total in sorted(retries.items()):
+        # resilience telemetry, read from the SAME registry collectors a
+        # /metrics scrape uses (one source of truth): retry counts as
+        # deltas vs _last_stats so an idle configuration emits nothing,
+        # breaker state + spill occupancy as point-in-time gauges
+        for lv, total in self.metrics.get(
+                "veneur.sink.retries_total").samples():
+            name = lv[0] if lv else ""
             key = f"veneur.sink.retries_total|{name}"
             delta = total - self._last_stats.get(key, 0)
             self._last_stats[key] = total
             if delta:
                 samples.append(ssf_samples.count(
                     "veneur.sink.retries_total", delta, {"sink": name}))
-        breakers = [(s.name, self._sink_breakers[id(s)])
-                    for s in self.metric_sinks + self.span_sinks
-                    if id(s) in self._sink_breakers]
-        if self._forward_breaker is not None:
-            breakers.append(("forward", self._forward_breaker))
-        for name, breaker in breakers:
+        for lv, v in self.metrics.get("veneur.circuit.state").samples():
             samples.append(ssf_samples.gauge(
-                "veneur.circuit.state", float(breaker.state),
-                {"sink": name}))
-        if self.forward_spill is not None:
+                "veneur.circuit.state", float(v),
+                {"sink": lv[0] if lv else ""}))
+        for _lv, v in self.metrics.get(
+                "veneur.forward.spill_bytes").samples():
             samples.append(ssf_samples.gauge(
-                "veneur.forward.spill_bytes",
-                float(self.forward_spill.bytes)))
-            cur["veneur.forward.spill.spilled_total"] = \
-                self.forward_spill.spilled_total
-            cur["veneur.forward.spill.dropped_total"] = \
-                self.forward_spill.dropped_total
+                "veneur.forward.spill_bytes", float(v)))
+        for mname in ("veneur.forward.spill.spilled_total",
+                      "veneur.forward.spill.dropped_total"):
+            for _lv, total in self.metrics.get(mname).samples():
+                cur[mname] = total
         for name, total in cur.items():
             delta = total - self._last_stats.get(name, 0)
             self._last_stats[name] = total
@@ -1527,8 +1786,7 @@ class Server:
                     self._send_forward(metrics, span)
                     if self._forward_breaker is not None:
                         self._forward_breaker.record_success()
-                    with self._reader_fold_lock:
-                        self.forward_sends_total += 1
+                    self._c_forward_sends.inc()
         except Exception as e:
             if (self._forward_breaker is not None
                     and not isinstance(e, CircuitOpenError)):
@@ -1541,10 +1799,9 @@ class Server:
                 self.forward_spill.readd(spilled)
                 self.forward_spill.add(fresh)
             # concurrent forwards (one aux thread per interval; a slow
-            # failure can overlap the next interval's) make += lossy —
-            # serialize the counter under the existing fold lock
-            with self._reader_fold_lock:
-                self.forward_errors += 1
+            # failure can overlap the next interval's) would make += lossy
+            # — the registry counter is atomic under its own lock
+            self._c_forward_errors.inc()
             if span is not None:
                 span.error = True
             log.warning("forward failed: %s", e)
@@ -1555,9 +1812,12 @@ class Server:
             # duration alert exists precisely for degraded forwards, and
             # a timed-out POST must show as a latency spike, not as an
             # absent metric.
+            dur_ns = time.perf_counter_ns() - t0
+            self._t_flush_phase.observe(dur_ns, phase="forward")
+            if span is not None and self._flush_trace:
+                span.set_tag("rows", str(n_metrics))
             with self._sink_stats_lock:
-                self._forward_stats.append(
-                    (time.perf_counter_ns() - t0, n_metrics))
+                self._forward_stats.append((dur_ns, n_metrics))
 
     def _send_forward(self, metrics, span) -> None:
         """One forward send under the retry policy. The HTTP client
@@ -1577,8 +1837,7 @@ class Server:
             return
 
         def on_retry(attempt, exc, delay):
-            with self._sink_stats_lock:
-                self.forward_retries_total += 1
+            self._c_forward_retries.inc()
             log.warning("forward attempt %d failed: %s; retrying in "
                         "%.3fs", attempt + 1, exc, delay)
 
@@ -1601,12 +1860,13 @@ class Server:
                and sink.resilience_configured)
         breaker = self._sink_breakers.get(id(sink))
         if not own and breaker is not None and not breaker.allow():
-            with self._sink_stats_lock:
-                self.sink_flushes_skipped += 1
+            self._c_sink_skips.inc()
             log.warning("sink %s: circuit %s; skipping this interval",
                         sink.name, breaker.state_name)
             return
         span = parent.child(f"flush.sink.{sink.name}") if parent else None
+        if span is not None and self._flush_trace:
+            span.set_tag("rows", str(len(metrics)))
         t0 = time.perf_counter_ns()
         ok = True
         try:
@@ -1642,6 +1902,7 @@ class Server:
             # metric_flush_total_duration_ns, tagged sink:<name>) — the
             # fan-out wraps every sink, so no sink can forget to emit
             ns = time.perf_counter_ns() - t0
+            self._t_sink_flush.observe(ns, sink=sink.name)
             with self._sink_stats_lock:
                 rows, total_ns = self._sink_flush_stats.get(
                     sink.name, (0, 0))

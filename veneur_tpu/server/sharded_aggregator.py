@@ -141,6 +141,11 @@ class ShardedAggregator(Aggregator):
         self._steps = 0
         self.processed = 0
         self.dropped_capacity = 0
+        # same device-step accounting surface as the single-device
+        # Aggregator (observability callbacks read these by getattr)
+        self.h2d_bytes = 0
+        self.step_ns = 0
+        self.steps_total = 0
 
     # -- slot routing --------------------------------------------------------
     def _local(self, kind: str, slot: int) -> Tuple[int, int]:
@@ -225,11 +230,17 @@ class ShardedAggregator(Aggregator):
         """Pack each shard's batch into its flat buffer and run the fused
         mesh step; compaction rides the in-band control word at the same
         cadence as the single-device backend (Aggregator._on_batch)."""
+        import time
+
         from veneur_tpu.aggregation.step import pack_batch
         self._steps += 1
+        self.steps_total += 1
         dc = self._steps % self.compact_every == 0
         flat = np.stack([[pack_batch(b, dc) for b in row]])  # [1, S, W]
+        self.h2d_bytes += flat.nbytes
+        t0 = time.perf_counter_ns()
         self.state = self._ingest(self.state, flat)
+        self.step_ns += time.perf_counter_ns() - t0
 
     def _on_shard_batch(self, shard: int, batch):
         self._dispatch_row([batch if i == shard else b.force_emit()

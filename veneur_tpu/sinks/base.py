@@ -111,6 +111,16 @@ class ResilientSink:
     def resilience_configured(self) -> bool:
         return self.retry_policy is not None or self.breaker is not None
 
+    def reliability_counters(self):
+        """(retries_total, posts_skipped_open) read under the harness
+        lock — the server's telemetry-registry collectors call this so
+        /metrics and the self-metric flush see consistent values."""
+        lock = getattr(self, "_resilience_lock", None)
+        if lock is None:   # configure_resilience never ran
+            return (self.retries_total, self.posts_skipped_open)
+        with lock:
+            return (self.retries_total, self.posts_skipped_open)
+
     def resilient_post(self, fn: Callable, what: str = ""):
         """Run one network call under the sink's policy/breaker. Terminal
         failure re-raises — call sites keep their existing log-and-continue

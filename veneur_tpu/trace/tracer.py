@@ -26,7 +26,8 @@ class Span:
     def __init__(self, name: str, service: str = "",
                  trace_id: Optional[int] = None,
                  parent_id: Optional[int] = None,
-                 indicator: bool = False, tags: Optional[Dict] = None):
+                 indicator: bool = False, tags: Optional[Dict] = None,
+                 start_ns: Optional[int] = None):
         self.name = name
         self.service = service
         self.trace_id = trace_id or _new_id()
@@ -35,7 +36,11 @@ class Span:
         self.indicator = indicator
         self.error = False
         self.tags = dict(tags or {})
-        self.start_ns = int(time.time() * 1e9)
+        # explicit start supports spans reconstructed after the fact (the
+        # flush trace's ingest-drain phase happens on the pipeline thread
+        # BEFORE the flush worker builds the span tree)
+        self.start_ns = (int(start_ns) if start_ns is not None
+                         else int(time.time() * 1e9))
         self.end_ns = 0
         self.samples = []
         self.log_lines = []   # LogFields/LogKV records (stored, unsent —
