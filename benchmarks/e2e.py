@@ -718,9 +718,140 @@ def config6_cardinality_stress(scale=1.0):
         srv.shutdown()
 
 
+# -- config 7: checkpoint write + restore ------------------------------------
+
+def config7_checkpoint_restore(scale=1.0):
+    """Durability cost at a 200k-name mixed shape (README §Durability):
+    snapshot write bandwidth, restore wall time, and — the acceptance
+    gate — the flush-path overhead of checkpointing every interval,
+    which must stay under 5% (the snapshot rides the flush's existing
+    device→host outputs and is encoded on a background thread, so the
+    flush only pays the handoff)."""
+    import shutil
+    import tempfile
+
+    from veneur_tpu.persistence.codec import read_manifest
+    from veneur_tpu.sinks.blackhole import BlackholeMetricSink
+
+    names_total = max(4_000, int(200_000 * scale))
+    n_c = int(names_total * 0.60)
+    n_t = int(names_total * 0.25)
+    n_g = int(names_total * 0.10)
+    n_s = names_total - n_c - n_t - n_g
+
+    def _cap(n):
+        # next power-of-two with ~25% headroom (self-telemetry rides the
+        # same tables after the first flush)
+        return 1 << max(8, int(n * 5 / 4).bit_length())
+
+    caps = dict(tpu_counter_capacity=_cap(n_c), tpu_histo_capacity=_cap(n_t),
+                tpu_gauge_capacity=_cap(n_g), tpu_set_capacity=_cap(n_s),
+                tpu_batch_counter=1 << 15, tpu_batch_histo=1 << 14,
+                tpu_batch_gauge=1 << 13, tpu_batch_set=1 << 12)
+
+    def build_payloads():
+        per = 200
+        payloads, lines = [], []
+        for fmt, n in ((b"kc%d:3|c", n_c), (b"kt%d:7.5|ms", n_t),
+                       (b"kg%d:1|g", n_g), (b"ks%d:x|s", n_s)):
+            for i in range(n):
+                lines.append(fmt % i)
+                if len(lines) >= per:
+                    payloads.append(b"\n".join(lines))
+                    lines = []
+        if lines:
+            payloads.append(b"\n".join(lines))
+        return payloads
+
+    payloads = build_payloads()
+
+    def timed_flushes(srv, cycles=3):
+        """Feed the full shape, then time ONLY the flush, per cycle.
+        Cycle 0 pays the size-bucket compiles and is discarded."""
+        walls = []
+        for cycle in range(cycles):
+            phase(f"cycle{cycle}")
+            base = srv.aggregator.processed
+            _feed_queue(srv, payloads)
+            _drain(srv, base + names_total)
+            t0 = time.perf_counter()
+            _flush_checked(srv, timeout=WARM_TIMEOUT if cycle == 0
+                           else FLUSH_WAIT)
+            walls.append(time.perf_counter() - t0)
+        return walls[1:]   # steady state only
+
+    ckpt_root = tempfile.mkdtemp(prefix="veneur-bench-ckpt-")
+    try:
+        # pass 1: checkpointing OFF — the flush-wall baseline
+        phase("plain_server")
+        srv = _mk_server([BlackholeMetricSink()], **caps)
+        try:
+            _warm(srv, [b"kc0:1|c"])
+            plain_walls = timed_flushes(srv)
+        finally:
+            srv.shutdown()
+
+        # pass 2: checkpoint every flush — same shape, same cycles
+        phase("ckpt_server")
+        srv = _mk_server([BlackholeMetricSink()], checkpoint_dir=ckpt_root,
+                         checkpoint_interval_flushes=1,
+                         checkpoint_on_shutdown=False, **caps)
+        try:
+            _warm(srv, [b"kc0:1|c"])
+            ckpt_walls = timed_flushes(srv)
+            if not srv._ckpt_writer.wait_idle(WARM_TIMEOUT):
+                raise RuntimeError("checkpoint writer never went idle")
+            writes = srv._ckpt_writer.writes
+            if not writes:
+                raise RuntimeError("no checkpoint was written")
+            manifest = read_manifest(srv._ckpt_writer.last_path)
+            snap_bytes = int(srv._c_ckpt_bytes.value())
+            ((_, wstat),) = srv._t_ckpt_write.snapshot(qs=())
+            write_s = wstat.sum / 1e9
+        finally:
+            srv.shutdown()
+
+        # pass 3: restore wall time through the real startup path
+        phase("restore_server")
+        srv = _mk_server([BlackholeMetricSink()], checkpoint_dir=ckpt_root,
+                         checkpoint_on_shutdown=False, **caps)
+        try:
+            t0 = time.perf_counter()
+            srv._restore_from_checkpoint()
+            restore_s = time.perf_counter() - t0
+            restored = srv.aggregator.processed
+            if int(srv._c_ckpt_restores.value()) != 1:
+                raise RuntimeError("restore did not complete")
+        finally:
+            srv.shutdown()
+
+        plain = float(np.mean(plain_walls))
+        ckpt = float(np.mean(ckpt_walls))
+        overhead = (ckpt - plain) / plain
+        return {
+            "config": 7, "name": "checkpoint_restore",
+            "names": names_total,
+            "mix": {"counter": n_c, "timer": n_t, "gauge": n_g, "set": n_s},
+            "snapshot_rows": sum(manifest["rows"].values()),
+            "snapshot_bytes": snap_bytes,
+            "snapshot_writes": int(writes),
+            "snapshot_write_mb_per_sec": round(
+                snap_bytes / 1e6 / write_s, 1) if write_s > 0 else None,
+            "restore_seconds": round(restore_s, 3),
+            "restored_keys": int(restored),
+            "flush_wall_plain_seconds": round(plain, 3),
+            "flush_wall_ckpt_seconds": round(ckpt, 3),
+            "flush_overhead_fraction": round(overhead, 4),
+            "flush_overhead_under_5pct": overhead < 0.05,
+        }
+    finally:
+        shutil.rmtree(ckpt_root, ignore_errors=True)
+
+
 CONFIGS = {1: config1_counter_replay, 2: config2_zipf_timers,
            3: config3_set_cardinality, 4: config4_global_merge,
-           5: config5_span_firehose, 6: config6_cardinality_stress}
+           5: config5_span_firehose, 6: config6_cardinality_stress,
+           7: config7_checkpoint_restore}
 
 # Per-config subprocess budget: backend init + first XLA compiles of the
 # config's size buckets (~tens of seconds each on the tunneled chip) +

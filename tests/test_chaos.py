@@ -218,3 +218,129 @@ def test_egress_paths_have_no_silent_excepts():
     proc = subprocess.run([sys.executable, str(script)],
                           capture_output=True, text=True, timeout=60)
     assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# -- durability chaos (veneur_tpu/persistence/) -----------------------------
+
+def _kr_lines(part):
+    """One interval's app traffic, deterministic per part."""
+    import numpy as np
+    rng = np.random.RandomState(7 + part)
+    # counter magnitudes kept well inside float32-exact integer range:
+    # the equivalence here is restore vs never-killed, not f32 rounding
+    lines = [f"kr.c{i}:{10007 + 3 * i + part}|c".encode()
+             for i in range(8)]
+    lines.append(f"kr.g:{10 + part}|g".encode())
+    lines += [f"kr.t:{rng.randint(1, 100000)}|ms".encode()
+              for _ in range(60)]
+    lines += [f"kr.s:m{part}-{i}|s".encode() for i in range(40)]
+    return lines
+
+
+_KR_PER_PART = 109   # 8 counters + 1 gauge + 60 timers + 40 set members
+
+
+def _kr_feed(srv, part, expect_processed):
+    _send_udp(srv.local_addr(), _kr_lines(part))
+    _wait_processed(srv, expect_processed)
+
+
+def _kr_assert_equal(ref, got):
+    """Kill/restart acceptance: counters, gauge, sets exact; t-digest
+    percentiles within 1e-6."""
+    import numpy as np
+    for i in range(8):
+        assert got[f"kr.c{i}"].value == ref[f"kr.c{i}"].value
+    assert got["kr.g"].value == ref["kr.g"].value
+    assert got["kr.s"].value == ref["kr.s"].value
+    for agg in ("min", "max", "count"):
+        assert got[f"kr.t.{agg}"].value == ref[f"kr.t.{agg}"].value
+    for q in ("50percentile", "99percentile"):
+        np.testing.assert_allclose(got[f"kr.t.{q}"].value,
+                                   ref[f"kr.t.{q}"].value,
+                                   rtol=1e-6, atol=1e-6)
+
+
+@pytest.mark.parametrize("backend_kw", [{}, {"tpu_n_shards": 8}],
+                         ids=["single", "sharded"])
+def test_kill_and_restart_equivalence(backend_kw, tmp_path):
+    """The ISSUE's headline acceptance: feed A, flush (checkpoint rides
+    it), KILL (no final checkpoint), restart with restore_on_start, feed
+    B, flush — the sink sees what a never-killed server fed A+B would
+    have flushed. Both aggregation backends."""
+    base = dict(native_ingest=False, **backend_kw)
+
+    ref_sink = DebugMetricSink()
+    ref = Server(small_config(**base), metric_sinks=[ref_sink])
+    ref.start()
+    try:
+        _kr_feed(ref, 0, _KR_PER_PART)
+        _kr_feed(ref, 1, 2 * _KR_PER_PART)
+        assert ref.trigger_flush()
+    finally:
+        ref.shutdown()
+    ref_m = by_name(ref_sink.flushed)
+
+    # victim: checkpoint every flush, and DON'T checkpoint at shutdown —
+    # the shutdown below stands in for a kill -9 right after the flush
+    sink1 = DebugMetricSink()
+    srv1 = Server(small_config(checkpoint_dir=str(tmp_path / "ckpt"),
+                               checkpoint_interval_flushes=1,
+                               checkpoint_on_shutdown=False, **base),
+                  metric_sinks=[sink1])
+    srv1.start()
+    try:
+        _kr_feed(srv1, 0, _KR_PER_PART)
+        assert srv1.trigger_flush()
+        assert srv1._ckpt_writer.wait_idle(30.0)
+        assert srv1._ckpt_writer.writes == 1
+    finally:
+        srv1.shutdown()
+
+    sink2 = DebugMetricSink()
+    srv2 = Server(small_config(checkpoint_dir=str(tmp_path / "ckpt"),
+                               restore_on_start=True,
+                               checkpoint_on_shutdown=False, **base),
+                  metric_sinks=[sink2])
+    srv2.start()
+    try:
+        restored = srv2.aggregator.processed
+        assert restored > 0 and srv2._c_ckpt_restores.value() == 1
+        _kr_feed(srv2, 1, restored + _KR_PER_PART)
+        assert srv2.trigger_flush()
+    finally:
+        srv2.shutdown()
+
+    _kr_assert_equal(ref_m, by_name(sink2.flushed))
+
+
+def test_checkpoint_write_fault_degrades_never_fails_flush(tmp_path):
+    """An injected checkpoint.write fault: the flush still succeeds and
+    reaches the sink, the failure is counted, no partial checkpoint
+    lands, and the NEXT interval checkpoints normally."""
+    from veneur_tpu.persistence import list_checkpoints
+    from veneur_tpu.reliability.faults import CHECKPOINT_WRITE
+
+    sink = DebugMetricSink()
+    srv = Server(small_config(checkpoint_dir=str(tmp_path / "ckpt"),
+                              checkpoint_interval_flushes=1,
+                              checkpoint_on_shutdown=False,
+                              native_ingest=False),
+                 metric_sinks=[sink])
+    srv.start()
+    try:
+        FAULTS.arm(CHECKPOINT_WRITE, error=True, times=1)
+        _send_udp(srv.local_addr(), [b"dur.count:4|c"])
+        _wait_processed(srv, 1)
+        assert srv.trigger_flush()            # flush unharmed
+        assert srv._ckpt_writer.wait_idle(30.0)
+        assert FAULTS.fired(CHECKPOINT_WRITE) == 1
+        assert srv._ckpt_writer.failures == 1
+        assert list_checkpoints(str(tmp_path / "ckpt")) == []
+        assert by_name(sink.flushed)["dur.count"].value == 4.0
+
+        assert srv.trigger_flush()            # next interval recovers
+        assert srv._ckpt_writer.wait_idle(30.0)
+        assert len(list_checkpoints(str(tmp_path / "ckpt"))) == 1
+    finally:
+        srv.shutdown()

@@ -1,0 +1,590 @@
+"""Durability subsystem tests (veneur_tpu/persistence/; README
+§Durability).
+
+The load-bearing property is GOLDEN ROUND-TRIP EQUIVALENCE: feed A,
+checkpoint, restore into a fresh aggregator, feed B — the flush must
+equal a fault-free aggregator fed A then B. Counters/gauges/status/sets
+exactly, t-digest quantiles within 1e-6. Everything else here defends
+the machinery that property rides on: CRC/schema rejection + quarantine,
+the async writer's retention and fault containment, the spill buffer's
+wire format, the schema-drift lint, and the operator CLI.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import pathlib
+
+import numpy as np
+import pytest
+
+from tests.test_server import (_send_udp, _wait_processed, _wait_until,
+                               by_name, small_config)
+from veneur_tpu.aggregation.host import BatchSpec
+from veneur_tpu.aggregation.state import TableSpec
+from veneur_tpu.persistence import (CheckpointWriter, CorruptSnapshot,
+                                    build_snapshot, fold_snapshot,
+                                    list_checkpoints, load_dir,
+                                    restore_latest, schema_hash,
+                                    verify_dir)
+from veneur_tpu.persistence.codec import (CHUNKS_NAME, MANIFEST_NAME,
+                                          encode_to_dir)
+from veneur_tpu.proto import metricpb_pb2 as mpb
+from veneur_tpu.reliability.faults import CHECKPOINT_WRITE, FAULTS
+from veneur_tpu.reliability.spill import (ForwardSpillBuffer,
+                                          parse_spill_bytes)
+from veneur_tpu.samplers.parser import UDPMetric
+from veneur_tpu.server.aggregator import Aggregator
+from veneur_tpu.server.server import Server
+from veneur_tpu.sinks.debug import DebugMetricSink
+
+PERC = [0.5, 0.99]
+BSPEC = BatchSpec(counter=512, gauge=128, status=16, set=64, histo=512)
+
+# three spec sizes; every capacity divides by 8 so the same specs drive
+# the sharded backend
+SPECS = {
+    "small": TableSpec(counter_capacity=64, gauge_capacity=32,
+                       status_capacity=8, set_capacity=8,
+                       histo_capacity=32),
+    "medium": TableSpec(counter_capacity=256, gauge_capacity=64,
+                        status_capacity=16, set_capacity=16,
+                        histo_capacity=64),
+    "large": TableSpec(counter_capacity=512, gauge_capacity=128,
+                       status_capacity=32, set_capacity=32,
+                       histo_capacity=128),
+}
+
+
+def _mk_agg(backend: str, spec: TableSpec):
+    if backend == "sharded":
+        from veneur_tpu.server.sharded_aggregator import ShardedAggregator
+        return ShardedAggregator(spec, BSPEC, n_shards=8)
+    return Aggregator(spec, BSPEC)
+
+
+def _feed(agg, part: int, n_counter=12, n_gauge=6, n_timer=200):
+    rng = np.random.RandomState(1000 + part)
+    for i in range(n_counter):
+        agg.process_metric(UDPMetric(
+            name=f"c{i}", type="counter", digest=i * 7 + 3,
+            value=float((1 << 24) + i * 3 + part), tags=("t:1",),
+            joined_tags="t:1"))
+    for i in range(n_gauge):
+        agg.process_metric(UDPMetric(
+            name=f"g{i}", type="gauge", digest=i * 5 + 1,
+            value=float(i * 10 + part)))
+    agg.process_metric(UDPMetric(name="st", type="status", value=1.0,
+                                 message=f"msg{part}"))
+    for i in range(150):
+        agg.process_metric(UDPMetric(name="s0", type="set", digest=9,
+                                     value=f"member-{part}-{i}"))
+    for v in rng.gamma(2.0, 10.0, size=n_timer):
+        agg.process_metric(UDPMetric(name="t0", type="timer", digest=11,
+                                     value=float(v)))
+
+
+def _result_map(res, table):
+    out = {}
+    for kind in ("counter", "gauge", "status", "set", "histogram"):
+        for i, (_slot, meta) in enumerate(table.get_meta(kind)):
+            key = (kind, meta.name)
+            if kind == "counter":
+                out[key] = float(res["counter"][i])
+            elif kind == "gauge":
+                out[key] = float(res["gauge"][i])
+            elif kind == "status":
+                out[key] = float(res["status"][i])
+            elif kind == "set":
+                out[key] = float(res["set_estimate"][i])
+            else:
+                out[key] = (np.asarray(res["histo_quantiles"][i]),
+                            float(res["histo_count"][i]),
+                            float(res["histo_min"][i]),
+                            float(res["histo_max"][i]))
+    return out
+
+
+def _snapshot_of(agg, spec, *, agg_kind, n_shards):
+    state, table = agg.swap()
+    res, table, raw = agg.compute_flush(state, table, PERC, want_raw=True)
+    return build_snapshot(spec, table, res, raw, agg_kind=agg_kind,
+                          n_shards=n_shards, interval_ts=123,
+                          hostname="testbox")
+
+
+def _assert_equivalent(ref_map, got_map):
+    assert set(got_map) >= set(ref_map)
+    for key, want in sorted(ref_map.items()):
+        got = got_map[key]
+        kind = key[0]
+        if kind in ("counter", "gauge", "status", "set"):
+            assert got == want, (key, want, got)
+        else:
+            qs_w, n_w, mn_w, mx_w = want
+            qs_g, n_g, mn_g, mx_g = got
+            np.testing.assert_allclose(qs_g, qs_w, rtol=1e-6, atol=1e-6,
+                                       err_msg=str(key))
+            assert n_g == n_w and mn_g == mn_w and mx_g == mx_w, key
+
+
+# -- tentpole: golden round-trip equivalence --------------------------------
+
+@pytest.mark.parametrize("backend,size", [
+    ("single", "small"), ("single", "medium"), ("single", "large"),
+    ("sharded", "small"), ("sharded", "medium"), ("sharded", "large"),
+])
+def test_golden_roundtrip(backend, size, tmp_path):
+    """feed A -> checkpoint -> restore -> feed B == feed A+B, for every
+    table size and both aggregation backends. Counters land at 2^24
+    magnitudes, where a single-float staging lane would already lose
+    increments — this asserts the two-float restore path end to end."""
+    spec = SPECS[size]
+    n_shards = 8 if backend == "sharded" else 1
+
+    ref = _mk_agg(backend, spec)
+    _feed(ref, 0)
+    _feed(ref, 1)
+    ref_res, ref_table = ref.flush(PERC)
+    ref_map = _result_map(ref_res, ref_table)
+
+    a1 = _mk_agg(backend, spec)
+    _feed(a1, 0)
+    snap = _snapshot_of(a1, spec, agg_kind=backend, n_shards=n_shards)
+    ckpt = tmp_path / "ckpt-00000000"
+    ckpt.mkdir()
+    encode_to_dir(str(ckpt), snap)
+    loaded = load_dir(str(ckpt))
+
+    a2 = _mk_agg(backend, spec)
+    folded = fold_snapshot(a2, loaded)
+    assert folded == sum(len(v) for v in loaded["tables"].values())
+    _feed(a2, 1)
+    res2, table2 = a2.flush(PERC)
+    _assert_equivalent(ref_map, _result_map(res2, table2))
+
+
+def test_roundtrip_across_backends(tmp_path):
+    """A sharded snapshot folds into a single-device aggregator (and the
+    reverse) — the snapshot is backend-neutral key/sketch state, not a
+    device-layout dump."""
+    spec = SPECS["medium"]
+    ref = _mk_agg("single", spec)
+    _feed(ref, 0)
+    _feed(ref, 1)
+    ref_map = _result_map(*ref.flush(PERC))
+
+    src = _mk_agg("sharded", spec)
+    _feed(src, 0)
+    snap = _snapshot_of(src, spec, agg_kind="sharded", n_shards=8)
+    d = tmp_path / "x"
+    d.mkdir()
+    encode_to_dir(str(d), snap)
+
+    dst = _mk_agg("single", spec)
+    fold_snapshot(dst, load_dir(str(d)))
+    _feed(dst, 1)
+    _assert_equivalent(ref_map, _result_map(*dst.flush(PERC)))
+
+
+# -- codec: rejection + quarantine ------------------------------------------
+
+def _write_ckpt(root: pathlib.Path, seq: int, snap) -> pathlib.Path:
+    d = root / f"ckpt-{seq:08d}"
+    d.mkdir(parents=True)
+    encode_to_dir(str(d), snap)
+    return d
+
+
+@pytest.fixture(scope="module")
+def small_snap():
+    spec = SPECS["small"]
+    agg = _mk_agg("single", spec)
+    _feed(agg, 0, n_timer=40)
+    return _snapshot_of(agg, spec, agg_kind="single", n_shards=1)
+
+
+def test_corrupt_chunk_rejected_and_quarantined(tmp_path, small_snap):
+    d = _write_ckpt(tmp_path, 0, small_snap)
+    blob = bytearray((d / CHUNKS_NAME).read_bytes())
+    blob[len(blob) // 2] ^= 0xFF
+    (d / CHUNKS_NAME).write_bytes(bytes(blob))
+    with pytest.raises(CorruptSnapshot, match="CRC"):
+        load_dir(str(d))
+    hits = []
+    assert restore_latest(str(tmp_path), on_corrupt=lambda: hits.append(1)) \
+        is None
+    assert hits == [1]
+    assert not d.exists()
+    assert (tmp_path / "quarantine" / d.name / CHUNKS_NAME).exists()
+
+
+def test_truncated_manifest_rejected_falls_back(tmp_path, small_snap):
+    good = _write_ckpt(tmp_path, 0, small_snap)
+    bad = _write_ckpt(tmp_path, 1, small_snap)
+    mpath = bad / MANIFEST_NAME
+    mpath.write_bytes(mpath.read_bytes()[:40])
+    found = restore_latest(str(tmp_path))
+    assert found is not None
+    _snap, path = found
+    assert path == str(good)          # newest was rejected, fell back
+    assert not bad.exists()           # ... and quarantined
+
+
+def test_schema_hash_mismatch_rejected(tmp_path, small_snap):
+    d = _write_ckpt(tmp_path, 0, small_snap)
+    manifest = json.loads((d / MANIFEST_NAME).read_bytes())
+    manifest["schema_hash"] = "0" * 64
+    (d / MANIFEST_NAME).write_bytes(json.dumps(manifest).encode())
+    with pytest.raises(CorruptSnapshot, match="schema hash"):
+        verify_dir(str(d))
+
+
+def test_truncated_chunks_file_rejected(tmp_path, small_snap):
+    d = _write_ckpt(tmp_path, 0, small_snap)
+    blob = (d / CHUNKS_NAME).read_bytes()
+    (d / CHUNKS_NAME).write_bytes(blob[:len(blob) // 2])
+    with pytest.raises(CorruptSnapshot):
+        verify_dir(str(d))
+
+
+def test_in_flight_write_is_not_a_checkpoint(tmp_path, small_snap):
+    """A directory without a manifest (crash mid-write) is invisible to
+    listing and restore."""
+    (tmp_path / ".tmp-ckpt-00000007").mkdir()
+    (tmp_path / "ckpt-00000003").mkdir()   # manifest never landed
+    _write_ckpt(tmp_path, 1, small_snap)
+    ckpts = list_checkpoints(str(tmp_path))
+    assert [seq for seq, _ in ckpts] == [1]
+
+
+# -- async writer: retention, latest-wins, containment ----------------------
+
+def test_writer_async_write_and_retention_gc(tmp_path, small_snap):
+    w = CheckpointWriter(str(tmp_path), retain=2, fsync=False)
+    try:
+        for _ in range(4):
+            w.submit(small_snap)
+            assert w.wait_idle(30.0)
+        assert w.writes == 4 and w.failures == 0
+        seqs = [seq for seq, _ in list_checkpoints(str(tmp_path))]
+        assert seqs == [2, 3]          # newest `retain`, oldest GC'd
+        assert w.last_path.endswith("ckpt-00000003")
+        assert verify_dir(w.last_path)["rows"] == \
+            {k: len(v) for k, v in small_snap["tables"].items()}
+    finally:
+        w.close()
+
+
+def test_writer_resumes_sequence_after_restart(tmp_path, small_snap):
+    w = CheckpointWriter(str(tmp_path), retain=5, fsync=False)
+    try:
+        assert w.write_sync(small_snap)
+    finally:
+        w.close()
+    w2 = CheckpointWriter(str(tmp_path), retain=5, fsync=False)
+    try:
+        assert w2.write_sync(small_snap)
+        assert [s for s, _ in list_checkpoints(str(tmp_path))] == [0, 1]
+    finally:
+        w2.close()
+
+
+def test_writer_fault_contained_not_raised(tmp_path, small_snap):
+    """An injected checkpoint.write fault is counted, leaves no partial
+    checkpoint behind, and the NEXT write succeeds — durability degrades,
+    nothing crashes (the ISSUE's containment acceptance)."""
+    FAULTS.reset()
+    w = CheckpointWriter(str(tmp_path), retain=3, fsync=False)
+    try:
+        FAULTS.arm(CHECKPOINT_WRITE, error=True, times=1)
+        assert w.write_sync(small_snap) is False
+        assert w.failures == 1 and w.writes == 0
+        assert list_checkpoints(str(tmp_path)) == []
+        assert w.write_sync(small_snap) is True
+        assert [s for s, _ in list_checkpoints(str(tmp_path))] == [0]
+    finally:
+        FAULTS.reset()
+        w.close()
+
+
+# -- spill buffer wire format (satellite) -----------------------------------
+
+def _metric(name: str, value: int) -> "mpb.Metric":
+    m = mpb.Metric()
+    m.name = name
+    m.type = mpb.Type.Value("Counter")
+    m.counter.value = value
+    return m
+
+
+def test_spill_roundtrip_preserves_stamps_and_caps():
+    now = [100.0]
+    buf = ForwardSpillBuffer(4096, max_age_s=60.0, clock=lambda: now[0])
+    buf.add([_metric("a", 1), _metric("b", 2)])
+    now[0] = 130.0
+    buf.add([_metric("c", 3)])
+    data = buf.to_bytes()
+
+    entries, (max_bytes, max_age_s) = parse_spill_bytes(data)
+    assert (max_bytes, max_age_s) == (4096, 60.0)
+    assert [ts for ts, _ in entries] == [100.0, 100.0, 130.0]
+    assert [m.name for _, m in entries] == ["a", "b", "c"]
+
+    buf2 = ForwardSpillBuffer.from_bytes(data, clock=lambda: now[0])
+    assert len(buf2) == 3 and buf2.bytes == buf.bytes
+    drained = buf2.drain(now=130.0)
+    assert [ts for ts, _ in drained] == [100.0, 100.0, 130.0]
+
+
+def test_spill_restored_expired_entries_counted_at_drain():
+    """Entries already past max_age_s still re-enter from a snapshot and
+    expire into dropped_age at the next drain — the drop accounting a
+    fault-free run would have produced survives the restart."""
+    buf = ForwardSpillBuffer(4096, max_age_s=60.0, clock=lambda: 0.0)
+    buf.add([_metric("old", 1)], now=0.0)
+    data = buf.to_bytes()
+    buf2 = ForwardSpillBuffer.from_bytes(data, clock=lambda: 1000.0)
+    assert len(buf2) == 1             # re-enters...
+    assert buf2.drain(now=1000.0) == []
+    assert buf2.dropped_age == 1      # ...and is charged at drain
+    assert buf2.dropped_total == 1
+
+
+def test_spill_readd_lands_left_of_concurrent_adds():
+    """drain()/readd() around a concurrent add(): re-added entries are
+    OLDER and must sit left of the fresh ones, or the byte cap would
+    evict fresh payloads while keeping stale."""
+    now = [10.0]
+    buf = ForwardSpillBuffer(10_000, max_age_s=600.0,
+                             clock=lambda: now[0])
+    buf.add([_metric("old1", 1), _metric("old2", 2)])
+    drained = buf.drain()
+    now[0] = 20.0
+    buf.add([_metric("fresh", 3)])    # lands while the retry is out
+    buf.readd(drained)                # retry failed; entries return
+    out = buf.drain()
+    assert [m.name for _, m in out] == ["old1", "old2", "fresh"]
+    assert [ts for ts, _ in out] == [10.0, 10.0, 20.0]
+
+
+def test_spill_bad_bytes_raise_value_error():
+    with pytest.raises(ValueError):
+        parse_spill_bytes(b"NOTSPILL")
+    good = ForwardSpillBuffer(64, clock=lambda: 0.0)
+    good.add([_metric("x", 1)], now=0.0)
+    data = good.to_bytes()
+    with pytest.raises(ValueError):
+        parse_spill_bytes(data[:len(data) - 3])
+
+
+# -- server integration ------------------------------------------------------
+
+def _persist_config(tmp_path, **kw):
+    """Server-level persistence tests pin the pure-Python ingest path:
+    restore folds through Aggregator.restore_metric, and the assertion
+    surface (slot layout) must match the backend under test."""
+    defaults = dict(checkpoint_dir=str(tmp_path / "ckpt"),
+                    native_ingest=False)
+    defaults.update(kw)
+    return small_config(**defaults)
+
+
+def test_checkpoint_off_by_default():
+    srv = Server(small_config(), metric_sinks=[DebugMetricSink()])
+    assert srv._ckpt_writer is None
+    srv.start()
+    try:
+        _send_udp(srv.local_addr(), [b"plain.count:1|c"])
+        _wait_processed(srv, 1)
+        assert srv.trigger_flush()
+    finally:
+        srv.shutdown()
+
+
+def test_server_periodic_checkpoint_and_metrics(tmp_path):
+    srv = Server(_persist_config(tmp_path, checkpoint_interval_flushes=1,
+                                 checkpoint_on_shutdown=False),
+                 metric_sinks=[DebugMetricSink()])
+    srv.start()
+    try:
+        _send_udp(srv.local_addr(), [b"p.count:5|c", b"p.timer:12|ms"])
+        _wait_processed(srv, 2)
+        assert srv.trigger_flush()
+        assert srv._ckpt_writer.wait_idle(30.0)
+        ckpts = list_checkpoints(str(tmp_path / "ckpt"))
+        assert len(ckpts) == 1
+        manifest = verify_dir(ckpts[0][1])
+        assert manifest["rows"]["counter"] >= 1
+        assert manifest["rows"]["histo"] >= 1
+        assert srv._c_ckpt_writes.value() >= 1
+        assert srv._c_ckpt_bytes.value() > 0
+    finally:
+        srv.shutdown()
+
+
+def test_server_interval_flushes_cadence(tmp_path):
+    """checkpoint_interval_flushes=2: flush #1 skips, flush #2 writes."""
+    srv = Server(_persist_config(tmp_path, checkpoint_interval_flushes=2,
+                                 checkpoint_on_shutdown=False),
+                 metric_sinks=[DebugMetricSink()])
+    srv.start()
+    try:
+        _send_udp(srv.local_addr(), [b"cad.count:1|c"])
+        _wait_processed(srv, 1)
+        assert srv.trigger_flush()
+        assert srv._ckpt_writer.wait_idle(30.0)
+        assert list_checkpoints(str(tmp_path / "ckpt")) == []
+        assert srv.trigger_flush()
+        assert srv._ckpt_writer.wait_idle(30.0)
+        assert len(list_checkpoints(str(tmp_path / "ckpt"))) == 1
+    finally:
+        srv.shutdown()
+
+
+def test_server_graceful_shutdown_checkpoints_tail_only(tmp_path):
+    """Graceful restart is exactly-once: the final checkpoint holds ONLY
+    the unflushed tail, so data already flushed to sinks is not replayed
+    into the next incarnation."""
+    sink1 = DebugMetricSink()
+    srv = Server(_persist_config(tmp_path, checkpoint_interval_flushes=1),
+                 metric_sinks=[sink1])
+    srv.start()
+    _send_udp(srv.local_addr(), [b"flushed.count:7|c"])
+    _wait_processed(srv, 1)
+    assert srv.trigger_flush()        # interval 1 reaches the sink...
+    assert srv._ckpt_writer.wait_idle(30.0)
+    _send_udp(srv.local_addr(), [b"tail.count:3|c"])
+    # self-telemetry from flush 1 loops back into `processed`, so wait
+    # for the KEY, not a count — shutdown must not race the datagram
+    _wait_until(lambda: ("counter", "tail.count", "") in
+                srv.aggregator.table.tables["counter"].by_key,
+                what="tail.count staged")
+    srv.shutdown()                    # ...tail never flushed; final ckpt
+    assert by_name(sink1.flushed)["flushed.count"].value == 7.0
+
+    sink2 = DebugMetricSink()
+    srv2 = Server(_persist_config(tmp_path, restore_on_start=True),
+                  metric_sinks=[sink2])
+    srv2.start()
+    try:
+        _wait_until(lambda: srv2.aggregator.processed >= 1,
+                    what="restore fold")
+        assert srv2._c_ckpt_restores.value() == 1
+        assert srv2.trigger_flush()
+        m = by_name(sink2.flushed)
+        assert m["tail.count"].value == 3.0
+        assert "flushed.count" not in m   # no double count downstream
+    finally:
+        srv2.shutdown()
+
+
+def test_server_restore_quarantines_corrupt_and_cold_starts(tmp_path):
+    root = tmp_path / "ckpt"
+    srv = Server(_persist_config(tmp_path, checkpoint_interval_flushes=1,
+                                 checkpoint_on_shutdown=False),
+                 metric_sinks=[DebugMetricSink()])
+    srv.start()
+    _send_udp(srv.local_addr(), [b"x.count:1|c"])
+    _wait_processed(srv, 1)
+    assert srv.trigger_flush()
+    assert srv._ckpt_writer.wait_idle(30.0)
+    srv.shutdown()
+    (seq, path), = list_checkpoints(str(root))
+    blob = bytearray(pathlib.Path(path, CHUNKS_NAME).read_bytes())
+    blob[0] ^= 0xFF
+    pathlib.Path(path, CHUNKS_NAME).write_bytes(bytes(blob))
+
+    srv2 = Server(_persist_config(tmp_path, restore_on_start=True),
+                  metric_sinks=[DebugMetricSink()])
+    srv2.start()
+    try:
+        assert srv2._c_ckpt_corrupt.value() == 1
+        assert srv2._c_ckpt_restores.value() == 0
+        assert srv2.aggregator.processed == 0      # cold start
+        assert (root / "quarantine").is_dir()
+        # the poisoned server still serves
+        _send_udp(srv2.local_addr(), [b"fresh.count:2|c"])
+        _wait_processed(srv2, 1)
+        assert srv2.trigger_flush()
+    finally:
+        srv2.shutdown()
+
+
+# -- lints + CLI (satellites) -----------------------------------------------
+
+def test_snapshot_schema_lint_passes():
+    script = (pathlib.Path(__file__).resolve().parent.parent
+              / "scripts" / "check_snapshot_schema.py")
+    proc = subprocess.run([sys.executable, str(script)],
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_schema_hash_is_pinned():
+    from veneur_tpu.persistence.codec import (SNAPSHOT_FORMAT_VERSION,
+                                              _SCHEMA_PINS)
+    assert _SCHEMA_PINS[SNAPSHOT_FORMAT_VERSION] == schema_hash()
+
+
+def test_cli_inspect_and_verify(tmp_path, small_snap, capsys):
+    from veneur_tpu.cli.checkpoint import main as ckpt_main
+    _write_ckpt(tmp_path, 0, small_snap)
+    _write_ckpt(tmp_path, 1, small_snap)
+    assert ckpt_main(["inspect", str(tmp_path), "--json"]) == 0
+    desc = json.loads(capsys.readouterr().out)
+    assert len(desc) == 2
+    assert desc[0]["live_keys"] == sum(
+        len(v) for v in small_snap["tables"].values())
+    assert ckpt_main(["verify", str(tmp_path)]) == 0
+    capsys.readouterr()
+
+    # corrupt the newest: verify fails loudly, names the culprit
+    bad = tmp_path / "ckpt-00000001" / CHUNKS_NAME
+    blob = bytearray(bad.read_bytes())
+    blob[-1] ^= 0xFF
+    bad.write_bytes(bytes(blob))
+    assert ckpt_main(["verify", str(tmp_path)]) == 1
+    out = capsys.readouterr().out
+    assert "ckpt-00000000: OK" in out and "CORRUPT" in out
+
+
+def test_atomic_append_never_tears(tmp_path):
+    """sinks/localfile.py satellite: append via temp+rename leaves the
+    full previous content plus the new bytes, and a reader never sees a
+    half-written file (the path is always a complete rename target)."""
+    from veneur_tpu.utils.atomicio import atomic_append_bytes
+    p = tmp_path / "flush.tsv"
+    atomic_append_bytes(str(p), b"row1\n")
+    atomic_append_bytes(str(p), b"row2\n")
+    assert p.read_bytes() == b"row1\nrow2\n"
+    assert not [f for f in os.listdir(tmp_path) if f != "flush.tsv"]
+
+
+def test_s3_staging_keeps_object_on_failed_upload(tmp_path):
+    from veneur_tpu.plugins.s3 import S3Plugin
+    from veneur_tpu.samplers.intermetric import COUNTER, InterMetric
+
+    class _FlakyClient:
+        def __init__(self):
+            self.calls = 0
+
+        def put_object(self, Bucket, Key, Body):
+            self.calls += 1
+            if self.calls == 1:
+                raise RuntimeError("s3 down")
+
+    client = _FlakyClient()
+    plug = S3Plugin("bucket", "us-east-1", "testbox", client=client,
+                    staging_dir=str(tmp_path / "staging"))
+    metrics = [InterMetric(name="s.count", timestamp=1, value=2.0,
+                           tags=[], type=COUNTER)]
+    with pytest.raises(RuntimeError):
+        plug.flush(metrics)
+    staged = os.listdir(tmp_path / "staging")
+    assert len(staged) == 1           # failed upload: object kept whole
+    plug.flush(metrics)
+    assert client.calls == 2
+    # the second flush stages its own ts-named object, then unlinks it
+    assert len(os.listdir(tmp_path / "staging")) <= 1

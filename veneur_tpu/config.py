@@ -119,6 +119,15 @@ class Config:
     forward_spill_max_age_s: float = 60.0
     fault_injection: str = ""          # chaos spec (reliability/faults.py)
 
+    # durability layer (veneur_tpu/persistence/; README §Durability).
+    # An empty checkpoint_dir keeps the whole subsystem inert — no
+    # writer thread, no restore scan, no behavior change.
+    checkpoint_dir: str = ""           # checkpoint root ("" = off)
+    checkpoint_interval_flushes: int = 1   # flushes between checkpoints
+    checkpoint_retain: int = 3         # newest N checkpoints kept on disk
+    restore_on_start: bool = False     # fold the newest valid snapshot
+    checkpoint_on_shutdown: bool = True    # final snapshot of the tail
+
     # observability (veneur_tpu/observability/). Both switches default
     # OFF with zero hot-path overhead (a single attribute check / a 404):
     # the telemetry registry itself always runs — it IS the counter store.
@@ -225,6 +234,9 @@ class Config:
     aws_secret_access_key: str = ""
     aws_region: str = ""
     aws_s3_bucket: str = ""
+    # local durable staging for S3 objects (empty = upload-only, the
+    # reference behavior); see plugins/s3.py and README §Durability
+    aws_s3_staging_dir: str = ""
     metric_prefix: str = ""
 
     # set by read_config: yaml keys that matched no field (strict-validate

@@ -38,6 +38,13 @@ from veneur_tpu.ops import tdigest as td
 REPLICA_AXIS = "replica"
 SHARD_AXIS = "shard"
 
+# jax.shard_map went public after 0.4.x; older installs only have the
+# experimental location
+try:
+    _shard_map = jax.shard_map
+except AttributeError:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
 
 def make_mesh(n_replicas: int, n_shards: int, devices=None) -> Mesh:
     """A (replica, shard) mesh over `n_replicas * n_shards` devices, or —
@@ -108,7 +115,7 @@ def make_sharded_ingest(mesh: Mesh, spec: TableSpec):
     scatters stay on its own device — zero communication."""
     core = partial(ingest_core, spec=spec)
     vv = jax.vmap(jax.vmap(core))
-    fn = jax.shard_map(
+    fn = _shard_map(
         vv, mesh=mesh,
         in_specs=(P(REPLICA_AXIS, SHARD_AXIS), P(REPLICA_AXIS, SHARD_AXIS)),
         out_specs=P(REPLICA_AXIS, SHARD_AXIS))
@@ -141,7 +148,7 @@ def make_sharded_ingest_packed(mesh: Mesh, spec: TableSpec, sizes: tuple):
         do_compact = flat[0, 0, 0] != 0   # scalar: cond stays a branch
         return jax.lax.cond(do_compact, vv_compact, lambda s: s, st)
 
-    fn = jax.shard_map(
+    fn = _shard_map(
         block, mesh=mesh,
         in_specs=(P(REPLICA_AXIS, SHARD_AXIS), P(REPLICA_AXIS, SHARD_AXIS)),
         out_specs=P(REPLICA_AXIS, SHARD_AXIS))
@@ -260,9 +267,18 @@ def make_merged_flush(mesh: Mesh, spec: TableSpec):
         out = jax.vmap(lambda st: flush_core(st, qs, spec=spec))(merged)
         return out
 
-    fn = jax.shard_map(
-        block, mesh=mesh,
-        in_specs=(P(REPLICA_AXIS, SHARD_AXIS), P()),
-        out_specs=P(SHARD_AXIS),
-        check_vma=False)
+    # replica-reduced outputs aren't replicated the way the checker wants;
+    # the kwarg that disables the check was renamed check_rep -> check_vma
+    try:
+        fn = _shard_map(
+            block, mesh=mesh,
+            in_specs=(P(REPLICA_AXIS, SHARD_AXIS), P()),
+            out_specs=P(SHARD_AXIS),
+            check_vma=False)
+    except TypeError:
+        fn = _shard_map(
+            block, mesh=mesh,
+            in_specs=(P(REPLICA_AXIS, SHARD_AXIS), P()),
+            out_specs=P(SHARD_AXIS),
+            check_rep=False)
     return jax.jit(fn)

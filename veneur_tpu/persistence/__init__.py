@@ -1,0 +1,17 @@
+"""Durability subsystem: versioned on-disk checkpoints of the full
+aggregation snapshot, an async double-buffered writer, and merge-based
+warm restart (README §Durability).
+
+  codec     chunked CRC-checksummed format + manifest + schema hash
+  snapshot  build the in-memory snapshot from a flush's outputs
+  writer    background serialize/fsync/rename/GC, off the flush path
+  restore   validate, quarantine-on-corrupt, fold via sketch merges
+"""
+
+from veneur_tpu.persistence.codec import (  # noqa: F401
+    SNAPSHOT_FORMAT_VERSION, CorruptSnapshot, list_checkpoints,
+    load_dir, read_manifest, schema_hash, verify_dir)
+from veneur_tpu.persistence.snapshot import build_snapshot  # noqa: F401
+from veneur_tpu.persistence.writer import CheckpointWriter  # noqa: F401
+from veneur_tpu.persistence.restore import (  # noqa: F401
+    fold_snapshot, restore_latest, restore_spill)

@@ -1,0 +1,274 @@
+"""Versioned, chunked, CRC-checksummed on-disk snapshot format.
+
+One checkpoint is a DIRECTORY holding two files:
+
+  chunks.bin      every chunk's raw bytes, concatenated
+  MANIFEST.json   format version, schema hash, spec, per-kind row counts,
+                  and the chunk index {name, offset, length, crc32, dtype,
+                  shape}; written LAST via temp-file + atomic rename, so
+                  its presence certifies every chunk byte already fsynced
+
+The chunk payloads are the COMPACT per-live-row aggregation snapshot (row
+i of each array pairs with entry i of the same kind's key-table chunk) —
+the same pairing contract as Aggregator.compute_flush — plus the interned
+key-table strings as one JSON chunk and the ForwardSpillBuffer's wire
+bytes as one opaque chunk. Full-capacity DeviceState arrays are NOT
+stored: at the default TableSpec that would be ~130MB per checkpoint
+regardless of occupancy.
+
+Schema drift is detected, not silently misread: the manifest pins a hash
+over DeviceState._fields + TableSpec's field names, and load refuses a
+snapshot whose hash differs (scripts/check_snapshot_schema.py fails CI
+when either structure changes without bumping SNAPSHOT_FORMAT_VERSION).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from veneur_tpu.utils.atomicio import atomic_write_bytes, fsync_dir
+
+log = logging.getLogger("veneur_tpu.persistence.codec")
+
+SNAPSHOT_FORMAT_VERSION = 1
+
+# schema_hash() pinned per format version; check_snapshot_schema.py fails
+# when the live structures drift from the current version's pin
+_SCHEMA_PINS = {
+    1: "f2901f08f86fee1c56067eb6c0668195cac0ad5cd042ea50ecad364d6baab4a2",
+}
+
+MANIFEST_NAME = "MANIFEST.json"
+CHUNKS_NAME = "chunks.bin"
+
+# the per-kind key-table chunks and their paired array chunks
+TABLE_KINDS = ("counter", "gauge", "status", "set", "histo")
+ARRAY_FIELDS = ("counter", "gauge", "status", "hll", "h_mean", "h_weight",
+                "h_min", "h_max", "h_recip")
+
+_CKPT_RE = re.compile(r"^ckpt-(\d{8})$")
+
+
+class CorruptSnapshot(Exception):
+    """A checkpoint that failed validation (bad CRC, truncated manifest,
+    unknown version, schema-hash mismatch). Callers quarantine and fall
+    back — never crash on one (restore.py restore_latest)."""
+
+
+def schema_hash() -> str:
+    """Hash over the structures the snapshot's meaning depends on:
+    DeviceState's field list (order included — it defines what state
+    exists to snapshot) and TableSpec's field names (they define the
+    capacities/sketch parameters the manifest records)."""
+    import dataclasses
+    import hashlib
+
+    from veneur_tpu.aggregation.state import DeviceState, TableSpec
+    payload = {
+        "format_version": SNAPSHOT_FORMAT_VERSION,
+        "device_state_fields": list(DeviceState._fields),
+        "table_spec_fields": sorted(
+            f.name for f in dataclasses.fields(TableSpec)),
+    }
+    return hashlib.sha256(
+        json.dumps(payload, sort_keys=True).encode()).hexdigest()
+
+
+def _tables_json(tables: Dict[str, list]) -> bytes:
+    # ensure_ascii keeps lone surrogates (non-UTF-8 interned names round-
+    # trip host-side via surrogateescape) representable: they escape to
+    # \udcXX, which json.loads restores exactly
+    return json.dumps(tables, ensure_ascii=True,
+                      separators=(",", ":")).encode("ascii")
+
+
+def encode_to_dir(dirpath: str, snap: dict, fsync: bool = True) -> int:
+    """Serialize an in-memory snapshot (persistence/snapshot.py layout)
+    into `dirpath` (which must exist and be empty-ish — the writer hands
+    us a fresh temp dir). Returns total bytes written."""
+    chunks: List[Tuple[str, bytes, Optional[str], Optional[list]]] = []
+    for name in ARRAY_FIELDS:
+        arr = np.ascontiguousarray(snap["arrays"][name])
+        chunks.append((f"array:{name}", arr.tobytes(), str(arr.dtype),
+                       list(arr.shape)))
+    chunks.append(("tables", _tables_json(snap["tables"]), None, None))
+    chunks.append(("spill", snap.get("spill") or b"", None, None))
+
+    index = []
+    offset = 0
+    chunk_path = os.path.join(dirpath, CHUNKS_NAME)
+    with open(chunk_path, "wb") as f:
+        for name, data, dtype, shape in chunks:
+            f.write(data)
+            entry = {"name": name, "offset": offset, "length": len(data),
+                     "crc32": zlib.crc32(data)}
+            if dtype is not None:
+                entry["dtype"] = dtype
+                entry["shape"] = shape
+            index.append(entry)
+            offset += len(data)
+        if fsync:
+            f.flush()
+            os.fsync(f.fileno())
+
+    manifest = {
+        "format_version": SNAPSHOT_FORMAT_VERSION,
+        "schema_hash": schema_hash(),
+        "agg_kind": snap["agg_kind"],
+        "n_shards": int(snap["n_shards"]),
+        "spec": snap["spec"],
+        "created_at": float(snap["created_at"]),
+        "interval_ts": int(snap["interval_ts"]),
+        "hostname": snap.get("hostname", ""),
+        "rows": {k: len(snap["tables"][k]) for k in TABLE_KINDS},
+        "spill_entries": int(snap.get("spill_entries", 0)),
+        "chunks": index,
+        "total_bytes": offset,
+    }
+    # the manifest lands LAST and atomically: a crash between chunk bytes
+    # and manifest leaves a directory load/list treat as non-existent
+    atomic_write_bytes(os.path.join(dirpath, MANIFEST_NAME),
+                       json.dumps(manifest, indent=1).encode(),
+                       fsync=fsync)
+    return offset
+
+
+def read_manifest(dirpath: str) -> dict:
+    """Parse + structurally validate a checkpoint's manifest."""
+    path = os.path.join(dirpath, MANIFEST_NAME)
+    try:
+        with open(path, "rb") as f:
+            manifest = json.loads(f.read())
+    except FileNotFoundError:
+        raise CorruptSnapshot(f"{dirpath}: no {MANIFEST_NAME}")
+    except (ValueError, OSError) as e:
+        raise CorruptSnapshot(f"{dirpath}: unreadable manifest: {e}")
+    if not isinstance(manifest, dict) or "chunks" not in manifest:
+        raise CorruptSnapshot(f"{dirpath}: manifest missing chunk index")
+    version = manifest.get("format_version")
+    if version != SNAPSHOT_FORMAT_VERSION:
+        raise CorruptSnapshot(
+            f"{dirpath}: format version {version!r}, this build reads "
+            f"{SNAPSHOT_FORMAT_VERSION}")
+    if manifest.get("schema_hash") != schema_hash():
+        raise CorruptSnapshot(
+            f"{dirpath}: schema hash {manifest.get('schema_hash')!r} does "
+            f"not match this build's {schema_hash()!r} — DeviceState or "
+            "TableSpec changed shape since the snapshot was written")
+    return manifest
+
+
+def _read_chunks(dirpath: str, manifest: dict) -> Dict[str, bytes]:
+    path = os.path.join(dirpath, CHUNKS_NAME)
+    try:
+        with open(path, "rb") as f:
+            blob = f.read()
+    except OSError as e:
+        raise CorruptSnapshot(f"{dirpath}: unreadable chunks: {e}")
+    out = {}
+    for entry in manifest["chunks"]:
+        lo, hi = entry["offset"], entry["offset"] + entry["length"]
+        if hi > len(blob):
+            raise CorruptSnapshot(
+                f"{dirpath}: chunk {entry['name']} extends to byte {hi} "
+                f"but {CHUNKS_NAME} holds {len(blob)}")
+        data = blob[lo:hi]
+        if zlib.crc32(data) != entry["crc32"]:
+            raise CorruptSnapshot(
+                f"{dirpath}: chunk {entry['name']} failed CRC")
+        out[entry["name"]] = data
+    return out
+
+
+def verify_dir(dirpath: str) -> dict:
+    """Full validation without materializing arrays: manifest structure,
+    version, schema hash, every chunk's CRC. Returns the manifest.
+    Raises CorruptSnapshot on any failure (the CLI `verify` command)."""
+    manifest = read_manifest(dirpath)
+    _read_chunks(dirpath, manifest)
+    return manifest
+
+
+def load_dir(dirpath: str) -> dict:
+    """Read + validate one checkpoint directory back into the in-memory
+    snapshot layout (persistence/snapshot.py)."""
+    manifest = read_manifest(dirpath)
+    chunks = _read_chunks(dirpath, manifest)
+    arrays = {}
+    by_name = {e["name"]: e for e in manifest["chunks"]}
+    for name in ARRAY_FIELDS:
+        entry = by_name.get(f"array:{name}")
+        if entry is None:
+            raise CorruptSnapshot(f"{dirpath}: missing array chunk {name}")
+        try:
+            arrays[name] = np.frombuffer(
+                chunks[f"array:{name}"],
+                dtype=np.dtype(entry["dtype"])).reshape(entry["shape"])
+        except (TypeError, ValueError) as e:
+            raise CorruptSnapshot(
+                f"{dirpath}: array chunk {name}: {e}")
+    try:
+        tables = json.loads(chunks["tables"])
+    except (KeyError, ValueError) as e:
+        raise CorruptSnapshot(f"{dirpath}: tables chunk: {e}")
+    for kind in TABLE_KINDS:
+        if kind not in tables:
+            raise CorruptSnapshot(f"{dirpath}: tables chunk lacks {kind}")
+    return {
+        "agg_kind": manifest["agg_kind"],
+        "n_shards": manifest["n_shards"],
+        "spec": manifest["spec"],
+        "created_at": manifest["created_at"],
+        "interval_ts": manifest["interval_ts"],
+        "hostname": manifest.get("hostname", ""),
+        "tables": tables,
+        "arrays": arrays,
+        "spill": chunks.get("spill", b""),
+    }
+
+
+def list_checkpoints(root: str) -> List[Tuple[int, str]]:
+    """(seq, path) for every complete checkpoint under `root`, oldest
+    first. A directory without a manifest (in-flight or crashed write)
+    is not a checkpoint."""
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return []
+    out = []
+    for name in names:
+        m = _CKPT_RE.match(name)
+        if not m:
+            continue
+        path = os.path.join(root, name)
+        if os.path.isfile(os.path.join(path, MANIFEST_NAME)):
+            out.append((int(m.group(1)), path))
+    return sorted(out)
+
+
+def checkpoint_dirname(seq: int) -> str:
+    return f"ckpt-{seq:08d}"
+
+
+def quarantine(root: str, dirpath: str) -> str:
+    """Move a rejected checkpoint aside so restore never retries it and
+    an operator can post-mortem the bytes. Returns the new path."""
+    qdir = os.path.join(root, "quarantine")
+    os.makedirs(qdir, exist_ok=True)
+    base = os.path.basename(dirpath.rstrip("/"))
+    dest = os.path.join(qdir, base)
+    n = 1
+    while os.path.exists(dest):
+        dest = os.path.join(qdir, f"{base}.{n}")
+        n += 1
+    os.replace(dirpath, dest)
+    fsync_dir(root)
+    log.warning("quarantined corrupt checkpoint %s -> %s", dirpath, dest)
+    return dest

@@ -1,0 +1,136 @@
+"""Warm restart: load the newest valid checkpoint and FOLD it into the
+live aggregator through the same sketch-merge ops the forward/import
+path uses.
+
+Restore never overwrites device state. Every snapshot row re-enters
+through Aggregator.restore_metric — counter add (two-float split so f64
+counts survive the f32 staging lane), HLL register max-merge, t-digest
+centroid re-add with the exact min/max/reciprocalSum stats lane, gauge/
+status last-write-wins — so a restore composes with concurrent ingest
+exactly like an imported interval does: restored state merges, and any
+later live sample for the same key wins the LWW lanes because restore
+runs before the listeners start.
+
+Corrupt snapshots are rejected and QUARANTINED (moved under
+<checkpoint_dir>/quarantine/), and restore falls back to the next-newest
+checkpoint, then to a cold start — a bad disk must never crash or wedge
+startup.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional, Tuple
+
+import numpy as np
+
+from veneur_tpu.persistence import codec
+from veneur_tpu.utils.hashing import fnv1a_32
+
+log = logging.getLogger("veneur_tpu.persistence.restore")
+
+
+def _digest(kind: str, name: str, joined_tags: str) -> int:
+    """Deterministic shard-routing digest for restored keys, the
+    parser's recipe (samplers/parser.py _key_info). The original ingest
+    digest is not persisted; any stable hash works — the KeyTable's
+    by_key dict guarantees later live samples land on the same slot
+    regardless of which digest allocated it."""
+    h = fnv1a_32(name.encode("utf-8", "surrogateescape"))
+    h = fnv1a_32(kind.encode(), h)
+    return fnv1a_32(joined_tags.encode("utf-8", "surrogateescape"), h)
+
+
+def restore_latest(root: str, on_corrupt=None
+                   ) -> Optional[Tuple[dict, str]]:
+    """Newest-first scan: load the first checkpoint that validates,
+    quarantining every rejected one along the way. Returns
+    (snapshot, path) or None for a cold start."""
+    for seq, path in reversed(codec.list_checkpoints(root)):
+        try:
+            snap = codec.load_dir(path)
+        except codec.CorruptSnapshot as e:
+            log.warning("rejecting checkpoint %s: %s", path, e)
+            try:
+                codec.quarantine(root, path)
+            except OSError as qe:
+                log.warning("could not quarantine %s: %s", path, qe)
+            if on_corrupt is not None:
+                on_corrupt()
+            continue
+        return snap, path
+    return None
+
+
+def fold_snapshot(aggregator, snap: dict) -> int:
+    """Merge every snapshot row into `aggregator` via restore_metric;
+    returns the number of rows folded. Capacity overflow in a smaller
+    target table is counted in the aggregator's dropped_capacity, same
+    as live ingest."""
+    arrays = snap["arrays"]
+    n = 0
+
+    def rows(kind):
+        for i, entry in enumerate(snap["tables"][kind]):
+            name, tags, scope, hostname, message, imported_only, \
+                actual_kind, joined_tags = entry
+            if joined_tags is None:
+                joined_tags = ",".join(tags)
+            yield (i, actual_kind, name, tuple(tags), int(scope),
+                   hostname, message, bool(imported_only), joined_tags)
+
+    for i, kind, name, tags, scope, host, _msg, imp, joined in \
+            rows("counter"):
+        aggregator.restore_metric(
+            kind, name, tags, scope, _digest(kind, name, joined),
+            {"value": float(arrays["counter"][i])},
+            hostname=host, imported_only=imp, joined_tags=joined)
+        n += 1
+    for i, kind, name, tags, scope, host, _msg, imp, joined in \
+            rows("gauge"):
+        aggregator.restore_metric(
+            kind, name, tags, scope, _digest(kind, name, joined),
+            {"value": float(arrays["gauge"][i])},
+            hostname=host, imported_only=imp, joined_tags=joined)
+        n += 1
+    for i, kind, name, tags, scope, host, msg, imp, joined in \
+            rows("status"):
+        aggregator.restore_metric(
+            kind, name, tags, scope, _digest(kind, name, joined),
+            {"value": float(arrays["status"][i])},
+            hostname=host, message=msg, imported_only=imp,
+            joined_tags=joined)
+        n += 1
+    for i, kind, name, tags, scope, host, _msg, imp, joined in \
+            rows("set"):
+        aggregator.restore_metric(
+            kind, name, tags, scope, _digest(kind, name, joined),
+            {"registers": np.asarray(arrays["hll"][i], np.uint8)},
+            hostname=host, imported_only=imp, joined_tags=joined)
+        n += 1
+    for i, kind, name, tags, scope, host, _msg, imp, joined in \
+            rows("histo"):
+        aggregator.restore_metric(
+            kind, name, tags, scope, _digest(kind, name, joined),
+            {"means": arrays["h_mean"][i],
+             "weights": arrays["h_weight"][i],
+             "min": float(arrays["h_min"][i]),
+             "max": float(arrays["h_max"][i]),
+             "recip": float(arrays["h_recip"][i])},
+            hostname=host, imported_only=imp, joined_tags=joined)
+        n += 1
+    aggregator.restore_flush()
+    return n
+
+
+def restore_spill(spill_buffer, spill_bytes: bytes) -> int:
+    """Re-seed a configured ForwardSpillBuffer from snapshot bytes,
+    preserving original spill stamps. Entries already past max_age_s
+    re-enter and are counted into dropped_age at the next drain — drop
+    accounting survives the restart, nothing vanishes silently."""
+    if not spill_bytes or spill_buffer is None:
+        return 0
+    from veneur_tpu.reliability.spill import parse_spill_bytes
+    entries, _caps = parse_spill_bytes(spill_bytes)
+    spill_buffer.readd(entries)
+    return len(entries)

@@ -22,10 +22,16 @@ class S3Plugin:
 
     def __init__(self, bucket: str, region: str, hostname: str,
                  access_key_id: str = "", secret_access_key: str = "",
-                 interval_s: int = 10, client=None):
+                 interval_s: int = 10, client=None, staging_dir: str = ""):
         self.bucket = bucket
         self.hostname = hostname
         self.interval_s = interval_s
+        # optional durable staging: each flush's object is written
+        # locally (atomic temp + rename) BEFORE the network put and
+        # unlinked only after S3 acknowledges — a crash or failed upload
+        # leaves a complete .tsv.gz an operator can re-upload, never a
+        # torn one (README §Durability)
+        self.staging_dir = staging_dir
         if client is None:
             try:
                 import boto3  # type: ignore
@@ -43,11 +49,24 @@ class S3Plugin:
         return f"{self.hostname}/{ts}.{ext}"
 
     def flush(self, metrics):
+        import os
         ts = int(time.time())
         body = encode_intermetrics_csv(metrics, self.hostname,
                                        self.interval_s, compress=True)
+        staged = None
+        if self.staging_dir:
+            from veneur_tpu.utils.atomicio import atomic_write_bytes
+            os.makedirs(self.staging_dir, exist_ok=True)
+            staged = os.path.join(self.staging_dir, f"{ts}.tsv.gz")
+            atomic_write_bytes(staged, body)
         self.client.put_object(Bucket=self.bucket,
                                Key=self.s3_path(ts), Body=body)
+        if staged is not None:
+            # acknowledged upload: the staged copy has served its purpose
+            try:
+                os.unlink(staged)
+            except OSError:
+                pass
 
     # see LocalFilePlugin: materialize, but don't veto the frame path
     accepts_frames = True

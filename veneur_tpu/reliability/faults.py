@@ -34,6 +34,7 @@ HTTP_POST = "http.post"
 PROXY_FORWARD = "proxy.forward"
 SINK_FLUSH = "sink.flush"
 FLUSH_WORKER = "flush.worker"
+CHECKPOINT_WRITE = "checkpoint.write"
 
 
 class InjectedFault(RuntimeError):

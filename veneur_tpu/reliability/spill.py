@@ -18,12 +18,45 @@ veneur.forward.spill.dropped_total in self-telemetry).
 from __future__ import annotations
 
 import logging
+import struct
 import threading
 import time
 from collections import deque
-from typing import Callable, List
+from typing import Callable, List, Tuple
 
 log = logging.getLogger("veneur_tpu.reliability.spill")
+
+# wire format (persistence checkpoints): magic, then the caps + entry
+# count, then per entry the ORIGINAL spill stamp and the metricpb blob —
+# stamps survive a restart so max_age_s keeps bounding total staleness
+_SPILL_MAGIC = b"VSPL1"
+_SPILL_HEADER = struct.Struct("<qdI")   # max_bytes, max_age_s, count
+_SPILL_ENTRY = struct.Struct("<dI")     # spilled_at, blob length
+
+
+def parse_spill_bytes(data: bytes) -> Tuple[List, Tuple[int, float]]:
+    """-> ([(spilled_at, metricpb.Metric), ...], (max_bytes, max_age_s)).
+    Raises ValueError on malformed bytes (checkpoint CRCs catch rot; this
+    catches format drift)."""
+    from veneur_tpu.proto import metricpb_pb2 as mpb
+    if data[:len(_SPILL_MAGIC)] != _SPILL_MAGIC:
+        raise ValueError("bad spill magic")
+    off = len(_SPILL_MAGIC)
+    try:
+        max_bytes, max_age_s, count = _SPILL_HEADER.unpack_from(data, off)
+        off += _SPILL_HEADER.size
+        entries = []
+        for _ in range(count):
+            spilled_at, blob_len = _SPILL_ENTRY.unpack_from(data, off)
+            off += _SPILL_ENTRY.size
+            blob = data[off:off + blob_len]
+            if len(blob) != blob_len:
+                raise ValueError("truncated spill entry")
+            off += blob_len
+            entries.append((spilled_at, mpb.Metric.FromString(blob)))
+    except struct.error as e:
+        raise ValueError(f"truncated spill buffer: {e}")
+    return entries, (max_bytes, max_age_s)
 
 
 class ForwardSpillBuffer:
@@ -82,12 +115,21 @@ class ForwardSpillBuffer:
         re-failed send, keeping their ORIGINAL spill timestamps — so
         max_age_s bounds total staleness since the first failure, not
         time since the last retry. Re-adds are not re-counted in
-        spilled_total."""
+        spilled_total.
+
+        Entries land at the LEFT of the deque: drained entries are older
+        than anything add() appended while the retry was in flight, and
+        the deque must stay oldest-first or the byte-cap eviction (and a
+        later drain()'s pair ordering) would drop fresh payloads while
+        keeping stale ones."""
         if not entries:
             return
         with self._lock:
-            evicted = self._extend_locked(
-                (ts, m, m.ByteSize()) for ts, m in entries)
+            for ts, m in reversed(entries):
+                nb = m.ByteSize()
+                self._entries.appendleft((ts, m, nb))
+                self._bytes += nb
+            evicted = self._evict_locked()
         if evicted:
             log.warning("forward spill over %d bytes: dropped %d oldest "
                         "payloads", self.max_bytes, evicted)
@@ -95,10 +137,13 @@ class ForwardSpillBuffer:
     def _extend_locked(self, triples) -> int:
         """Append (spilled_at, metric, nbytes) triples and enforce the
         byte cap; returns the evicted count. Caller holds the lock and
-        must keep appends time-ordered (oldest entries re-add first)."""
+        must keep appends time-ordered (newest at the right)."""
         for t in triples:
             self._entries.append(t)
             self._bytes += t[2]
+        return self._evict_locked()
+
+    def _evict_locked(self) -> int:
         evicted = 0
         while self._bytes > self.max_bytes and self._entries:
             _, _, nb = self._entries.popleft()
@@ -127,3 +172,31 @@ class ForwardSpillBuffer:
             log.warning("forward spill: dropped %d payloads older than "
                         "%.0fs", expired, self.max_age_s)
         return out
+
+    # -- persistence (checkpoints; README §Durability) ----------------------
+    def to_bytes(self) -> bytes:
+        """Serialize contents + caps, preserving every entry's original
+        spill stamp. Point-in-time consistent (one lock hold)."""
+        with self._lock:
+            triples = list(self._entries)
+        parts = [_SPILL_MAGIC,
+                 _SPILL_HEADER.pack(self.max_bytes, self.max_age_s,
+                                    len(triples))]
+        for spilled_at, m, _nb in triples:
+            blob = m.SerializeToString()
+            parts.append(_SPILL_ENTRY.pack(spilled_at, len(blob)))
+            parts.append(blob)
+        return b"".join(parts)
+
+    @classmethod
+    def from_bytes(cls, data: bytes,
+                   clock: Callable[[], float] = time.time
+                   ) -> "ForwardSpillBuffer":
+        """Rebuild a buffer with the SERIALIZED caps and stamps. Entries
+        already past max_age_s still re-enter; the next drain() expires
+        them into dropped_age, so the drop accounting that would have
+        happened without the restart still happens."""
+        entries, (max_bytes, max_age_s) = parse_spill_bytes(data)
+        buf = cls(max_bytes, max_age_s, clock=clock)
+        buf.readd(entries)
+        return buf

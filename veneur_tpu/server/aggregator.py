@@ -49,6 +49,9 @@ class Aggregator:
         # staged HLL import rows (merged via ops.hll.merge_rows)
         self._hll_slots: list = []
         self._hll_rows: list = []
+        # checkpoint-restore residuals: (batcher, slot, lo) counter tails
+        # applied in a SECOND ingest step (restore_flush)
+        self._restore_residuals: list = []
         # stats (reference self-telemetry counters)
         self.processed = 0
         self.dropped_capacity = 0
@@ -158,6 +161,101 @@ class Aggregator:
                 recip_corr = float(recip) - float(np.sum(weights / means))
             self.batcher.add_histo_stats(slot, mn, mx, recip_corr)
         self.processed += 1
+
+    # -- checkpoint restore (persistence/restore.py) ------------------------
+    def _restore_lane(self, kind: str, slot: int):
+        """(batcher, staging slot) for a restored key; the sharded
+        backend overrides with its per-shard routing."""
+        return self.batcher, slot
+
+    def _restore_hll(self, slot: int, regs) -> None:
+        """Stage restored HLL registers for max-merge, same as the
+        import path."""
+        self._hll_slots.append(slot)
+        self._hll_rows.append(regs)
+        if len(self._hll_slots) >= 128:
+            self._flush_hll_imports()
+
+    def _restore_emit(self) -> None:
+        self.batcher.emit()
+
+    def restore_metric(self, kind: str, name: str, tags: tuple, scope: int,
+                       digest: int, payload: dict, hostname: str = "",
+                       message: str = "", imported_only: bool = False,
+                       joined_tags=None) -> None:
+        """Fold one checkpointed key back in through the merge lanes
+        (never by overwriting state): counter add, gauge/status
+        last-write-wins, HLL max, digest centroid re-add — the
+        import_metric machinery plus the host-side metadata
+        (hostname/message/joined_tags) a snapshot preserves and a
+        forwarded metric does not. Callers finish with restore_flush()."""
+        slot = self.table.slot_for(kind, name, tags, scope, digest,
+                                   hostname=hostname,
+                                   imported=imported_only,
+                                   joined_tags=joined_tags)
+        if slot is None:
+            self.dropped_capacity += 1
+            return
+        b, local = self._restore_lane(kind, slot)
+        if kind == "counter":
+            # two-float split: the staging lane is f32, but the
+            # checkpointed count is the f64 hi+lo fold. Stage hi now and
+            # defer lo to restore_flush's second ingest step — a
+            # same-batch scatter-add would re-round hi+lo to f32 and
+            # lose exactly the bits the split carries.
+            value = float(payload["value"])
+            hi = float(np.float32(value))
+            b.add_counter(local, hi, 1.0)
+            lo = value - hi
+            if lo != 0.0:
+                self._restore_residuals.append((b, local, lo))
+        elif kind == "gauge":
+            b.add_gauge(local, float(payload["value"]))
+        elif kind == "status":
+            b.add_status(local, float(payload["value"]))
+            mt = self.table.meta_for_slot("status", slot)
+            if mt is not None:
+                mt.message = message
+        elif kind == "set":
+            regs = np.asarray(payload["registers"], np.uint8)
+            if regs.shape[0] != self.spec.registers:
+                raise ValueError(
+                    f"restored HLL has {regs.shape[0]} registers, table "
+                    f"expects {self.spec.registers}")
+            self._restore_hll(slot, regs)
+        elif kind in ("histogram", "timer"):
+            # identical merge math to import_metric: re-add live
+            # centroids, exact min/max/recip via the stats lane
+            means = np.asarray(payload["means"], np.float32)
+            weights = np.asarray(payload["weights"], np.float32)
+            live = weights > 0
+            means, weights = means[live], weights[live]
+            b.add_histos_bulk(
+                np.full(len(means), local, np.int32), means, weights)
+            mn = float(payload.get("min", np.inf))
+            mx = float(payload.get("max", -np.inf))
+            recip = payload.get("recip")
+            recip_corr = 0.0
+            if recip is not None and len(means) and np.all(means != 0.0):
+                recip_corr = float(recip) - float(np.sum(weights / means))
+            b.add_histo_stats(local, mn, mx, recip_corr)
+        self.processed += 1
+
+    def restore_flush(self) -> None:
+        """Materialize a fold_snapshot pass: emit the hi-part batches,
+        then the counter lo residuals in a separate step (see the split
+        rationale in restore_metric), then drain staged HLL rows."""
+        self._restore_emit()
+        if self._restore_residuals:
+            for b, local, lo in self._restore_residuals:
+                b.add_counter(local, lo, 1.0)
+            self._restore_residuals = []
+            self._restore_emit()
+        self._restore_drain_hll()
+
+    def _restore_drain_hll(self) -> None:
+        while self._hll_slots:
+            self._flush_hll_imports()
 
     def _flush_hll_imports(self):
         if not self._hll_slots:

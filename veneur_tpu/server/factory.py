@@ -158,7 +158,8 @@ def new_from_config(cfg: Config, extra_metric_sinks=(), extra_span_sinks=(),
             access_key_id=cfg.aws_access_key_id,
             secret_access_key=cfg.aws_secret_access_key,
             hostname=cfg.hostname,
-            interval_s=int(cfg.parse_interval())))
+            interval_s=int(cfg.parse_interval()),
+            staging_dir=cfg.aws_s3_staging_dir))
 
     return Server(cfg, metric_sinks=metric_sinks, span_sinks=span_sinks,
                   plugins=plugins)

@@ -299,6 +299,25 @@ class Server:
             "veneur.sink.flush_duration_ns",
             "one sink flush call, success or failure",
             labelnames=("sink",))
+        # durability layer (veneur_tpu/persistence/) — registered even
+        # with checkpointing off so the inventory is stable; they just
+        # stay zero
+        self._c_ckpt_writes = M.counter(
+            "veneur.checkpoint.writes_total",
+            "checkpoint snapshots durably written")
+        self._c_ckpt_bytes = M.counter(
+            "veneur.checkpoint.bytes",
+            "serialized snapshot bytes written (manifest + chunks)")
+        self._c_ckpt_restores = M.counter(
+            "veneur.checkpoint.restores_total",
+            "snapshots folded into a starting server")
+        self._c_ckpt_corrupt = M.counter(
+            "veneur.checkpoint.corrupt_total",
+            "snapshots rejected by checksum/schema validation and "
+            "quarantined")
+        self._t_ckpt_write = M.timer(
+            "veneur.checkpoint.write_duration_ns",
+            "one checkpoint serialize+fsync on the writer thread")
         jaxruntime.install()
         # h2d_bytes high-water at the last flush report, for per-interval
         # byte tags on the flush trace (flush worker thread only)
@@ -350,6 +369,19 @@ class Server:
             from veneur_tpu.reliability.spill import ForwardSpillBuffer
             self.forward_spill = ForwardSpillBuffer(
                 cfg.forward_spill_max_bytes, cfg.forward_spill_max_age_s)
+
+        # -- durability layer (veneur_tpu/persistence/) -------------------
+        # Off by default (empty checkpoint_dir): no writer thread, no
+        # extra work anywhere in the flush path.
+        self._ckpt_writer = None
+        self._flushes_since_ckpt = 0
+        if cfg.checkpoint_dir:
+            from veneur_tpu.persistence import CheckpointWriter
+            self._ckpt_writer = CheckpointWriter(
+                cfg.checkpoint_dir, retain=max(1, cfg.checkpoint_retain),
+                write_timer=self._t_ckpt_write,
+                bytes_counter=self._c_ckpt_bytes,
+                writes_counter=self._c_ckpt_writes)
         # fan-out retry counts per sink (plain sinks only; ResilientSink
         # sinks count their own), under _sink_stats_lock
         self._fanout_retries: dict = {}
@@ -474,6 +506,11 @@ class Server:
                             if self.forward_spill is not None else None),
                    kind="counter",
                    help="spilled metrics dropped at the cap or max age")
+        M.callback("veneur.checkpoint.age_s",
+                   lambda: (time.time() - self._ckpt_writer.last_write_ts
+                            if self._ckpt_writer is not None
+                            and self._ckpt_writer.last_write_ts else None),
+                   help="seconds since the last durable checkpoint")
 
     # -- registry collector helpers -----------------------------------------
     def _breaker_list(self):
@@ -1052,6 +1089,12 @@ class Server:
                 "(use enable_profiling for the cProfile CPU profile)")
         for sink in self.metric_sinks + self.span_sinks:
             sink.start()
+        # durable restart: fold the newest valid checkpoint into the
+        # (still-empty) first interval BEFORE any ingest thread exists —
+        # restore merges through the same sketch ops as live traffic, so
+        # samples arriving after this point land on top losslessly
+        if self._ckpt_writer is not None and self.cfg.restore_on_start:
+            self._restore_from_checkpoint()
         t = threading.Thread(target=self._pipeline_loop, daemon=True,
                              name="pipeline")
         t.start()
@@ -1319,6 +1362,55 @@ class Server:
             log.warning("manual flush did not complete: %s", req.detail)
         return ok
 
+    def _checkpoint_interval(self, flush_arrays, table, raw, ts) -> None:
+        """Assemble this interval's snapshot from the flush outputs and
+        hand it to the async writer. Containment: a checkpoint that
+        cannot be built degrades durability, never the flush."""
+        ck_t0 = time.perf_counter_ns()
+        try:
+            from veneur_tpu.persistence import build_snapshot
+            spill_bytes, spill_n = None, 0
+            if self.forward_spill is not None:
+                spill_bytes = self.forward_spill.to_bytes()
+                spill_n = len(self.forward_spill)
+            n_shards = getattr(self.aggregator, "n_shards", 1)
+            snap = build_snapshot(
+                self.aggregator.spec, table, flush_arrays, raw,
+                agg_kind="sharded" if n_shards > 1 else "single",
+                n_shards=n_shards, interval_ts=ts,
+                hostname=self.hostname, spill=spill_bytes,
+                spill_entries=spill_n)
+            self._ckpt_writer.submit(snap)
+        except Exception:
+            log.exception("checkpoint snapshot build failed; interval "
+                          "not checkpointed")
+        self._t_flush_phase.observe(time.perf_counter_ns() - ck_t0,
+                                    phase="checkpoint_build")
+
+    def _restore_from_checkpoint(self) -> None:
+        """Fold the newest valid snapshot into the live aggregator.
+        Corrupt snapshots are quarantined and counted inside
+        restore_latest; any other failure cold-starts — a bad checkpoint
+        must never keep the server from serving."""
+        from veneur_tpu.persistence import (fold_snapshot, restore_latest,
+                                            restore_spill)
+        try:
+            found = restore_latest(self.cfg.checkpoint_dir,
+                                   on_corrupt=self._c_ckpt_corrupt.inc)
+            if found is None:
+                log.info("no restorable checkpoint under %s; cold start",
+                         self.cfg.checkpoint_dir)
+                return
+            snap, path = found
+            n = fold_snapshot(self.aggregator, snap)
+            if self.forward_spill is not None and snap.get("spill"):
+                restore_spill(self.forward_spill, snap["spill"])
+            self._c_ckpt_restores.inc()
+            log.info("restored %d metrics from %s (interval_ts=%d)",
+                     n, path, snap["interval_ts"])
+        except Exception:
+            log.exception("checkpoint restore failed; cold start")
+
     def _flush_worker(self):
         """Dedicated flush thread: drains detached intervals and runs the
         full flush fan-out. Serializes overlapping flushes; a slow sink
@@ -1380,7 +1472,12 @@ class Server:
         dev_t0 = time.perf_counter_ns()
         sp = stage("device_update")
         raw = None
-        if self._forward_client is not None:
+        # a due checkpoint rides the forward path's raw sketch outputs —
+        # same want_raw host transfer, zero checkpoint-only device reads
+        ckpt_due = (self._ckpt_writer is not None
+                    and self._flushes_since_ckpt + 1
+                    >= max(1, self.cfg.checkpoint_interval_flushes))
+        if self._forward_client is not None or ckpt_due:
             flush_arrays, table, raw = self.aggregator.compute_flush(
                 state, table, self.cfg.percentiles, want_raw=True)
         else:
@@ -1391,6 +1488,16 @@ class Server:
         if trace:
             sp.set_tag("h2d_bytes", str(h2d_delta))
         sp.client_finish(self.trace_client)
+        if self._ckpt_writer is not None:
+            if ckpt_due:
+                # capture the spill BEFORE the forward drains it: a crash
+                # between here and a successful send replays those
+                # payloads (at-least-once; mergeable sketches make the
+                # duplicate fold idempotent at the receiving tier)
+                self._checkpoint_interval(flush_arrays, table, raw, ts)
+                self._flushes_since_ckpt = 0
+            else:
+                self._flushes_since_ckpt += 1
         if self._forward_client is not None:
             # fire-and-forget, concurrent with sink flushes
             # (flusher.go:84-95); _forward logs and counts its own errors,
@@ -2026,6 +2133,36 @@ class Server:
             if self._flush_thread.is_alive():
                 log.error("flush worker did not exit within %.0fs",
                           device_timeout)
+        # graceful-exit durability: checkpoint the sub-interval tail that
+        # never reached a flush. Written SYNCHRONOUSLY (shutdown is the
+        # one caller that must not race interpreter teardown) and always
+        # newest, so a graceful restart restores ONLY the tail — flushed
+        # intervals already left through the sinks, and restoring them
+        # too would double-count downstream (exactly-once across a
+        # graceful restart; a crash falls back to the last periodic
+        # checkpoint, i.e. at-least-once for that interval).
+        if self._ckpt_writer is not None:
+            if self.cfg.checkpoint_on_shutdown:
+                try:
+                    from veneur_tpu.persistence import build_snapshot
+                    state, table = self.aggregator.swap()
+                    flush_arrays, table, raw = self.aggregator.compute_flush(
+                        state, table, self.cfg.percentiles, want_raw=True)
+                    spill_bytes, spill_n = None, 0
+                    if self.forward_spill is not None:
+                        spill_bytes = self.forward_spill.to_bytes()
+                        spill_n = len(self.forward_spill)
+                    n_shards = getattr(self.aggregator, "n_shards", 1)
+                    self._ckpt_writer.write_sync(build_snapshot(
+                        self.aggregator.spec, table, flush_arrays, raw,
+                        agg_kind="sharded" if n_shards > 1 else "single",
+                        n_shards=n_shards, interval_ts=int(time.time()),
+                        hostname=self.hostname, spill=spill_bytes,
+                        spill_entries=spill_n))
+                except Exception:
+                    log.exception("final checkpoint failed; last periodic "
+                                  "checkpoint remains newest")
+            self._ckpt_writer.close()
         with self._aux_lock:
             aux = list(self._aux_threads)
         for t in aux:

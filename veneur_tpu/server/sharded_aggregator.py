@@ -138,6 +138,7 @@ class ShardedAggregator(Aggregator):
         self.batchers = self._make_batchers()
         self._hll_slots: List[Tuple[int, int]] = []  # (shard, local_slot)
         self._hll_rows: List[np.ndarray] = []
+        self._restore_residuals: list = []  # (batcher, local, lo) tails
         self._steps = 0
         self.processed = 0
         self.dropped_capacity = 0
@@ -216,6 +217,23 @@ class ShardedAggregator(Aggregator):
                               float(payload.get("max", -np.inf)),
                               recip_corr)
         self.processed += 1
+
+    # -- checkpoint restore (hooks into Aggregator.restore_metric) ----------
+    def _restore_lane(self, kind: str, slot: int):
+        shard, local = self._local(kind, slot)
+        return self.batchers[shard], local
+
+    def _restore_hll(self, slot: int, regs) -> None:
+        # staged as (shard, local) for _apply_hll_imports, same as the
+        # sharded import path; drained by _restore_drain_hll / swap
+        self._hll_slots.append(self._local("set", slot))
+        self._hll_rows.append(regs)
+
+    def _restore_emit(self) -> None:
+        self._emit_all()
+
+    def _restore_drain_hll(self) -> None:
+        self._apply_hll_imports()
 
     # -- device steps --------------------------------------------------------
     def _make_batchers(self):

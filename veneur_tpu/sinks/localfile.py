@@ -106,8 +106,11 @@ class LocalFilePlugin:
     def flush(self, metrics):
         data = encode_intermetrics_csv(metrics, self.hostname,
                                        self.interval_s, self.delimiter)
-        with open(self.path, "ab") as f:
-            f.write(data)
+        # atomic append: a crash mid-flush must never leave a torn TSV
+        # row for downstream loaders (same temp-file + rename discipline
+        # as the checkpoint codec; README §Durability)
+        from veneur_tpu.utils.atomicio import atomic_append_bytes
+        atomic_append_bytes(self.path, data)
 
     # Plugins are file-bound and low-volume tiers: materializing is fine,
     # but declaring frame support keeps the server's columnar fast path
