@@ -340,7 +340,8 @@ def main():
             # headline configs first (2: digest accuracy+rate, 1: UDP
             # ingest, 4: global merge): under the wall-clock guard the
             # TAIL gets truncated, never the head
-            out["e2e"] = e2e.main(configs=[2, 1, 4, 3, 5, 6, 7], scale=scale,
+            out["e2e"] = e2e.main(configs=[2, 1, 4, 3, 5, 6, 7, 8],
+                                  scale=scale,
                                   force_cpu=on_cpu, on_result=on_result,
                                   deadline=T0 + guard - 45.0)
             cfg2 = next((r for r in out["e2e"] if r.get("config") == 2), None)

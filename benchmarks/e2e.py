@@ -848,10 +848,195 @@ def config7_checkpoint_restore(scale=1.0):
         shutil.rmtree(ckpt_root, ignore_errors=True)
 
 
+def config8_overload_storm(scale=1.0):
+    """Sustained ingest storm at ~5x measured capacity (README §Overload
+    & health). The acceptance gates, all reported as booleans:
+    /healthz answers 200 throughout (a shedding server is LIVE),
+    /readyz flips non-ready within one flush interval of entering
+    SHEDDING and recovers within two intervals of load removal, every
+    packet is accounted (admitted + shed == sent, exact — blocking
+    queue puts make the feed lossless), high-priority traffic absorbs
+    <1% of the shedding, and every storm flush meets the interval
+    deadline."""
+    import urllib.error
+    import urllib.request
+
+    from veneur_tpu.reliability.overload import PRESSURED, SHEDDING
+    from veneur_tpu.sinks.blackhole import BlackholeMetricSink
+
+    interval_s = 2.0          # the flush deadline the gates measure against
+    storm_intervals = 3
+    n_producers = 4
+
+    srv = _mk_server(
+        [BlackholeMetricSink()], http_address="127.0.0.1:0",
+        native_ingest=False,  # admission gates the Python parse path
+        overload_enabled=True, overload_poll_interval_s=0.05,
+        overload_hold_s=0.5,
+        shed_priority_tags=["veneur.priority:high"],
+        tpu_counter_capacity=1024, tpu_batch_counter=4096)
+    try:
+        ov = srv._overload
+        port = srv.http_port
+
+        def probe(path):
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+                    return r.status
+            except urllib.error.HTTPError as e:
+                return e.code
+
+        # calibrate capacity with the controller's signals silenced —
+        # admission during the baseline would measure the shed path,
+        # not the parse path
+        real_signals = ov._signals
+        ov._signals = lambda: {}
+        _warm(srv, [b"storm.l0:1|c"])
+        phase("calibrate")
+        # the calibration feed covers the storm's full name set (incl.
+        # the high-priority rows) so the pre-storm flush compiles the
+        # flush program at the storm's true size bucket — a mid-storm
+        # recompile would be charged to the first flush deadline
+        calib = [(b"storm.h%d:1|c|#veneur.priority:high" % (i % 64))
+                 if i % 10 == 0 else (b"storm.l%d:1|c" % (i % 512))
+                 for i in range(max(2_000, int(30_000 * scale)))]
+        base = srv.aggregator.processed
+        t0 = time.perf_counter()
+        _feed_queue(srv, calib)
+        _drain(srv, base + len(calib))
+        capacity = len(calib) / (time.perf_counter() - t0)
+        _flush_checked(srv, timeout=WARM_TIMEOUT)  # pay the size compile
+        ov._signals = real_signals
+
+        # storm traffic: 10% high-priority, 90% low; single-line packets
+        high_pkts = [b"storm.h%d:1|c|#veneur.priority:high" % (i % 64)
+                     for i in range(64)]
+        low_pkts = [b"storm.l%d:1|c" % (i % 512) for i in range(512)]
+        adm0 = dict(ov.admitted)
+        shed0 = dict(ov.shed)
+        sent = {"high": 0, "low": 0}
+        sent_lock = threading.Lock()
+        stop_evt = threading.Event()
+        target_rate = 5.0 * capacity / n_producers  # per producer
+
+        def produce(idx):
+            put = srv.packet_queue.put
+            h, lo, n = 0, 0, 0
+            t_start = time.monotonic()
+            while not stop_evt.is_set():
+                burst = 100
+                for i in range(burst):
+                    if (n + i) % 10 == idx % 10:
+                        put(high_pkts[(n + i) % len(high_pkts)])
+                        h += 1
+                    else:
+                        put(low_pkts[(n + i) % len(low_pkts)])
+                        lo += 1
+                n += burst
+                ahead = n / target_rate - (time.monotonic() - t_start)
+                if ahead > 0:
+                    stop_evt.wait(min(ahead, 0.05))
+            with sent_lock:
+                sent["high"] += h
+                sent["low"] += lo
+
+        health_codes, ready_log = [], []
+
+        def poll_http():
+            while not poll_stop.is_set():
+                t = time.monotonic()
+                health_codes.append(probe("/healthz"))
+                ready_log.append((t, probe("/readyz")))
+                poll_stop.wait(0.05)
+
+        phase("storm")
+        poll_stop = threading.Event()
+        poller = threading.Thread(target=poll_http, daemon=True)
+        poller.start()
+        producers = [threading.Thread(target=produce, args=(i,),
+                                      daemon=True)
+                     for i in range(n_producers)]
+        t_storm = time.monotonic()
+        for p in producers:
+            p.start()
+        flush_walls = []
+        for k in range(storm_intervals):
+            wake = t_storm + (k + 1) * interval_s
+            while time.monotonic() < wake - 0.05:
+                time.sleep(0.02)
+            f0 = time.perf_counter()
+            _flush_checked(srv)
+            flush_walls.append(time.perf_counter() - f0)
+        stop_evt.set()
+        for p in producers:
+            p.join()
+        t_load_off = time.monotonic()
+
+        phase("recover")
+        deadline = time.time() + DRAIN_TIMEOUT
+        while srv.packet_queue.qsize() > 0 and time.time() < deadline:
+            time.sleep(0.02)
+        while (ov.state > PRESSURED
+               and time.monotonic() - t_load_off < 4 * interval_s):
+            time.sleep(0.02)
+        time.sleep(0.2)   # let the pollers observe the recovered state
+        poll_stop.set()
+        poller.join()
+
+        # accounting: every packet the producers put is either admitted
+        # or shed — exactly, no third bucket
+        adm_d = {k: v - adm0.get(k, 0) for k, v in ov.admitted.items()}
+        shed_d = {k: v - shed0.get(k, 0) for k, v in ov.shed.items()}
+        shed_d.pop("flush", None)  # flush-protection rows, not packets
+        total_sent = sent["high"] + sent["low"]
+        accounted = (sum(adm_d.values()) + sum(shed_d.values())
+                     == total_sent)
+        high_dropped = shed_d.get("high", 0)
+        low_shed = shed_d.get("low", 0)
+
+        # readiness latency vs the state machine's own transition stamps
+        t_shed = next((ts for ts, _f, to in ov.transitions
+                       if to >= SHEDDING and ts >= t_storm), None)
+        t_flip = next((t for t, c in ready_log if c != 200), None)
+        t_back = next((t for t, c in ready_log
+                       if t > t_load_off and c == 200), None)
+        flip_s = (t_flip - t_shed) if t_shed and t_flip else None
+        recover_s = (t_back - t_load_off) if t_back else None
+        return {
+            "config": 8, "name": "overload_storm",
+            "capacity_samples_per_sec": round(capacity, 1),
+            "overload_ratio": round(
+                total_sent / (t_load_off - t_storm) / capacity, 2),
+            "sent": sent, "admitted": adm_d, "shed": shed_d,
+            "accounting_exact": accounted,
+            "healthz_all_200": all(c == 200 for c in health_codes),
+            "healthz_probes": len(health_codes),
+            "readyz_flip_seconds": round(flip_s, 3) if flip_s is not None
+            else None,
+            "readyz_flip_within_interval": flip_s is not None
+            and flip_s <= interval_s,
+            "readyz_recover_seconds": round(recover_s, 3)
+            if recover_s is not None else None,
+            "readyz_recover_within_2_intervals": recover_s is not None
+            and recover_s <= 2 * interval_s,
+            "high_drop_fraction": round(
+                high_dropped / max(1, sent["high"]), 4),
+            "high_drop_under_1pct":
+                high_dropped / max(1, sent["high"]) < 0.01,
+            "low_absorbed_shedding": low_shed > 0,
+            "flush_wall_seconds": [round(w, 3) for w in flush_walls],
+            "flush_deadline_met": max(flush_walls) <= interval_s,
+            "transitions": len(ov.transitions),
+        }
+    finally:
+        srv.shutdown()
+
+
 CONFIGS = {1: config1_counter_replay, 2: config2_zipf_timers,
            3: config3_set_cardinality, 4: config4_global_merge,
            5: config5_span_firehose, 6: config6_cardinality_stress,
-           7: config7_checkpoint_restore}
+           7: config7_checkpoint_restore, 8: config8_overload_storm}
 
 # Per-config subprocess budget: backend init + first XLA compiles of the
 # config's size buckets (~tens of seconds each on the tunneled chip) +

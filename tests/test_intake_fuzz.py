@@ -3,18 +3,25 @@ listeners must answer with the right status (HTTP) or keep reading
 (UDP) — never die or 500. The pipeline-thread DoS class (set members,
 events) was found by fuzz; these pin the transport layer the same way."""
 
+import io
 import socket
+import struct
 import time
 import urllib.error
 import urllib.request
 import zlib
 
 import numpy as np
+import pytest
 
+from veneur_tpu.protocol.wire import (MAX_SSF_PACKET_LENGTH, FramingError,
+                                      parse_ssf, read_ssf, write_ssf)
+from veneur_tpu.samplers.parser import (ParseError, parse_event,
+                                        parse_metric, parse_service_check)
 from veneur_tpu.server.server import Server
 from veneur_tpu.sinks.debug import DebugMetricSink, DebugSpanSink
 
-from tests.test_server import small_config
+from tests.test_server import _wait_until, small_config
 
 
 def test_http_import_random_bodies_never_5xx():
@@ -75,5 +82,164 @@ def test_ssf_udp_random_datagrams_keep_reader_alive():
                 x.name == "ok" for x in ssink.spans):
             time.sleep(0.05)
         assert any(x.name == "ok" for x in ssink.spans), "reader died"
+    finally:
+        srv.shutdown()
+
+# -- malformed-datagram corpus (overload hardening) --------------------------
+# A parser that raises anything but ParseError under garbage input kills
+# the pipeline thread — the single worst failure mode under overload,
+# when garbage is most likely (truncated datagrams from full socket
+# buffers). The corpus enumerates the malformation classes by hand; the
+# random fuzzers above cover the space between them.
+
+MALFORMED_METRIC_CORPUS = [
+    # truncated at every plausible boundary
+    b"", b":", b"|", b"a", b"a:", b"a:1", b"a:1|", b"a:1|c|", b"a:1|c|@",
+    b"a:1|c|#", b"a:1|c|@0.5|", b"a:|c", b"a:1|c|@|#t:1",
+    # zero-length names
+    b":1|c", b":|c", b":1|ms|#tag:v",
+    # NaN / Inf / absurd numerics
+    b"a:nan|c", b"a:NaN|g", b"a:inf|c", b"a:-inf|ms", b"a:Infinity|h",
+    b"a:1e400|c", b"a:-1e400|g", b"a:0x10|c", b"a:1_000|c", b"a:++1|c",
+    # bad sample rates
+    b"a:1|c|@nan", b"a:1|c|@inf", b"a:1|c|@-1", b"a:1|c|@0",
+    b"a:1|c|@2abc", b"a:1|c|@",
+    # bad types
+    b"a:1|x", b"a:1|cc", b"a:1|\xff", b"a:1|", b"a:1|9",
+    # oversized tag sets / tag abuse
+    b"a:1|c|#" + b",".join(b"tag%d:%s" % (i, b"v" * 64)
+                           for i in range(200)),
+    b"a:1|c|#" + b"t" * 65536,
+    b"a:1|c|#,,,,", b"a:1|c|##", b"a:1|c|#:",
+    # invalid UTF-8 in every field
+    b"\xff\xfe:1|c", b"a\x80b:1|c", b"a:1|c|#\xc3:\x28",
+    b"s\xf0\x28\x8c\x28:m|s", b"a:\xff|s",
+    # embedded NULs and control bytes
+    b"a\x00b:1|c", b"a:1\x00|c", b"a:1|c|#t:\x00",
+    # multiple colons / pipes in odd places
+    b"a:b:c|g", b"a:1|c|c|c|c", b"||||", b"::::",
+]
+
+
+def test_parse_metric_corpus_never_raises_unexpectedly():
+    for pkt in MALFORMED_METRIC_CORPUS:
+        try:
+            parse_metric(pkt)
+        except ParseError:
+            pass  # the one sanctioned rejection path
+        except Exception as e:
+            pytest.fail(f"parse_metric({pkt!r}) leaked "
+                        f"{type(e).__name__}: {e}")
+
+
+MALFORMED_EVENT_CORPUS = [
+    b"_e{", b"_e{}", b"_e{}:", b"_e{1,1}:", b"_e{0,0}:|",
+    b"_e{99,99}:short|x", b"_e{nan,1}:a|b", b"_e{-1,-1}:a|b",
+    b"_e{1,1}:a|b|x:", b"_e{1,1}:a|b|d:nan", b"_e{1,1}:a|b|p:bogus",
+    b"_e{1,1}:a|b|t:bogus", b"_e{1,1}:\xff|\xfe",
+    b"_e{18446744073709551616,1}:a|b",
+]
+
+MALFORMED_CHECK_CORPUS = [
+    b"_sc", b"_sc|", b"_sc|name", b"_sc|name|", b"_sc|name|9",
+    b"_sc|name|nan", b"_sc||0", b"_sc|name|0|d:nan", b"_sc|name|0|x:",
+    b"_sc|\xff\xfe|0", b"_sc|name|0|m:\xc3\x28",
+]
+
+
+def test_parse_event_and_check_corpus_never_raise_unexpectedly():
+    for fn, corpus in ((parse_event, MALFORMED_EVENT_CORPUS),
+                       (parse_service_check, MALFORMED_CHECK_CORPUS)):
+        for pkt in corpus:
+            try:
+                fn(pkt, now=1)
+            except ParseError:
+                pass
+            except Exception as e:
+                pytest.fail(f"{fn.__name__}({pkt!r}) leaked "
+                            f"{type(e).__name__}: {e}")
+
+
+def _ssf_frames():
+    """Malformed SSF frame corpus: (stream_bytes, why)."""
+    from veneur_tpu.proto import ssf_pb2
+    good = ssf_pb2.SSFSpan(version=0, trace_id=1, id=2, service="s",
+                           name="n", start_timestamp=1, end_timestamp=2)
+    buf = io.BytesIO()
+    write_ssf(buf, good)
+    frame = buf.getvalue()
+    return [
+        (frame[:1], "truncated before length"),
+        (frame[:3], "truncated mid-length"),
+        (frame[:6], "truncated mid-body"),
+        (b"\x01" + frame[1:], "unknown version"),
+        (b"\xff" * 5, "garbage header"),
+        (struct.pack(">BI", 0, MAX_SSF_PACKET_LENGTH + 1),
+         "oversized length"),
+        (struct.pack(">BI", 0, 8) + b"\xde\xad\xbe\xef\xde\xad\xbe\xef",
+         "valid frame, garbage protobuf"),
+    ]
+
+
+def test_read_ssf_corpus_raises_only_framing_or_decode_errors():
+    from google.protobuf.message import DecodeError
+    for raw, why in _ssf_frames():
+        try:
+            read_ssf(io.BytesIO(raw))
+        except (FramingError, DecodeError):
+            pass  # framing errors are fatal-per-connection by contract
+        except Exception as e:
+            pytest.fail(f"read_ssf({why}) leaked {type(e).__name__}: {e}")
+    # clean EOF at a boundary is None, not an error
+    assert read_ssf(io.BytesIO(b"")) is None
+
+
+def test_parse_ssf_garbage_raises_only_decode_error():
+    from google.protobuf.message import DecodeError
+    rng = np.random.default_rng(11)
+    for n in (1, 2, 7, 33, 257):
+        blob = bytes(rng.integers(0, 256, n).astype(np.uint8))
+        try:
+            parse_ssf(blob)
+        except DecodeError:
+            pass
+        except Exception as e:
+            pytest.fail(f"parse_ssf({n}B garbage) leaked "
+                        f"{type(e).__name__}: {e}")
+
+
+def test_server_accounts_every_corpus_rejection():
+    """End to end: the full malformed corpus over real UDP. Every
+    datagram must land in processed or in the registered drop counter
+    (veneur.parse_errors_total) — shed, not lost — and the pipeline
+    thread must survive to flush a valid metric afterward."""
+    sink = DebugMetricSink()
+    srv = Server(small_config(native_ingest=False), metric_sinks=[sink])
+    srv.start()
+    try:
+        addr = srv.local_addr()
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        # empty payloads don't traverse UDP and the 64KiB tag entry
+        # exceeds the datagram limit — both stay parser-level-only
+        corpus = [p for p in (MALFORMED_METRIC_CORPUS
+                              + MALFORMED_EVENT_CORPUS
+                              + MALFORMED_CHECK_CORPUS)
+                  if p and len(p) < 60000]
+        for pkt in corpus:
+            s.sendto(pkt, addr)
+        s.sendto(b"fuzz.survivor:1|c", addr)
+        s.close()
+
+        def accounted():
+            return (srv.aggregator.processed + srv.parse_errors
+                    + srv.aggregator.extra_parse_errors()) >= \
+                len(corpus) + 1
+        _wait_until(accounted, 60, "corpus fully accounted")
+        # rejections landed in the REGISTERED counter, not a shadow int
+        assert srv.metrics.flat_values()["veneur.parse_errors_total"] \
+            == float(srv.parse_errors)
+        assert srv.parse_errors > 0
+        assert srv.trigger_flush(wait=True, timeout=120)
+        assert any(m.name == "fuzz.survivor" for m in sink.flushed)
     finally:
         srv.shutdown()

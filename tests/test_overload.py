@@ -1,0 +1,616 @@
+"""Overload management: state machine hysteresis, admission accounting,
+degraded aggregation, /healthz + /readyz, TCP hardening, proxy ring
+ejection, discovery fail-static, and the drop-accounting lint.
+
+Unit tests drive the controller in virtual time (injectable clock +
+scripted signals, the CircuitBreaker testing pattern); server tests run
+the real pipeline on loopback with `native_ingest=False` so admission
+and degradation apply on the Python path.
+"""
+
+import json
+import pathlib
+import socket
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tests.test_server import (_send_udp, _wait_processed, _wait_until,
+                               by_name, small_config)
+from veneur_tpu.forward.discovery import ConsulDiscoverer, StaticDiscoverer
+from veneur_tpu.forward.proxysrv import ProxyServer
+from veneur_tpu.reliability.overload import (CRITICAL, HEALTHY, PRESSURED,
+                                             SHEDDING, OverloadController,
+                                             PriorityClassifier, TokenBucket)
+from veneur_tpu.reliability.policy import CircuitBreaker
+from veneur_tpu.server.health import check_live, check_ready
+from veneur_tpu.server.server import Server
+from veneur_tpu.sinks.debug import DebugMetricSink
+
+
+class VClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def tick(self, dt):
+        self.t += dt
+
+
+def _controller(signals, clock, **kw):
+    kw.setdefault("hold_s", 5.0)
+    return OverloadController(signals=signals, clock=clock, **kw)
+
+
+# -- unit: token bucket / classifier ----------------------------------------
+
+def test_token_bucket_refill_virtual_time():
+    clk = VClock()
+    b = TokenBucket(rate=10.0, burst=5.0, clock=clk)
+    assert sum(b.allow() for _ in range(10)) == 5  # burst drained
+    clk.tick(0.5)  # +5 tokens
+    assert sum(b.allow() for _ in range(10)) == 5
+    clk.tick(100.0)  # refill clamps at burst
+    assert sum(b.allow() for _ in range(10)) == 5
+
+
+def test_priority_classifier():
+    c = PriorityClassifier(["veneur.priority:high"])
+    assert c.classify(b"veneur.flush.total:1|c") == "self"
+    assert c.classify(b"app.x:1|c|#veneur.priority:high,env:prod") == "high"
+    assert c.classify(b"app.x:1|c|#env:prod") == "low"
+    # multi-line datagram promotes on its strongest line
+    assert c.classify(
+        b"app.a:1|c\napp.b:1|c|#veneur.priority:high") == "high"
+
+
+# -- unit: state machine hysteresis -----------------------------------------
+
+def test_upgrades_immediate_downgrades_held():
+    clk = VClock()
+    sig = {"q": 0.0}
+    ov = _controller(lambda: sig, clk, hold_s=5.0)
+    assert ov.poll() == HEALTHY
+    # a pressure spike upgrades in ONE poll, multi-level
+    sig["q"] = 0.97
+    assert ov.poll() == CRITICAL
+    # pressure gone, but dwell not served: still CRITICAL
+    sig["q"] = 0.0
+    clk.tick(4.9)
+    assert ov.poll() == CRITICAL
+    # dwell served: one level per poll, each with its own dwell
+    clk.tick(0.2)
+    assert ov.poll() == SHEDDING
+    clk.tick(5.1)
+    assert ov.poll() == PRESSURED
+    clk.tick(5.1)
+    assert ov.poll() == HEALTHY
+    # exact transition count: 1 upgrade + 3 stepped downgrades
+    assert len(ov.transitions) == 4
+
+
+def test_no_flapping_across_a_load_step():
+    """The chaos property: a load step that lands near a threshold must
+    produce exactly one transition, not a square wave."""
+    clk = VClock()
+    sig = {"q": 0.0}
+    ov = _controller(lambda: sig, clk, hold_s=5.0, exit_margin=0.10)
+    ov.poll()
+    # step to just above enter_shedding and HOLD it, polling at 10Hz
+    sig["q"] = 0.86
+    for _ in range(600):
+        ov.poll()
+        clk.tick(0.1)
+    assert ov.state == SHEDDING
+    assert len(ov.transitions) == 1  # one step up, zero flaps
+    # hover just below the entry threshold but above the exit margin:
+    # the downgrade is suppressed no matter how long we dwell
+    sig["q"] = 0.80  # enter(0.85) - margin(0.10) = 0.75 < 0.80 < 0.85
+    for _ in range(600):
+        ov.poll()
+        clk.tick(0.1)
+    assert ov.state == SHEDDING
+    assert len(ov.transitions) == 1
+    # a real drop clears it, stepping monotonically
+    sig["q"] = 0.10
+    for _ in range(300):
+        ov.poll()
+        clk.tick(0.1)
+    assert ov.state == HEALTHY
+    assert len(ov.transitions) == 3
+    states = [t[2] for t in ov.transitions]
+    assert states == [SHEDDING, PRESSURED, HEALTHY]
+
+
+def test_broken_signal_source_never_kills_poll():
+    clk = VClock()
+    ov = _controller(lambda: 1 / 0, clk)
+    assert ov.poll() == HEALTHY  # holds last (empty) signals
+
+
+# -- unit: admission accounting ---------------------------------------------
+
+def test_admission_exact_accounting_by_class():
+    clk = VClock()
+    sig = {"q": 0.0}
+    ov = _controller(lambda: sig, clk,
+                     shed_priority_tags=["veneur.priority:high"])
+    sent = 0
+    for state_pressure in (0.0, 0.90, 0.97):  # HEALTHY, SHEDDING, CRITICAL
+        sig["q"] = state_pressure
+        ov.poll()
+        for _ in range(100):
+            ov.admit(b"app.low:1|c")
+            ov.admit(b"app.high:1|c|#veneur.priority:high")
+            ov.admit(b"veneur.self:1|c")
+            sent += 3
+    assert ov.admitted_total + sum(n for _, n in ov.shed_snapshot()) == sent
+    adm, shed = dict(ov.admitted), dict(ov.shed)
+    # self NEVER shed; low shed in SHEDDING and CRITICAL rounds
+    assert adm["self"] == 300 and "self" not in shed
+    assert shed["low"] == 200 and adm["low"] == 100
+    # high passes until CRITICAL; with no bucket configured it still
+    # passes there (admit_rate=0 disables the bucket)
+    assert adm["high"] == 300
+
+
+def test_admission_high_priority_bucket_at_critical():
+    clk = VClock()
+    sig = {"q": 0.97}
+    ov = _controller(lambda: sig, clk, admit_rate=5.0, admit_burst=5.0,
+                     shed_priority_tags=["veneur.priority:high"])
+    ov.poll()
+    assert ov.state == CRITICAL
+    got = sum(ov.admit(b"a:1|c|#veneur.priority:high") for _ in range(20))
+    assert got == 5  # burst-limited, not unlimited
+    assert ov.import_blocked()
+    assert not ov.admit_import(7)
+    assert dict(ov.shed)["import"] == 7
+
+
+def test_degradation_knobs_follow_state():
+    clk = VClock()
+    sig = {"q": 0.0}
+    ov = _controller(lambda: sig, clk, timer_sample_rate=0.25, set_shift=3)
+    ov.poll()
+    assert ov.degraded_timer_rate() == 1.0 and ov.degraded_set_shift() == 0
+    sig["q"] = 0.90
+    ov.poll()
+    assert ov.degraded_timer_rate() == 0.25 and ov.degraded_set_shift() == 3
+
+
+# -- server: health endpoints + end-to-end shedding -------------------------
+
+def _overload_config(**kw):
+    defaults = dict(
+        interval="5s", http_address="127.0.0.1:0", native_ingest=False,
+        overload_enabled=True, overload_poll_interval_s=0.05,
+        overload_hold_s=0.3,
+        shed_priority_tags=["veneur.priority:high"])
+    defaults.update(kw)
+    return small_config(**defaults)
+
+
+def _http(port, path):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+@pytest.fixture
+def overload_server():
+    sink = DebugMetricSink()
+    srv = Server(_overload_config(), metric_sinks=[sink])
+    srv.start()
+    yield srv, sink
+    srv.shutdown()
+
+
+def test_healthz_readyz_lifecycle(overload_server):
+    srv, _sink = overload_server
+    port = srv._httpd.server_address[1]
+    code, live = _http(port, "/healthz")
+    assert code == 200 and live["live"]
+    code, ready = _http(port, "/readyz")
+    assert code == 200 and ready["ready"]
+    assert ready["overload_state"] == "healthy"
+
+    # drive the REAL poller into SHEDDING via injected signals
+    ov = srv._overload
+    ov._signals = lambda: {"synthetic": 0.9}
+    _wait_until(lambda: ov.state == SHEDDING, 10, "SHEDDING")
+    code, ready = _http(port, "/readyz")
+    assert code == 503 and not ready["ready"]
+    assert ready["overload_state"] == "shedding"
+    # a SHEDDING server is still LIVE — restarting it would turn
+    # graceful degradation into an outage
+    code, live = _http(port, "/healthz")
+    assert code == 200 and live["live"]
+    # the poller pushed the degradation knobs into the aggregator
+    _wait_until(lambda: srv.aggregator.degraded_timer_rate < 1.0, 10,
+                "degraded timer rate pushed")
+    assert srv.aggregator.pending_set_shift > 0
+
+    # recovery: readyz flips back once the state machine steps down
+    ov._signals = lambda: {"synthetic": 0.0}
+    _wait_until(lambda: ov.state == HEALTHY, 15, "HEALTHY again")
+    code, _ = _http(port, "/readyz")
+    assert code == 200
+    assert srv.aggregator.degraded_timer_rate == 1.0
+
+
+def test_udp_shedding_accounting_and_priority(overload_server):
+    srv, _sink = overload_server
+    ov = srv._overload
+    addr = srv.local_addr()
+    ov._signals = lambda: {"synthetic": 0.9}
+    _wait_until(lambda: ov.state == SHEDDING, 10, "SHEDDING")
+    n = 50
+    for i in range(n):
+        _send_udp(addr, [b"app.low:1|c"])
+        _send_udp(addr, [b"app.high:1|c|#veneur.priority:high"])
+        _send_udp(addr, [b"veneur.mine:1|c"])
+    _wait_until(
+        lambda: ov.admitted_total
+        + sum(c for _, c in ov.shed_snapshot()) >= 3 * n,
+        30, "all packets accounted")
+    adm, shed = dict(ov.admitted), dict(ov.shed)
+    # exact accounting: every packet is either admitted or shed
+    assert adm.get("low", 0) + shed.get("low", 0) == n
+    assert shed.get("low", 0) == n        # low sheds under SHEDDING
+    assert adm.get("high", 0) == n and "high" not in shed
+    assert adm.get("self", 0) >= n and "self" not in shed
+    # telemetry mirrors the controller exactly
+    _code, stats = _http(srv._httpd.server_address[1], "/stats")
+    tele = stats["telemetry"]
+    assert tele["veneur.overload.shed_total{class=low}"] == shed["low"]
+    assert tele["veneur.overload.state"] == float(SHEDDING)
+
+
+def test_critical_flush_protection(overload_server):
+    srv, sink = overload_server
+    ov = srv._overload
+    addr = srv.local_addr()
+    # separate datagrams: classification is per packet, and one datagram
+    # carrying both lines would classify whole-packet "high"
+    _send_udp(addr, [b"app.keep:1|c|#veneur.priority:high"])
+    _send_udp(addr, [b"app.gone:1|c"])
+    # wait on the controller's own admission counters: self-telemetry
+    # loop-back inflates `processed`, so _wait_processed can return
+    # before the datagram has even reached the pipeline — and a flush
+    # triggered then would race ahead of it in the queue
+    _wait_until(lambda: dict(ov.admitted).get("low", 0) >= 1
+                and dict(ov.admitted).get("high", 0) >= 1,
+                30, "both metrics admitted")
+    ov._signals = lambda: {"synthetic": 0.99}
+    _wait_until(lambda: ov.state == CRITICAL, 10, "CRITICAL")
+    assert srv.trigger_flush(wait=True, timeout=120)
+    m = by_name(sink.flushed)
+    # high-priority and self rows flushed; low-priority rows withheld
+    assert "app.keep" in m
+    assert "app.gone" not in m
+    assert dict(ov.shed).get("flush", 0) >= 1
+    assert ov.degraded_flushes >= 1
+    # the aggregated row was NOT lost — it was withheld from fan-out
+    # this interval only, and the next interval starts clean
+    ov._signals = lambda: {"synthetic": 0.0}
+    _wait_until(lambda: ov.state <= PRESSURED, 15, "recovered")
+    sink.flushed.clear()
+    _send_udp(addr, [b"app.second:2|c"])
+    _wait_until(lambda: dict(ov.admitted).get("low", 0) >= 2,
+                30, "app.second admitted")
+    assert srv.trigger_flush(wait=True, timeout=120)
+    assert "app.second" in by_name(sink.flushed)
+
+
+def test_check_live_detects_dead_threads():
+    sink = DebugMetricSink()
+    srv = Server(_overload_config(), metric_sinks=[sink])
+    srv.start()
+    try:
+        ok, detail = check_live(srv)
+        assert ok and detail["pipeline_thread_alive"]
+        ok, detail = check_ready(srv)
+        assert ok
+    finally:
+        srv.shutdown()
+    # after shutdown the pipeline thread is gone: not live
+    ok, detail = check_live(srv)
+    assert not ok and not detail["pipeline_thread_alive"]
+
+
+# -- server: degraded aggregation accuracy ----------------------------------
+
+def test_degraded_timer_quantiles_within_5pct():
+    """SHEDDING timers admit a fraction p with the correction recorded
+    in the sample rate: quantiles must stay within 5% of the exact ones
+    and the count must stay unbiased."""
+    rng = np.random.default_rng(7)
+    samples = rng.lognormal(mean=3.0, sigma=0.6, size=8000)
+    sink = DebugMetricSink()
+    srv = Server(small_config(native_ingest=False), metric_sinks=[sink])
+    srv.start()
+    try:
+        srv.aggregator.degraded_timer_rate = 0.5  # forced degradation
+        for v in samples:
+            srv.packet_queue.put(b"deg.timer:%.6f|ms" % v)
+        _wait_processed(srv, len(samples), timeout=180)
+        assert srv.aggregator.degraded_timer_skipped > 0
+        assert srv.trigger_flush(wait=True, timeout=180)
+    finally:
+        srv.shutdown()
+    m = by_name(sink.flushed)
+    for q, name in ((0.5, "deg.timer.50percentile"),
+                    (0.99, "deg.timer.99percentile")):
+        exact = float(np.quantile(samples, q))
+        got = m[name].value
+        assert abs(got - exact) / exact < 0.05, (name, got, exact)
+    # weights carry 1/(rate*p): the flushed count stays ~unbiased even
+    # though only ~half the samples were staged (binomial noise only)
+    assert m["deg.timer.count"].value == pytest.approx(
+        len(samples), rel=0.10)
+
+
+def test_degraded_set_shift_correction():
+    """Sets under degradation subsample members by hash prefix at
+    2^-shift; the flushed estimate is multiplied back by 2^shift."""
+    sink = DebugMetricSink()
+    srv = Server(small_config(native_ingest=False), metric_sinks=[sink])
+    srv.start()
+    try:
+        srv.aggregator.active_set_shift = 2
+        srv.aggregator.pending_set_shift = 2
+        n = 2000
+        for i in range(n):
+            srv.packet_queue.put(b"deg.set:member-%d|s" % i)
+        _wait_processed(srv, n, timeout=180)
+        assert srv.aggregator.degraded_set_skipped > 0
+        assert srv.trigger_flush(wait=True, timeout=180)
+    finally:
+        srv.shutdown()
+    m = by_name(sink.flushed)
+    # HLL error (~2% at default precision) + subsample variance at 1/4:
+    # 15% is a generous, non-flaky bound; the UNcorrected estimate
+    # (~n/4) would miss it by 4x
+    assert m["deg.set"].value == pytest.approx(n, rel=0.15)
+
+
+# -- server: TCP statsd hardening -------------------------------------------
+
+def _tcp_config(**kw):
+    defaults = dict(
+        statsd_listen_addresses=["tcp://127.0.0.1:0"],
+        native_ingest=False)
+    defaults.update(kw)
+    return small_config(**defaults)
+
+
+def _closed_by_peer(conn, timeout=10.0):
+    conn.settimeout(timeout)
+    try:
+        return conn.recv(1) == b""
+    except socket.timeout:
+        return False
+    except OSError:
+        return True
+
+
+def test_tcp_max_connections_cap():
+    sink = DebugMetricSink()
+    srv = Server(_tcp_config(tcp_max_connections=2), metric_sinks=[sink])
+    srv.start()
+    try:
+        addr = srv.local_addr()
+        c1 = socket.create_connection(addr, timeout=5)
+        c2 = socket.create_connection(addr, timeout=5)
+        c1.sendall(b"tcp.a:1|c\n")
+        c2.sendall(b"tcp.b:1|c\n")
+        _wait_processed(srv, 2)
+        # the third connection is refused (closed immediately, counted)
+        c3 = socket.create_connection(addr, timeout=5)
+        assert _closed_by_peer(c3)
+        _wait_until(lambda: srv._c_tcp_rejected.value() == 1, 10,
+                    "rejected counter")
+        c3.close()
+        # freeing a slot re-admits new connections
+        c1.close()
+        _wait_until(lambda: srv._tcp_conns_live < 2, 10, "slot freed")
+        c4 = socket.create_connection(addr, timeout=5)
+        c4.sendall(b"tcp.c:1|c\n")
+        _wait_processed(srv, 3)
+        c4.close()
+        c2.close()
+    finally:
+        srv.shutdown()
+
+
+def test_tcp_idle_timeout_closes_connection():
+    sink = DebugMetricSink()
+    srv = Server(_tcp_config(tcp_idle_timeout_s=0.5), metric_sinks=[sink])
+    srv.start()
+    try:
+        addr = srv.local_addr()
+        c = socket.create_connection(addr, timeout=5)
+        c.sendall(b"tcp.live:1|c\n")
+        _wait_processed(srv, 1)
+        # now go idle past the deadline: the server closes the conn
+        assert _closed_by_peer(c, timeout=20.0)
+        _wait_until(lambda: srv._c_tcp_idle_closed.value() == 1, 10,
+                    "idle-closed counter")
+        c.close()
+    finally:
+        srv.shutdown()
+
+
+# -- proxy: ring ejection + readyz consultation -----------------------------
+
+def test_proxy_ejects_open_breaker_and_readmits_on_half_open():
+    dests = ["h1:1", "h2:1", "h3:1"]
+    clk = VClock()
+    p = ProxyServer(StaticDiscoverer(dests), failure_threshold=1,
+                    cooldown_s=30.0)
+    try:
+        assert sorted(p._routing_ring().destinations) == dests
+        # open h2's breaker with an injectable clock
+        b = CircuitBreaker(1, 30.0, clock=clk)
+        b.record_failure()
+        with p._lock:
+            p._breakers["h2:1"] = b
+        ring = p._routing_ring()
+        assert sorted(ring.destinations) == ["h1:1", "h3:1"]
+        # every key routes to a SURVIVOR (the ejected keyspace rehashes)
+        for i in range(200):
+            assert ring.get(b"key-%d" % i) != "h2:1"
+        # ring rebuild is cached while the exclusion set is unchanged
+        assert p._routing_ring() is ring
+        # cooldown elapsed -> HALF_OPEN -> destination re-admitted; the
+        # per-batch allow() gate owns the single probe from here
+        clk.tick(31.0)
+        assert sorted(p._routing_ring().destinations) == dests
+    finally:
+        p.stop()
+
+
+def test_proxy_never_routes_over_empty_ring():
+    clk = VClock()
+    p = ProxyServer(StaticDiscoverer(["only:1"]), failure_threshold=1,
+                    cooldown_s=30.0)
+    try:
+        b = CircuitBreaker(1, 30.0, clock=clk)
+        b.record_failure()
+        with p._lock:
+            p._breakers["only:1"] = b
+        # all destinations excluded -> fail-static on the full ring
+        assert p._routing_ring().destinations == ["only:1"]
+    finally:
+        p.stop()
+
+
+def test_proxy_consults_peer_readyz():
+    calls = []
+
+    class FakeResp:
+        def __init__(self, status):
+            self.status = status
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            return False
+
+    ready = {"h1": 200, "h2": 503}
+
+    def opener(url, timeout=0):
+        host = url.split("//")[1].split(":")[0]
+        calls.append(url)
+        return FakeResp(ready[host])
+
+    p = ProxyServer(StaticDiscoverer(["h1:1", "h2:1"]), readyz_port=8127,
+                    readyz_opener=opener)
+    try:
+        p.refresh()
+        assert any("h1:8127/readyz" in u for u in calls)
+        assert sorted(p._routing_ring().destinations) == ["h1:1"]
+        ready["h2"] = 200
+        p.refresh()
+        assert sorted(p._routing_ring().destinations) == ["h1:1", "h2:1"]
+    finally:
+        p.stop()
+
+
+def test_proxy_discovery_stale_gauge():
+    class Flaky:
+        def __init__(self):
+            self.fail = False
+            self.stale = 0
+
+        def get_destinations_for_service(self, service):
+            if self.fail:
+                self.stale = 1
+                return ["h1:1"]
+            self.stale = 0
+            return ["h1:1"]
+
+    d = Flaky()
+    p = ProxyServer(d)
+    try:
+        flat = {m.name: m for m in p.metrics.collect()}
+        gauge = flat["veneur.discovery.stale"]
+        assert [v for _lv, v in gauge.samples()] == [0.0]
+        d.fail = True
+        p.refresh()
+        assert [v for _lv, v in gauge.samples()] == [1.0]
+    finally:
+        p.stop()
+
+
+# -- discovery: fail-static -------------------------------------------------
+
+def test_consul_discoverer_fail_static():
+    payload = json.dumps([
+        {"Service": {"Address": "10.0.0.1", "Port": 8128}, "Node": {}},
+        {"Service": {"Port": 8128}, "Node": {"Address": "10.0.0.2"}},
+    ]).encode()
+    state = {"fail": False}
+
+    class Resp:
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            return False
+
+        def read(self):
+            return payload
+
+    def opener(url, timeout=0):
+        if state["fail"]:
+            raise OSError("consul down")
+        return Resp()
+
+    d = ConsulDiscoverer(opener=opener)
+    got = d.get_destinations_for_service("veneur-global")
+    assert got == ["10.0.0.1:8128", "10.0.0.2:8128"]
+    assert d.stale == 0
+    # transient failure: serve last-known-good, flag stale
+    state["fail"] = True
+    got = d.get_destinations_for_service("veneur-global")
+    assert got == ["10.0.0.1:8128", "10.0.0.2:8128"]
+    assert d.stale == 1
+    # recovery clears the flag
+    state["fail"] = False
+    assert d.get_destinations_for_service("veneur-global") == got
+    assert d.stale == 0
+
+
+def test_consul_discoverer_no_last_good_raises():
+    def opener(url, timeout=0):
+        raise OSError("consul down")
+
+    d = ConsulDiscoverer(opener=opener)
+    with pytest.raises(OSError):
+        d.get_destinations_for_service("veneur-global")
+
+
+# -- lint wiring ------------------------------------------------------------
+
+def test_drop_accounting_lint_passes():
+    """Every data-discarding code path increments a registered counter
+    (scripts/check_drop_accounting.py), same wiring convention as the
+    bare-except lint in test_chaos.py."""
+    script = (pathlib.Path(__file__).resolve().parent.parent
+              / "scripts" / "check_drop_accounting.py")
+    proc = subprocess.run([sys.executable, str(script)],
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr

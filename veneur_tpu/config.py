@@ -135,6 +135,29 @@ class Config:
     flush_trace_enabled: bool = False  # per-phase span tree + row/byte tags
     self_timer_compression: float = 50.0  # t-digest delta for self-timers
 
+    # overload management (veneur_tpu/reliability/overload.py; README
+    # §Overload & health). Off by default: no controller, no poller
+    # thread, no per-packet admission check — prior behavior exactly.
+    overload_enabled: bool = False     # master switch for the controller
+    overload_poll_interval_s: float = 0.25   # pressure sampling cadence
+    overload_enter_pressured: float = 0.70   # state entry thresholds on
+    overload_enter_shedding: float = 0.85    # max-normalized pressure
+    overload_enter_critical: float = 0.95
+    overload_exit_margin: float = 0.10  # hysteresis: exit below entry-margin
+    overload_hold_s: float = 5.0       # min dwell before any downgrade
+    overload_admit_rate: float = 0.0   # token bucket pkts/s (0 = no bucket)
+    overload_admit_burst: float = 0.0  # bucket depth (0 = admit_rate)
+    overload_timer_sample_rate: float = 0.5  # degraded timer admit fraction
+    overload_set_shift: int = 2        # degraded HLL member-subsample bits
+    shed_priority_tags: List[str] = dataclasses.field(
+        default_factory=list)          # substrings shed LAST (e.g.
+    #                                    "veneur.priority:high")
+
+    # TCP statsd hardening: connection cap + per-connection idle
+    # deadline (a slowloris peer must not pin reader threads forever).
+    tcp_max_connections: int = 0       # concurrent conns (0 = unlimited)
+    tcp_idle_timeout_s: float = 0.0    # close idle conns (0 = no deadline)
+
     # debug
     debug: bool = False
     debug_flushed_metrics: bool = False

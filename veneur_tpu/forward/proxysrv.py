@@ -16,8 +16,9 @@ import threading
 from typing import Dict, List, Optional
 
 from veneur_tpu.forward.rpc import ForwardClient, serve
+from veneur_tpu.observability.registry import TelemetryRegistry
 from veneur_tpu.reliability.faults import FAULTS, PROXY_FORWARD
-from veneur_tpu.reliability.policy import CircuitBreaker
+from veneur_tpu.reliability.policy import OPEN, CircuitBreaker
 from veneur_tpu.utils.hashing import fnv1a_64, splitmix64
 
 
@@ -63,7 +64,8 @@ class ProxyServer:
 
     def __init__(self, discoverer, service: str = "veneur-global",
                  refresh_interval: float = 0.0, replicas: int = 128,
-                 failure_threshold: int = 0, cooldown_s: float = 30.0):
+                 failure_threshold: int = 0, cooldown_s: float = 30.0,
+                 readyz_port: int = 0, readyz_opener=None):
         self.discoverer = discoverer
         self.service = service
         self.refresh_interval = refresh_interval
@@ -76,6 +78,25 @@ class ProxyServer:
         self._breakers: Dict[str, CircuitBreaker] = {}
         self.rejected_open = 0
         self._ring = HashRing([], replicas)
+        # overload-aware routing: peers answering /readyz non-200 (the
+        # server's overload state machine) and OPEN-breaker destinations
+        # are ejected from a derived routing ring so their keyspace
+        # rehashes to survivors instead of queueing behind a sick peer.
+        # readyz_port=0 disables probing (destinations' gRPC port is not
+        # their HTTP port, so it must be configured explicitly).
+        self.readyz_port = readyz_port
+        self._readyz_open = readyz_opener  # injectable for tests
+        self._not_ready: frozenset = frozenset()
+        self._routing_cache = None  # ((id(base), excluded), derived ring)
+        # registry: the proxy's own veneur.* instruments (the statsd
+        # emitter's veneur_proxy.* lines are a separate, lint-exempt
+        # namespace)
+        self.metrics = TelemetryRegistry()
+        self.metrics.callback(
+            "veneur.discovery.stale",
+            lambda: float(getattr(self.discoverer, "stale", 0) or 0),
+            kind="gauge",
+            help="1 while discovery serves last-known-good destinations")
         self._conns: Dict[str, ForwardClient] = {}
         self._lock = threading.Lock()
         self._shutdown = threading.Event()
@@ -105,9 +126,11 @@ class ProxyServer:
             dests = self.discoverer.get_destinations_for_service(self.service)
         except Exception as e:
             log.warning("discovery failed: %s", e)
+            self._probe_ready()
             return
         if not dests:
             log.warning("discovery returned no hosts; keeping last ring")
+            self._probe_ready()
             return
         with self._lock:
             self._ring = HashRing(dests, self.replicas)
@@ -117,6 +140,64 @@ class ProxyServer:
             for dest in list(self._breakers):
                 if dest not in self._ring.destinations:
                     del self._breakers[dest]
+        self._probe_ready()
+
+    def _probe_ready(self) -> None:
+        """Consult each destination's GET /readyz (server/health.py) and
+        record the non-ready set for _routing_ring. Fail-open per peer: a
+        probe that errors (connection refused, no HTTP listener) admits
+        the destination — actually-dead peers are the breakers' job, and
+        a proxy must not de-route its whole ring because probing broke."""
+        if self.readyz_port <= 0:
+            return
+        import urllib.request
+        opener = self._readyz_open or urllib.request.urlopen
+        with self._lock:
+            dests = list(self._ring.destinations)
+        not_ready = set()
+        for dest in dests:
+            host = dest.rsplit(":", 1)[0]
+            url = f"http://{host}:{self.readyz_port}/readyz"
+            try:
+                with opener(url, timeout=2) as resp:
+                    code = getattr(resp, "status", None) or resp.getcode()
+                if code != 200:
+                    not_ready.add(dest)
+            except Exception as e:
+                log.debug("readyz probe of %s failed (admitting): %s",
+                          dest, e)
+        if not_ready != self._not_ready:
+            log.info("readyz: not-ready destinations now %s",
+                     sorted(not_ready) or "(none)")
+        self._not_ready = frozenset(not_ready)
+
+    def _routing_ring(self) -> HashRing:
+        """The ring handle()/handle_json route over: the discovery ring
+        minus OPEN-breaker and not-ready destinations, rebuilt (and
+        cached) only when that exclusion set changes so the hot path
+        normally costs two dict scans. A breaker whose cooldown elapsed
+        reads HALF_OPEN, so its destination re-enters here and the
+        per-batch allow() gate claims the single probe — success closes
+        the breaker and the destination stays admitted. Fail-static:
+        with every destination excluded, route over the full ring."""
+        with self._lock:
+            base = self._ring
+            excluded = set(self._not_ready)
+            for dest, b in self._breakers.items():
+                if b.state == OPEN:
+                    excluded.add(dest)
+            excluded &= set(base.destinations)
+            if not excluded or len(excluded) == len(base.destinations):
+                return base
+            key = (id(base), frozenset(excluded))
+            cached = self._routing_cache
+            if cached is not None and cached[0] == key:
+                return cached[1]
+            ring = HashRing(
+                [d for d in base.destinations if d not in excluded],
+                self.replicas)
+            self._routing_cache = (key, ring)
+            return ring
 
     def _conn(self, dest: str) -> ForwardClient:
         with self._lock:
@@ -138,8 +219,7 @@ class ProxyServer:
         """Group by ring destination, then one SendMetrics per destination
         (proxysrv/server.go:180-188, :286)."""
         by_dest: Dict[str, List] = {}
-        with self._lock:
-            ring = self._ring  # immutable once built; snapshot suffices
+        ring = self._routing_ring()  # rings are immutable once built
         for m in metrics:
             key = f"{m.name}{m.type}{','.join(m.tags)}".encode()
             dest = ring.get(key)
@@ -178,8 +258,7 @@ class ProxyServer:
         (proxy.go:580 ProxyMetrics: key = Name+Type+JoinedTags). Returns
         the per-destination batches; callers POST each to <dest>/import."""
         by_dest: Dict[str, List[dict]] = {}
-        with self._lock:
-            ring = self._ring
+        ring = self._routing_ring()
         for jm in json_metrics:
             key = (f"{jm.get('name', '')}{jm.get('type', '')}"
                    f"{jm.get('tagstring', '')}").encode()

@@ -14,4 +14,9 @@ pure-Python, injectable-clock, so everything is testable in virtual time:
 - faults:  a process-global FaultInjector with named injection points in
            the egress paths, so chaos tests force errors, latency, and
            partial failures deterministically. Default no-op.
+- overload: OverloadController — samples the pipeline's pressure
+           signals and drives the HEALTHY -> PRESSURED -> SHEDDING ->
+           CRITICAL hysteresis state machine behind admission control,
+           priority shedding, degraded aggregation, and the /healthz +
+           /readyz endpoints (README §Overload & health).
 """

@@ -20,6 +20,7 @@ from veneur_tpu.aggregation.state import (TableSpec, empty_state_compiled)
 from veneur_tpu.aggregation.step import (
     batch_sizes, ingest_step_packed, pack_batch)
 from veneur_tpu.samplers.parser import UDPMetric
+from veneur_tpu.utils.hashing import fnv1a_64, splitmix64
 
 
 def set_member_bytes(value) -> bytes:
@@ -61,10 +62,73 @@ class Aggregator:
         # step count — _steps resets every swap, steps_total never does
         self.step_ns = 0
         self.steps_total = 0
+        self._init_degrade()
+
+    def _init_degrade(self) -> None:
+        """Degraded-aggregation state (reliability/overload.py). Every
+        backend __init__ must call this — ShardedAggregator builds its
+        own state and does not run Aggregator.__init__.
+
+        Under SHEDDING+ the OverloadController pushes these knobs; the
+        defaults (1.0 / 0) are branch-predicted no-ops on the hot path.
+        Timers: admit a fraction p of samples and scale the recorded
+        sample_rate by p — staged weight becomes 1/(rate·p), so the
+        correction is exact in expectation and needs no latch."""
+        self.degraded_timer_rate = 1.0
+        self._degrade_seq = 0
+        # Sets: admit a member iff the low k bits of fnv1a_64(member)
+        # are zero (rate 2^-k, deterministic per member so repeats stay
+        # idempotent) and multiply the flushed estimate by 2^k. The
+        # shift LATCHES at swap — pending applies from the next interval
+        # and last_set_shift is the shift that governed the interval
+        # just detached (the flush worker reads it for the correction);
+        # a mid-interval change would make the 2^k correction wrong for
+        # members admitted before the change.
+        self.pending_set_shift = 0
+        self.active_set_shift = 0
+        self.last_set_shift = 0
+        # degradation drop accounting (veneur.overload.degraded_samples
+        # _total): samples represented statistically, not lost rows
+        self.degraded_timer_skipped = 0
+        self.degraded_set_skipped = 0
 
     def extra_parse_errors(self) -> int:
         """Parse errors counted below the Python layer (native engine)."""
         return 0
+
+    # -- degraded aggregation (shared by the sharded backend) ---------------
+    def _histo_admit(self, sample_rate: float):
+        """Effective sample rate for one timer/histogram sample under
+        degradation, or None when the sample is skipped. The roll is a
+        deterministic splitmix64 counter sequence (reproducible tests,
+        no RNG state), and the admitted samples carry rate·p so the
+        flushed count/percentile weights stay unbiased."""
+        p = self.degraded_timer_rate
+        if p >= 1.0:
+            return sample_rate
+        self._degrade_seq += 1
+        if (splitmix64(self._degrade_seq) >> 11) * (1.0 / (1 << 53)) >= p:
+            self.degraded_timer_skipped += 1
+            return None
+        return sample_rate * p
+
+    def _set_admit(self, member: bytes) -> bool:
+        """Hash-prefix member subsample at rate 2^-active_set_shift."""
+        k = self.active_set_shift
+        if k <= 0:
+            return True
+        if fnv1a_64(member) & ((1 << k) - 1):
+            self.degraded_set_skipped += 1
+            return False
+        return True
+
+    def _latch_degrade(self) -> None:
+        """Interval boundary: promote the pending set shift and expose
+        the one that governed the detached interval. Called from every
+        backend's swap() ON the pipeline thread, before new samples
+        land in the fresh table."""
+        self.last_set_shift = self.active_set_shift
+        self.active_set_shift = self.pending_set_shift
 
     # -- ingest -------------------------------------------------------------
     def _on_batch(self, batch):
@@ -106,9 +170,23 @@ class Aggregator:
             if mt is not None:
                 mt.message = m.message
         elif kind == "set":
-            self.batcher.add_set(slot, set_member_bytes(m.value))
+            member = set_member_bytes(m.value)
+            if self._set_admit(member):
+                self.batcher.add_set(slot, member)
         elif kind in ("histogram", "timer"):
-            self.batcher.add_histo(slot, float(m.value), m.sample_rate)
+            # self-metric timers are exempt from degraded sampling: the
+            # admission layer never sheds veneur.*, and blurring the
+            # operator's own latency telemetry during an incident
+            # defeats the point of bounded degradation. (Sets get no
+            # such exemption — their 2^shift correction is applied
+            # per-interval to every set row at flush, so a row staged
+            # unsubsampled would be over-corrected.)
+            if m.name.startswith("veneur."):
+                rate = m.sample_rate
+            else:
+                rate = self._histo_admit(m.sample_rate)
+            if rate is not None:
+                self.batcher.add_histo(slot, float(m.value), rate)
         self.processed += 1
 
     # -- import path (global tier) ------------------------------------------
@@ -287,6 +365,7 @@ class Aggregator:
         self.state = empty_state_compiled(self.spec)
         self.table = KeyTable(self.spec, self.n_shards)
         self._steps = 0
+        self._latch_degrade()
         return state, table
 
     def compute_flush(self, state, table, percentiles: List[float],

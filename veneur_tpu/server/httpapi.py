@@ -1,7 +1,9 @@
 """HTTP API (reference http.go:21-59 Handler + handlers_global.go).
 
-Endpoints: GET /healthcheck, GET /version, GET /builddate, POST /import,
-optional POST/GET /quitquitquit (gated on http_quit, server.go:80).
+Endpoints: GET /healthcheck, GET /healthz (liveness), GET /readyz
+(readiness — see server/health.py), GET /version, GET /builddate,
+POST /import, optional POST/GET /quitquitquit (gated on http_quit,
+server.go:80).
 
 /import accepts BOTH body formats, optionally zlib-deflated
 (handlers_global.go:134-146):
@@ -92,6 +94,21 @@ def start_http_server(server, address) -> "http.server.ThreadingHTTPServer":
         def do_GET(self):
             if self.path == "/healthcheck":
                 self._reply(200, b"ok")
+            elif self.path == "/healthz":
+                # liveness: restart-worthy failures only (README
+                # §Overload & health) — a SHEDDING server is still live
+                from veneur_tpu.server.health import check_live
+                ok, detail = check_live(server)
+                self._reply(200 if ok else 503,
+                            json.dumps(detail).encode(),
+                            "application/json")
+            elif self.path == "/readyz":
+                # readiness: should peers send NEW traffic here?
+                from veneur_tpu.server.health import check_ready
+                ok, detail = check_ready(server)
+                self._reply(200 if ok else 503,
+                            json.dumps(detail).encode(),
+                            "application/json")
             elif self.path == "/healthcheck/tracing":
                 # tracing is always on (reference http.go:44 keeps the
                 # endpoint for fleet compatibility)
@@ -260,7 +277,12 @@ def start_http_server(server, address) -> "http.server.ThreadingHTTPServer":
                 self._reply(400, b"Received empty or improperly-formed "
                                  b"metrics")
                 return
-            server.import_metrics(metrics)
+            if not server.import_metrics(metrics):
+                # CRITICAL overload sheds imports: 503 tells the sending
+                # tier to retry elsewhere (or later) instead of 202-ing
+                # data we discarded
+                self._reply(503, b"overloaded: import shed")
+                return
             self._import_timing(self._import_t0, "request")
             self._reply(202, b"imported")
 
@@ -272,7 +294,9 @@ def start_http_server(server, address) -> "http.server.ThreadingHTTPServer":
                 self._import_error("protobuf")
                 self._reply(400, b"bad MetricList protobuf")
                 return
-            server.import_metrics(list(mlist.metrics))
+            if not server.import_metrics(list(mlist.metrics)):
+                self._reply(503, b"overloaded: import shed")
+                return
             self._import_timing(self._import_t0, "request")
             self._reply(202, b"imported")
 

@@ -30,8 +30,8 @@ from veneur_tpu.aggregation.host import BatchSpec
 from veneur_tpu.aggregation.state import TableSpec
 from veneur_tpu.config import Config
 from veneur_tpu.reliability.faults import FAULTS, FLUSH_WORKER
-from veneur_tpu.reliability.policy import (CircuitBreaker, CircuitOpenError,
-                                           RetryPolicy)
+from veneur_tpu.reliability.policy import (OPEN, CircuitBreaker,
+                                           CircuitOpenError, RetryPolicy)
 from veneur_tpu.samplers import parser, ssf_samples
 from veneur_tpu.samplers.intermetric import InterMetric
 from veneur_tpu.sinks.base import ResilientSink, dispatch_flush
@@ -318,6 +318,14 @@ class Server:
         self._t_ckpt_write = M.timer(
             "veneur.checkpoint.write_duration_ns",
             "one checkpoint serialize+fsync on the writer thread")
+        # TCP statsd hardening (README §Overload & health) — registered
+        # even with the caps off so the inventory is stable
+        self._c_tcp_rejected = M.counter(
+            "veneur.tcp.rejected_total",
+            "TCP statsd connections refused at tcp_max_connections")
+        self._c_tcp_idle_closed = M.counter(
+            "veneur.tcp.idle_closed_total",
+            "TCP statsd connections closed at the idle deadline")
         jaxruntime.install()
         # h2d_bytes high-water at the last flush report, for per-interval
         # byte tags on the flush trace (flush worker thread only)
@@ -369,6 +377,33 @@ class Server:
             from veneur_tpu.reliability.spill import ForwardSpillBuffer
             self.forward_spill = ForwardSpillBuffer(
                 cfg.forward_spill_max_bytes, cfg.forward_spill_max_age_s)
+
+        # -- overload management (veneur_tpu/reliability/overload.py) -----
+        # Off by default: no controller object, and every hot-path gate
+        # is a single `is not None` check.
+        self._overload = None
+        self._restore_complete = not (cfg.checkpoint_dir
+                                      and cfg.restore_on_start)
+        if cfg.overload_enabled:
+            from veneur_tpu.reliability.overload import OverloadController
+            self._overload = OverloadController(
+                signals=self._overload_signals,
+                enter_pressured=cfg.overload_enter_pressured,
+                enter_shedding=cfg.overload_enter_shedding,
+                enter_critical=cfg.overload_enter_critical,
+                exit_margin=cfg.overload_exit_margin,
+                hold_s=cfg.overload_hold_s,
+                admit_rate=cfg.overload_admit_rate,
+                admit_burst=cfg.overload_admit_burst,
+                timer_sample_rate=cfg.overload_timer_sample_rate,
+                set_shift=cfg.overload_set_shift,
+                shed_priority_tags=cfg.shed_priority_tags)
+
+        # -- TCP statsd hardening -----------------------------------------
+        # live-connection accounting for tcp_max_connections; the idle
+        # deadline lives in _tcp_conn
+        self._tcp_conn_lock = threading.Lock()
+        self._tcp_conns_live = 0
 
         # -- durability layer (veneur_tpu/persistence/) -------------------
         # Off by default (empty checkpoint_dir): no writer thread, no
@@ -511,6 +546,40 @@ class Server:
                             if self._ckpt_writer is not None
                             and self._ckpt_writer.last_write_ts else None),
                    help="seconds since the last durable checkpoint")
+        # overload management — None/[] while the controller is disabled
+        # keeps the series out of the exposition, the same
+        # absent-when-off convention as spill/checkpoint above
+        M.callback("veneur.overload.state",
+                   lambda: (float(self._overload.state)
+                            if self._overload is not None else None),
+                   help="health state: 0 healthy / 1 pressured / "
+                        "2 shedding / 3 critical")
+        M.callback("veneur.overload.pressure",
+                   lambda: (self._overload.pressure
+                            if self._overload is not None else None),
+                   help="max normalized pressure signal, 0..1")
+        M.callback("veneur.overload.shed_total",
+                   lambda: (self._overload.shed_snapshot()
+                            if self._overload is not None else []),
+                   kind="counter", labelnames=("class",),
+                   help="samples refused by admission control or flush "
+                        "protection, by priority class")
+        M.callback("veneur.overload.admitted_total",
+                   lambda: (float(self._overload.admitted_total)
+                            if self._overload is not None else None),
+                   kind="counter",
+                   help="packets admitted past the overload controller")
+        M.callback("veneur.overload.degraded_flushes_total",
+                   lambda: (float(self._overload.degraded_flushes)
+                            if self._overload is not None else None),
+                   kind="counter",
+                   help="flushes published with degraded aggregation "
+                        "corrections or CRITICAL fan-out filtering")
+        M.callback("veneur.overload.degraded_samples_total",
+                   self._collect_degraded_samples, kind="counter",
+                   labelnames=("kind",),
+                   help="samples statistically subsumed (not staged) by "
+                        "degraded timer sampling / set subsampling")
 
     # -- registry collector helpers -----------------------------------------
     def _breaker_list(self):
@@ -562,6 +631,82 @@ class Server:
                     totals[s.name] = totals.get(s.name, 0) + n
         return [((name,), float(n)) for name, n in sorted(totals.items())]
 
+    def _collect_degraded_samples(self):
+        if self._overload is None:
+            return []
+        return [(("timer",),
+                 float(getattr(self.aggregator, "degraded_timer_skipped", 0))),
+                (("set",),
+                 float(getattr(self.aggregator, "degraded_set_skipped", 0)))]
+
+    # -- overload pressure signals ------------------------------------------
+    def _overload_signals(self):
+        """One {name: pressure} sample, each normalized to [0, 1] against
+        that resource's capacity. The controller takes the max: one
+        saturated resource IS an overloaded server. Every signal is
+        defensive — a broken source reads 0 for a tick rather than
+        killing the poller."""
+        sig: dict = {}
+        try:
+            sig["packet_queue"] = (self.packet_queue.qsize()
+                                   / max(1, self.packet_queue.maxsize))
+        except Exception as e:
+            log.debug("overload signal packet_queue failed: %s", e)
+        try:
+            sig["flush_jobs"] = (self._flush_jobs.qsize()
+                                 / max(1, self._flush_jobs.maxsize))
+        except Exception as e:
+            log.debug("overload signal flush_jobs failed: %s", e)
+        try:
+            # flush lag against the same staleness budget the watchdog
+            # and /healthz use; 1.0 == "watchdog would fire now"
+            stale = time.time() - min(self.last_flush, self.last_flush_done)
+            missed = self.cfg.flush_watchdog_missed_flushes
+            budget = (missed * self.interval if missed and missed > 0
+                      else 10.0 * self.interval + 60.0)
+            sig["flush_lag"] = max(0.0, stale / budget)
+        except Exception as e:
+            log.debug("overload signal flush_lag failed: %s", e)
+        try:
+            # key-table capacity drops since the previous poll: any delta
+            # means rows are ALREADY being lost, so saturate immediately
+            drops = self.aggregator.dropped_capacity
+            prev = getattr(self, "_ov_prev_capacity_drops", None)
+            self._ov_prev_capacity_drops = drops
+            if prev is not None and drops > prev:
+                sig["capacity_drops"] = 1.0
+            else:
+                sig["capacity_drops"] = 0.0
+        except Exception as e:
+            log.debug("overload signal capacity_drops failed: %s", e)
+        try:
+            if self.forward_spill is not None \
+                    and self.cfg.forward_spill_max_bytes > 0:
+                sig["spill_bytes"] = (self.forward_spill.bytes
+                                      / self.cfg.forward_spill_max_bytes)
+        except Exception as e:
+            log.debug("overload signal spill_bytes failed: %s", e)
+        try:
+            # an open forward breaker parks the server in PRESSURED
+            # (0.75 sits between enter_pressured and enter_shedding at
+            # the default thresholds): peers should stop sending, but
+            # local traffic is still being aggregated fine
+            if self._forward_breaker is not None \
+                    and self._forward_breaker.state == OPEN:
+                sig["forward_breaker"] = 0.75
+        except Exception as e:
+            log.debug("overload signal forward_breaker failed: %s", e)
+        try:
+            w = self._ckpt_writer
+            if w is not None and w.last_write_ts:
+                cadence = max(1, self.cfg.checkpoint_interval_flushes)
+                budget = 10.0 * cadence * self.interval + 60.0
+                sig["checkpoint_age"] = ((time.time() - w.last_write_ts)
+                                         / budget)
+        except Exception as e:
+            log.debug("overload signal checkpoint_age failed: %s", e)
+        return sig
+
     # -- tag exclusion wiring (server.go:1467-1510) -------------------------
     def _wire_excluded_tags(self):
         base: List[str] = []
@@ -605,6 +750,13 @@ class Server:
         """reference server.go:1081 processMetricPacket + SplitBytes. With
         the native engine, the whole buffer (splitting included) is handled
         in C++; only events/service checks come back up."""
+        if self._overload is not None \
+                and not self._overload.admit(data, "statsd"):
+            # shed BEFORE the parse — the cost being refused is the
+            # parse+stage itself. Counted per-class in
+            # veneur.overload.shed_total; native ring traffic bypasses
+            # this path (its drops are accounted by the reader ring).
+            return
         if self._native:
             for special in self.aggregator.feed(data):
                 self.handle_metric_packet(special)
@@ -760,6 +912,10 @@ class Server:
             "span_chan_cap_hits": self.span_pipeline.chan_cap_hits,
             "intervals_deferred": self.flush_intervals_deferred,
             "sink_flushes_skipped": self.sink_flushes_skipped,
+            # set-subsample shift that was ACTIVE for the interval just
+            # detached (latched by swap) — the flush worker multiplies
+            # set estimates by 2^shift to undo the member subsampling
+            "set_shift": getattr(self.aggregator, "last_set_shift", 0),
         }
         self._flush_jobs.put_nowait((state, table, stats, now, req))
 
@@ -993,12 +1149,38 @@ class Server:
                 continue
             except OSError:
                 return
+            # connection cap BEFORE spawning a thread: each conn costs a
+            # reader thread, and an accept flood must degrade to refused
+            # connections (counted, retryable) rather than thread
+            # exhaustion
+            cap = self.cfg.tcp_max_connections
+            if cap and cap > 0:
+                with self._tcp_conn_lock:
+                    if self._tcp_conns_live >= cap:
+                        over = True
+                    else:
+                        over = False
+                        self._tcp_conns_live += 1
+                if over:
+                    self._c_tcp_rejected.inc()
+                    log.warning("TCP statsd connection refused: "
+                                "tcp_max_connections=%d reached", cap)
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+                    continue
+            else:
+                with self._tcp_conn_lock:
+                    self._tcp_conns_live += 1
             conn.settimeout(5.0)
             if tls_ctx is not None:
                 try:
                     conn = tls_ctx.wrap_socket(conn, server_side=True)
                 except ssl.SSLError as e:
                     log.warning("TLS handshake failed: %s", e)
+                    with self._tcp_conn_lock:
+                        self._tcp_conns_live -= 1
                     continue
             t = threading.Thread(target=self._tcp_conn, args=(conn,),
                                  daemon=True)
@@ -1007,27 +1189,50 @@ class Server:
     def _tcp_conn(self, conn):
         buf = b""
         limit = self.cfg.metric_max_length
-        with conn:
-            while not self._shutdown.is_set():
-                try:
-                    data = conn.recv(65536)
-                except socket.timeout:
-                    continue  # idle conns stay open (server.go ReadTCPSocket)
-                except OSError:
-                    return
-                if not data:
-                    break
-                buf += data
-                *lines, buf = buf.split(b"\n")
-                for line in lines:
-                    if len(line) > limit:
-                        self._c_parse_errors.inc()
+        idle_limit = self.cfg.tcp_idle_timeout_s
+        if idle_limit and idle_limit > 0:
+            # wake often enough to notice the deadline: the 5.0s recv
+            # timeout set at accept only bounds ONE recv, so a slowloris
+            # peer trickling a byte per timeout held the thread forever
+            conn.settimeout(min(5.0, idle_limit))
+        last_data = time.monotonic()
+        try:
+            with conn:
+                while not self._shutdown.is_set():
+                    try:
+                        data = conn.recv(65536)
+                    except socket.timeout:
+                        # idle conns stay open (server.go ReadTCPSocket)
+                        # unless an idle deadline is configured
+                        if idle_limit and idle_limit > 0 and \
+                                time.monotonic() - last_data >= idle_limit:
+                            self._c_tcp_idle_closed.inc()
+                            log.info("TCP statsd connection closed: idle "
+                                     "for %.1fs (deadline %.1fs)",
+                                     time.monotonic() - last_data,
+                                     idle_limit)
+                            return
                         continue
-                    if line:
-                        self.packet_queue.put(line)
-                if len(buf) > limit:  # oversized line w/o newline: drop conn
-                    self._c_parse_errors.inc()
-                    return
+                    except OSError:
+                        return
+                    if not data:
+                        break
+                    last_data = time.monotonic()
+                    buf += data
+                    *lines, buf = buf.split(b"\n")
+                    for line in lines:
+                        if len(line) > limit:
+                            self._c_parse_errors.inc()
+                            continue
+                        if line:
+                            self.packet_queue.put(line)
+                    if len(buf) > limit:
+                        # oversized line w/o newline: drop conn
+                        self._c_parse_errors.inc()
+                        return
+        finally:
+            with self._tcp_conn_lock:
+                self._tcp_conns_live -= 1
 
     def _tls_context(self):
         if not (self.cfg.tls_key and self.cfg.tls_certificate):
@@ -1095,6 +1300,17 @@ class Server:
         # samples arriving after this point land on top losslessly
         if self._ckpt_writer is not None and self.cfg.restore_on_start:
             self._restore_from_checkpoint()
+            self._restore_complete = True  # /readyz gates on this
+        if self._overload is not None:
+            # the poller pushes the degradation knobs into the aggregator
+            # each tick; active_set_shift latches at the next swap so the
+            # 2^k flush correction always matches what was staged
+            def _push_degrade(ov):
+                self.aggregator.degraded_timer_rate = ov.degraded_timer_rate()
+                self.aggregator.pending_set_shift = ov.degraded_set_shift()
+
+            self._overload.start(self.cfg.overload_poll_interval_s,
+                                 on_poll=_push_degrade)
         t = threading.Thread(target=self._pipeline_loop, daemon=True,
                              name="pipeline")
         t.start()
@@ -1306,16 +1522,26 @@ class Server:
                 {"name": d.get("name", ""), "api_key": "REDACTED"}
                 for d in self.cfg.signalfx_per_tag_api_keys]
 
-    def import_metrics(self, metrics: List) -> None:
+    def import_metrics(self, metrics: List) -> bool:
         """gRPC import entry: enqueue onto the pipeline thread
-        (importsrv/server.go:102 SendMetrics → IngestMetrics)."""
+        (importsrv/server.go:102 SendMetrics → IngestMetrics). Returns
+        False when CRITICAL overload sheds the batch (HTTP callers turn
+        that into a 503 so the sender retries elsewhere)."""
+        if self._overload is not None \
+                and not self._overload.admit_import(len(metrics)):
+            return False
         self.packet_queue.put(_ImportBatch(metrics))
+        return True
 
-    def import_bytes(self, data: bytes) -> None:
+    def import_bytes(self, data: bytes) -> bool:
         """Raw-bytes gRPC import entry (native decode path): the
         pipeline thread hands the serialized MetricList straight to the
-        C++ importer."""
+        C++ importer. Same CRITICAL-shed contract as import_metrics."""
+        if self._overload is not None \
+                and not self._overload.admit_import():
+            return False
         self.packet_queue.put(_ImportBytes(data))
+        return True
 
     def process_span_metrics(self, metrics: List) -> None:
         """Extraction-sink loop-back: span-derived UDPMetrics re-enter the
@@ -1533,6 +1759,19 @@ class Server:
             generate = generate_frame
         else:
             generate = generate_intermetrics
+        # degraded-aggregation correction: the detached interval staged
+        # set members subsampled at 2^-shift (Aggregator._set_admit), so
+        # multiply the FLUSH estimate back by 2^shift. Forward and
+        # checkpoint carry raw HLL registers and are untouched; a new
+        # dict + new array because the checkpoint snapshot may still
+        # reference the originals.
+        flush_degraded = False
+        set_shift = int(stats.get("set_shift", 0))
+        if set_shift > 0 and flush_arrays.get("set_estimate") is not None:
+            flush_arrays = dict(flush_arrays)
+            flush_arrays["set_estimate"] = (
+                flush_arrays["set_estimate"] * (1 << set_shift))
+            flush_degraded = True
         fb_t0 = time.perf_counter_ns()
         fbsp = stage("frame_build") if trace else None
         final = generate(
@@ -1546,6 +1785,19 @@ class Server:
         if fbsp is not None:
             fbsp.set_tag("rows", str(len(final)))
             fbsp.client_finish(self.trace_client)
+        # flush protection: at CRITICAL, withhold low-priority rows from
+        # sink fan-out (and plugins) — the device update, forward, and
+        # checkpoint above already ran unconditionally, so no aggregated
+        # data is lost, only its low-priority publication this interval
+        if self._overload is not None and final:
+            from veneur_tpu.reliability.overload import CRITICAL
+            if self._overload.state >= CRITICAL:
+                final, n_shed = self._flush_protect(final)
+                if n_shed:
+                    self._overload.count_flush_shed(n_shed)
+                    flush_degraded = True
+        if flush_degraded and self._overload is not None:
+            self._overload.note_degraded_flush()
         if final:
             # parallel sink flushes + barrier with a per-interval join
             # budget (flusher.go:105-115). Slow-sink containment:
@@ -1622,6 +1874,42 @@ class Server:
             root.set_tag("rows", str(len(final)))
             root.set_tag("h2d_bytes", str(h2d_delta))
         root.client_finish(self.trace_client)
+
+    def _flush_protect(self, final):
+        """Filter low-priority rows out of a flush result (MetricFrame or
+        InterMetric list). Keeps self-metrics and any row carrying a
+        `shed_priority_tags` match; returns (filtered, n_dropped)."""
+        high = tuple(self.cfg.shed_priority_tags)
+
+        def keep(name, tags):
+            if name.startswith("veneur."):
+                return True
+            for h in high:
+                for t in tags:
+                    if h in t:
+                        return True
+            return False
+
+        from veneur_tpu.server.flusher import FrameSegment, MetricFrame
+        if isinstance(final, MetricFrame):
+            segs, dropped = [], 0
+            for seg in final.segments:
+                keep_idx = [i for i, m in enumerate(seg.metas)
+                            if keep(seg.names[i], m.tags)]
+                dropped += len(seg.names) - len(keep_idx)
+                if not keep_idx:
+                    continue
+                if len(keep_idx) == len(seg.names):
+                    segs.append(seg)
+                    continue
+                segs.append(FrameSegment(
+                    [seg.names[i] for i in keep_idx],
+                    seg.values[keep_idx], seg.mtype,
+                    [seg.metas[i] for i in keep_idx], seg.is_status))
+            return MetricFrame(final.timestamp, final.hostname,
+                               segs), dropped
+        kept = [m for m in final if keep(m.name, m.tags)]
+        return kept, len(final) - len(kept)
 
     def _forward_traced(self, span, raw, table):
         try:
@@ -2087,6 +2375,8 @@ class Server:
         # /import, gRPC import
         self.trace_client.close()
         self.span_pipeline.stop()
+        if self._overload is not None:
+            self._overload.stop()
         if self._stats_sock is not None:
             self._stats_sock.close()   # eagerly created in __init__
             self._stats_sock = None
@@ -2125,6 +2415,9 @@ class Server:
                 try:
                     stale = self._flush_jobs.get_nowait()
                     if stale is not _STOP:
+                        # the displaced interval is counted like any
+                        # other interval that never reached the sinks
+                        self._c_intervals_deferred.inc()
                         stale[-1].finish(False, "dropped at shutdown")
                 except queue.Empty:
                     pass

@@ -147,6 +147,7 @@ class ShardedAggregator(Aggregator):
         self.h2d_bytes = 0
         self.step_ns = 0
         self.steps_total = 0
+        self._init_degrade()
 
     # -- slot routing --------------------------------------------------------
     def _local(self, kind: str, slot: int) -> Tuple[int, int]:
@@ -178,9 +179,18 @@ class ShardedAggregator(Aggregator):
             if mt is not None:
                 mt.message = m.message
         elif kind == "set":
-            b.add_set(local, set_member_bytes(m.value))
+            member = set_member_bytes(m.value)
+            if self._set_admit(member):
+                b.add_set(local, member)
         elif kind in ("histogram", "timer"):
-            b.add_histo(local, float(m.value), m.sample_rate)
+            # self-metric timers exempt from degraded sampling (see the
+            # base Aggregator.process_metric rationale)
+            if m.name.startswith("veneur."):
+                rate = m.sample_rate
+            else:
+                rate = self._histo_admit(m.sample_rate)
+            if rate is not None:
+                b.add_histo(local, float(m.value), rate)
         self.processed += 1
 
     def import_metric(self, kind: str, name: str, tags: tuple, scope: int,
@@ -294,6 +304,7 @@ class ShardedAggregator(Aggregator):
         self.table = KeyTable(self.spec, self.n_shards)
         self.batchers = self._make_batchers()
         self._steps = 0
+        self._latch_degrade()
         return state, table
 
     def compute_flush(self, state, table, percentiles,
