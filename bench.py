@@ -338,9 +338,10 @@ def main():
                 checkpoint()   # each finished config stands immediately
 
             # headline configs first (2: digest accuracy+rate, 1: UDP
-            # ingest, 4: global merge): under the wall-clock guard the
-            # TAIL gets truncated, never the head
-            out["e2e"] = e2e.main(configs=[2, 1, 4, 3, 5, 6, 7, 8],
+            # ingest, 4: global merge, 9: exactly-once under ack loss):
+            # under the wall-clock guard the TAIL gets truncated, never
+            # the head
+            out["e2e"] = e2e.main(configs=[2, 1, 4, 9, 3, 5, 6, 7, 8],
                                   scale=scale,
                                   force_cpu=on_cpu, on_result=on_result,
                                   deadline=T0 + guard - 45.0)
@@ -348,6 +349,17 @@ def main():
             if cfg2 and "samples_per_sec" in cfg2:
                 out["e2e_samples_per_sec"] = cfg2["samples_per_sec"]
                 out["e2e_p99_err_mean"] = cfg2["p99_err_mean"]
+            # config 9 gate "p99 unchanged vs config4": same seed, same
+            # load — any drift means duplicates double-folded into the
+            # digests despite the window
+            cfg4 = next((r for r in out["e2e"] if r.get("config") == 4), None)
+            cfg9 = next((r for r in out["e2e"] if r.get("config") == 9), None)
+            if cfg4 and cfg9 and "merged_p99_err_mean" in cfg4 \
+                    and "merged_p99_err_mean" in cfg9:
+                delta = cfg9["merged_p99_err_mean"] \
+                    - cfg4["merged_p99_err_mean"]
+                cfg9["p99_err_delta_vs_config4"] = round(delta, 5)
+                cfg9["p99_unchanged_vs_config4"] = abs(delta) <= 2e-3
         except Exception as e:  # bench must still print its line
             out["e2e_error"] = f"{type(e).__name__}: {e}"
     out["elapsed_s"] = round(time.monotonic() - T0, 1)

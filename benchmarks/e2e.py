@@ -1033,10 +1033,132 @@ def config8_overload_storm(scale=1.0):
         srv.shutdown()
 
 
+# -- config 9: duplicate storm — exactly-once under 30% ack loss -------------
+
+def config9_duplicate_storm(scale=1.0):
+    """Config4's 64→1 merge under a hostile network: ~30% of sends lose
+    their ack (FORWARD_ACK fault fires AFTER the global folded) and are
+    re-sent with the SAME (source_id, epoch, seq) envelope, per the
+    exactly-once retry contract. Same rng seed and load shape as config4
+    so the merged-digest numbers are directly comparable: if duplicates
+    double-folded, counters drift and p99 error moves. Gates: counter
+    totals byte-exact, every forced duplicate suppressed AND accounted
+    (dup_suppressed == forced, rejected == 0), p99 error at config4's
+    level (bench.py cross-checks the two rows)."""
+    from veneur_tpu.aggregation.host import BatchSpec
+    from veneur_tpu.aggregation.state import TableSpec
+    from veneur_tpu.forward.convert import export_metrics
+    from veneur_tpu.forward.envelope import Envelope, mint_source_id
+    from veneur_tpu.forward.rpc import ForwardClient
+    from veneur_tpu.reliability.faults import (FAULTS, FORWARD_ACK,
+                                               InjectedFault)
+    from veneur_tpu.samplers.parser import parse_metric
+    from veneur_tpu.server.aggregator import Aggregator
+    from veneur_tpu.sinks.debug import DebugMetricSink
+
+    n_locals = 64
+    counters = max(8, int(200 * scale))
+    histos = max(4, int(50 * scale))
+    histo_samples = 20
+    rng = np.random.default_rng(4)      # config4's seed: same oracle
+    loss_rng = np.random.default_rng(90)
+
+    spec = TableSpec(counter_capacity=1 << 10, gauge_capacity=64,
+                     status_capacity=16, set_capacity=16,
+                     histo_capacity=1 << 8)
+    bspec = BatchSpec(counter=2048, gauge=64, status=16, set=64, histo=2048)
+
+    all_histo_vals = {h: [] for h in range(histos)}
+    exports = []
+    for li in range(n_locals):
+        agg = Aggregator(spec, bspec)
+        for c in range(counters):
+            m = parse_metric(
+                b"merged.counter.%d:%d|c|#veneurglobalonly" % (c, li + c))
+            agg.process_metric(m)
+        for h in range(histos):
+            vals = rng.lognormal(2.0, 0.8, histo_samples)
+            all_histo_vals[h].extend(vals.tolist())
+            for v in vals:
+                agg.process_metric(
+                    parse_metric(b"merged.timer.%d:%.4f|ms" % (h, v)))
+        _, table, raw = agg.flush([0.5], want_raw=True)
+        exports.append(export_metrics(raw, table, compression=spec.compression,
+                                      hll_precision=spec.hll_precision))
+    sids = [mint_source_id() for _ in range(n_locals)]
+
+    sink = DebugMetricSink()
+    glob = _mk_server([sink], grpc_address="127.0.0.1:0",
+                      forward_dedup_window=64,
+                      tpu_counter_capacity=1 << 12,
+                      tpu_histo_capacity=1 << 9)
+    try:
+        _warm(glob, [b"warm.c:1|c", b"warm.t:1.0|ms"], sinks=[sink])
+        client = ForwardClient(f"127.0.0.1:{glob.grpc_port}")
+        n_metrics = sum(len(e) for e in exports)
+        dup_forced = 0
+        for cycle in range(2):   # cycle 0 compiles the size bucket
+            phase(f"cycle{cycle}")
+            sink.flushed.clear()
+            t0 = time.perf_counter()
+            for li, e in enumerate(exports):
+                env = Envelope(sids[li], 0, cycle)
+                if loss_rng.random() < 0.30:
+                    FAULTS.arm(FORWARD_ACK, error=True, times=1)
+                try:
+                    client.send_metrics(e, timeout=30.0, envelope=env)
+                except InjectedFault:
+                    # ack lost after the fold; retry the SAME seq — the
+                    # global's window must suppress it (and still ack)
+                    dup_forced += 1
+                    client.send_metrics(e, timeout=30.0, envelope=env)
+            t1 = time.time()
+            while glob.packet_queue.qsize() and \
+                    time.time() - t1 < FLUSH_WAIT:
+                time.sleep(0.02)
+            _flush_checked(glob, timeout=WARM_TIMEOUT if cycle == 0
+                           else FLUSH_WAIT)
+            dt = time.perf_counter() - t0
+        client.close()
+
+        suppressed = glob._c_dup_suppressed.value()
+        rejected = glob._c_envelope_rejected.value()
+        flushed = {m.name: m.value for m in sink.flushed}
+        counter_exact = all(
+            flushed.get(f"merged.counter.{c}") ==
+            sum(li + c for li in range(n_locals))
+            for c in range(counters))
+        p99_errs = []
+        for h in range(histos):
+            got = flushed.get(f"merged.timer.{h}.99percentile")
+            exact = midpoint_quantile(all_histo_vals[h], 0.99)
+            if got is not None and exact > 0:
+                p99_errs.append(abs(got - exact) / exact)
+        return {
+            "config": 9, "name": "duplicate_storm_30pct_ack_loss",
+            "forwarded_metrics_per_sec": round(n_metrics / dt, 1),
+            "n_locals": n_locals, "metrics_forwarded": n_metrics,
+            "dup_forced": int(dup_forced),
+            "dup_suppressed": int(suppressed),
+            "dup_accounting_exact": suppressed == float(dup_forced)
+            and dup_forced > 0,
+            "envelope_rejected": int(rejected),
+            "counters_exact": bool(counter_exact),
+            "merged_p99_err_mean": round(float(np.mean(_acc(
+                p99_errs, "merged p99", flushed_keys=len(flushed)))), 5),
+            "merged_p99_err_max": round(float(np.max(p99_errs)), 5),
+            "wall_seconds": round(dt, 3),
+        }
+    finally:
+        FAULTS.reset()
+        glob.shutdown()
+
+
 CONFIGS = {1: config1_counter_replay, 2: config2_zipf_timers,
            3: config3_set_cardinality, 4: config4_global_merge,
            5: config5_span_firehose, 6: config6_cardinality_stress,
-           7: config7_checkpoint_restore, 8: config8_overload_storm}
+           7: config7_checkpoint_restore, 8: config8_overload_storm,
+           9: config9_duplicate_storm}
 
 # Per-config subprocess budget: backend init + first XLA compiles of the
 # config's size buckets (~tens of seconds each on the tunneled chip) +

@@ -344,3 +344,83 @@ def test_checkpoint_write_fault_degrades_never_fails_flush(tmp_path):
         assert len(list_checkpoints(str(tmp_path / "ckpt"))) == 1
     finally:
         srv.shutdown()
+
+
+@pytest.mark.parametrize("backend_kw", [{}, {"tpu_n_shards": 8}],
+                         ids=["single", "sharded"])
+def test_kill_restart_ack_loss_global_counters_byte_exact(backend_kw,
+                                                          tmp_path):
+    """Exactly-once under the worst crash-matrix composition: the local
+    forwards a batch whose ack is LOST (the global folded it), then is
+    KILLED (no shutdown checkpoint — only the one that rode the flush),
+    restarted from that checkpoint, and replays its spilled unit under
+    the ORIGINAL (epoch, seq). The global tier — single and sharded
+    aggregation backends — must end with counter totals byte-exact:
+    every duplicate delivery suppressed (and accounted), every fresh one
+    folded exactly once."""
+    from veneur_tpu.reliability.faults import FORWARD_ACK
+
+    gsink = DebugMetricSink()
+    glob = Server(small_config(grpc_address="127.0.0.1:0",
+                               forward_dedup_window=64, **backend_kw),
+                  metric_sinks=[gsink])
+    glob.start()
+    ckpt = str(tmp_path / "ckpt")
+    local_cfg = dict(forward_address=f"127.0.0.1:{glob.grpc_port}",
+                     forward_dedup_window=64, checkpoint_dir=ckpt,
+                     checkpoint_interval_flushes=1,
+                     checkpoint_on_shutdown=False)
+    part_a = {f"kx.c{i}": 1009 + 7 * i for i in range(6)}
+    part_b = {f"kx.c{i}": 5 + i for i in range(6)}
+
+    local = Server(small_config(**local_cfg),
+                   metric_sinks=[DebugMetricSink()])
+    local.start()
+    try:
+        FAULTS.arm(FORWARD_ACK, error=True, times=1)
+        _send_udp(local.local_addr(),
+                  [f"{n}:{v}|c|#veneurglobalonly".encode()
+                   for n, v in part_a.items()])
+        _wait_processed(local, len(part_a))
+        assert local.trigger_flush()          # global folds; ack lost
+        _wait_until(lambda: local.forward_errors >= 1,
+                    what="lost-ack forward failure")
+        assert FAULTS.fired(FORWARD_ACK) == 1
+        assert len(local.forward_spill) >= 1  # un-acked: still staged
+        assert local._ckpt_writer.wait_idle(30.0)
+        epoch0 = local._fwd_epoch
+        FAULTS.reset()
+    finally:
+        local.shutdown()      # checkpoint_on_shutdown=False: a kill
+
+    local2 = Server(small_config(restore_on_start=True, **local_cfg),
+                    metric_sinks=[DebugMetricSink()])
+    local2.start()
+    try:
+        assert local2._c_ckpt_restores.value() == 1
+        assert local2._fwd_epoch == epoch0 + 1
+        restored = local2.aggregator.processed
+        _send_udp(local2.local_addr(),
+                  [f"{n}:{v}|c|#veneurglobalonly".encode()
+                   for n, v in part_b.items()])
+        _wait_until(lambda: local2.aggregator.processed
+                    >= restored + len(part_b),
+                    what="post-restart ingest")
+        assert local2.trigger_flush()
+        _wait_until(lambda: len(local2.forward_spill) == 0,
+                    what="replay + fresh unit both acked")
+        # the part-A replay arrived at least once more and was suppressed
+        # (the kill-side shutdown may also have retried it, so >= 1)
+        assert glob._c_dup_suppressed.value() >= 1
+        assert glob._c_envelope_rejected.value() == 0
+
+        _wait_until(lambda: glob.aggregator.processed >= 2,
+                    what="global imports")
+        glob.trigger_flush()
+        flushed = by_name(gsink.flushed)
+        for name in part_a:
+            assert flushed[name].value == float(part_a[name]
+                                                + part_b[name]), name
+    finally:
+        local2.shutdown()
+        glob.shutdown()

@@ -1,0 +1,428 @@
+"""Exactly-once forwarding: envelope/window units, ack-gated spill
+units, and end-to-end ack-loss drills over real loopback gRPC.
+
+The contract under test (forward/envelope.py; README §Exactly-once
+forwarding): every forwarded interval travels under a monotone
+(source_id, epoch, seq) envelope; retries — ambiguous timeouts, lost
+acks, spill replay, graceful restart — re-send the SAME seq; the global
+tier's dedup window suppresses (and still ACKS) duplicates, so additive
+kinds (counters, t-digest weights) land exactly once."""
+
+import pathlib
+import struct
+import subprocess
+import sys
+
+import grpc
+import pytest
+
+from tests.test_server import (_send_udp, _wait_processed, _wait_until,
+                               by_name, small_config)
+from veneur_tpu.forward.envelope import (DUPLICATE, FRESH, STALE,
+                                         DedupWindow, Envelope,
+                                         EnvelopeError, mint_source_id)
+from veneur_tpu.forward.rpc import AmbiguousResultError, ForwardClient
+from veneur_tpu.reliability.faults import FAULTS, FORWARD_ACK
+from veneur_tpu.reliability.spill import (ForwardSpillBuffer,
+                                          parse_spill_bytes)
+from veneur_tpu.server.server import Server
+from veneur_tpu.sinks.debug import DebugMetricSink
+
+SID = mint_source_id()
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    FAULTS.reset()
+    yield
+    FAULTS.reset()
+
+
+# -- envelope codec ---------------------------------------------------------
+
+def test_envelope_metadata_roundtrip():
+    env = Envelope(SID, 3, 41)
+    assert Envelope.from_mapping(dict(env.to_metadata())) == env
+    assert Envelope.from_json(env.to_json()) == env
+
+
+def test_envelope_legacy_absent_vs_partial():
+    # no keys at all: a legacy sender, not an error
+    assert Envelope.from_mapping({}) is None
+    assert Envelope.from_json(None) is None
+    # a half-present envelope is corruption, never silently legacy
+    with pytest.raises(EnvelopeError):
+        Envelope.from_mapping({"veneur-source-id": SID})
+
+
+# -- dedup window verdicts --------------------------------------------------
+
+def test_window_fresh_duplicate_stale():
+    w = DedupWindow(window=4)
+    assert w.observe(Envelope(SID, 0, 0)) == FRESH
+    assert w.observe(Envelope(SID, 0, 0)) == DUPLICATE
+    for seq in (1, 2, 3, 4, 5):
+        assert w.observe(Envelope(SID, 0, seq)) == FRESH
+    # seq 1 scrolled off the 4-bit window behind high-water 5
+    assert w.observe(Envelope(SID, 0, 1)) == STALE
+    # inside the window, unseen seqs stay fresh even out of order
+    w2 = DedupWindow(window=8)
+    assert w2.observe(Envelope(SID, 0, 5)) == FRESH
+    assert w2.observe(Envelope(SID, 0, 3)) == FRESH
+    assert w2.observe(Envelope(SID, 0, 3)) == DUPLICATE
+
+
+def test_window_epochs_are_independent_streams():
+    w = DedupWindow(window=4)
+    assert w.observe(Envelope(SID, 0, 0)) == FRESH
+    # a restarted sender opens a new epoch: seq 0 is fresh again
+    assert w.observe(Envelope(SID, 1, 0)) == FRESH
+    assert w.observe(Envelope(SID, 0, 0)) == DUPLICATE
+
+
+def test_window_rejects_oversized_skip():
+    w = DedupWindow(window=4, max_skip=16)
+    with pytest.raises(EnvelopeError):
+        w.observe(Envelope(SID, 0, 17))      # opening jump past bound
+    assert w.observe(Envelope(SID, 0, 0)) == FRESH
+    with pytest.raises(EnvelopeError):
+        w.observe(Envelope(SID, 0, 18))      # forward jump past bound
+    # the rejection must not have corrupted the stream's memory
+    assert w.observe(Envelope(SID, 0, 0)) == DUPLICATE
+
+
+def test_window_snapshot_restore_and_lru_eviction():
+    w = DedupWindow(window=8, max_sources=2)
+    w.observe(Envelope(SID, 0, 0))
+    w.observe(Envelope(SID, 0, 1))
+    other = mint_source_id()
+    w.observe(Envelope(other, 0, 7))
+    snap = w.snapshot()
+
+    w2 = DedupWindow(window=8, max_sources=2)
+    assert w2.restore(snap) == 2
+    assert w2.observe(Envelope(SID, 0, 1)) == DUPLICATE
+    assert w2.observe(Envelope(other, 0, 7)) == DUPLICATE
+    assert w2.observe(Envelope(SID, 0, 2)) == FRESH
+
+    # a third stream evicts the LRU one, and the eviction is counted
+    third = mint_source_id()
+    assert w2.observe(Envelope(third, 0, 0)) == FRESH
+    assert w2.evictions == 1
+
+
+# -- ack-gated spill units --------------------------------------------------
+
+def _M(i):
+    from veneur_tpu.proto import metricpb_pb2 as mpb
+    return mpb.Metric(name=f"m{i}")
+
+
+def test_spill_unit_ack_gates_eviction():
+    buf = ForwardSpillBuffer(1 << 20, max_age_s=600.0)
+    buf.add_unit([_M(0), _M(1)], epoch=0, seq=0)
+    buf.add_unit([_M(2)], epoch=0, seq=1)
+    units = buf.pending_units()
+    assert [(u.epoch, u.seq) for u in units] == [(0, 0), (0, 1)]
+    # pending_units is a snapshot, not a drain
+    assert len(buf.pending_units()) == 2
+    assert buf.ack(0, 0) is True
+    assert buf.ack(0, 0) is False        # idempotent
+    assert [(u.epoch, u.seq) for u in buf.pending_units()] == [(0, 1)]
+    assert buf.ack(0, 1) is True
+    assert len(buf) == 0
+
+
+def test_spill_v2_roundtrip_preserves_envelopes():
+    buf = ForwardSpillBuffer(1 << 20, max_age_s=600.0)
+    buf.add_unit([_M(0)], epoch=2, seq=7)
+    data = buf.to_bytes()
+    assert data.startswith(b"VSPL2")
+    buf2 = ForwardSpillBuffer.from_bytes(data)
+    units = buf2.pending_units()
+    assert [(u.epoch, u.seq) for u in units] == [(2, 7)]
+    assert units[0].metrics[0].name == "m0"
+
+
+def test_spill_v1_bytes_still_parse_as_legacy():
+    """A pre-upgrade checkpoint's VSPL1 chunk restores as unenveloped
+    legacy entries (replayed at-least-once, as before the upgrade)."""
+    import time
+    now = time.time()
+    blob = _M(9).SerializeToString()
+    data = (b"VSPL1" + struct.Struct("<qdI").pack(1 << 20, 123.0, 1)
+            + struct.Struct("<dI").pack(now, len(blob)) + blob)
+    entries, caps = parse_spill_bytes(data, with_envelope=True)
+    assert caps == (1 << 20, 123.0)
+    assert len(entries) == 1
+    ts, m, epoch, seq = entries[0]
+    assert (ts, epoch, seq) == (now, -1, -1) and m.name == "m9"
+    buf = ForwardSpillBuffer(1 << 20, max_age_s=600.0)
+    buf.restore_entries(entries)
+    assert len(buf) == 1 and not buf.pending_units()
+    # the exactly-once sender folds those into its next stamped unit
+    assert [m.name for _, m in buf.take_legacy()] == ["m9"]
+
+
+# -- ambiguous-result classification (satellite: rpc.py) --------------------
+
+class _FakeRpcError(grpc.RpcError):
+    def __init__(self, code):
+        self._code = code
+
+    def code(self):
+        return self._code
+
+
+@pytest.mark.parametrize("code", [grpc.StatusCode.DEADLINE_EXCEEDED,
+                                  grpc.StatusCode.CANCELLED])
+def test_ambiguous_codes_raise_ambiguous_result(code):
+    client = ForwardClient("127.0.0.1:1")
+    try:
+        def boom(*a, **kw):
+            raise _FakeRpcError(code)
+        client._send = boom
+        with pytest.raises(AmbiguousResultError) as ei:
+            client.send_metrics([])
+        assert ei.value.code == code
+    finally:
+        client.close()
+
+
+def test_internal_error_is_not_ambiguous():
+    client = ForwardClient("127.0.0.1:1")
+    try:
+        def boom(*a, **kw):
+            raise _FakeRpcError(grpc.StatusCode.INTERNAL)
+        client._send = boom
+        with pytest.raises(grpc.RpcError) as ei:
+            client.send_metrics([])
+        assert not isinstance(ei.value, AmbiguousResultError)
+    finally:
+        client.close()
+
+
+# -- end-to-end: lost ack converges to exactly-once -------------------------
+
+def _eo_tier(tmp_path=None, **local_kw):
+    gsink = DebugMetricSink()
+    glob = Server(small_config(grpc_address="127.0.0.1:0",
+                               forward_dedup_window=64),
+                  metric_sinks=[gsink])
+    glob.start()
+    local = Server(small_config(
+        forward_address=f"127.0.0.1:{glob.grpc_port}",
+        forward_dedup_window=64, **local_kw),
+        metric_sinks=[DebugMetricSink()])
+    local.start()
+    return local, glob, gsink
+
+
+def test_ack_loss_retry_is_suppressed_and_counters_exact():
+    """Crash-matrix row `ack-loss`: the global folds the batch, the
+    sender sees a failure (FORWARD_ACK fault) and re-sends the SAME seq
+    next interval; the duplicate is suppressed WITH an ack, the unit is
+    evicted, and the global counter is byte-exact."""
+    local, glob, gsink = _eo_tier()
+    try:
+        FAULTS.arm(FORWARD_ACK, error=True, times=1)
+        _send_udp(local.local_addr(), [b"eo.count:7|c|#veneurglobalonly"])
+        _wait_processed(local, 1)
+        assert local.trigger_flush()
+        _wait_until(lambda: local.forward_errors >= 1,
+                    what="lost-ack forward failure")
+        assert FAULTS.fired(FORWARD_ACK) == 1
+        assert len(local.forward_spill) == 1     # un-acked: still staged
+
+        # next interval's pump re-sends seq 0; receiver suppresses + acks
+        assert local.trigger_flush()
+        _wait_until(lambda: len(local.forward_spill) == 0,
+                    what="retried unit acked and evicted")
+        assert glob._c_dup_suppressed.value() == 1
+        # seq 0 acked (idle intervals also stage self-telemetry units,
+        # so the high-water may sit above 0 by then)
+        assert local._fwd_acked_seq >= 0
+
+        _wait_until(lambda: glob.aggregator.processed > 0,
+                    what="global import")
+        glob.trigger_flush()
+        assert by_name(gsink.flushed)["eo.count"].value == 7.0
+        assert glob._c_envelope_rejected.value() == 0
+    finally:
+        local.shutdown()
+        glob.shutdown()
+
+
+def test_graceful_restart_replays_under_old_epoch(tmp_path):
+    """Crash-matrix row `send-then-restart`: a unit whose ack was lost
+    survives a graceful shutdown inside the checkpoint's spill chunk,
+    replays under its ORIGINAL (epoch, seq) after restart (where it is
+    suppressed), while post-restart data opens epoch+1 and folds fresh.
+    The restored tail is NOT re-exported under a new seq
+    (fold_snapshot(skip_forwarded=True))."""
+    gsink = DebugMetricSink()
+    glob = Server(small_config(grpc_address="127.0.0.1:0",
+                               forward_dedup_window=64),
+                  metric_sinks=[gsink])
+    glob.start()
+    ckpt = str(tmp_path / "ckpt")
+    local_cfg = dict(forward_address=f"127.0.0.1:{glob.grpc_port}",
+                     forward_dedup_window=64, checkpoint_dir=ckpt)
+    local = Server(small_config(**local_cfg),
+                   metric_sinks=[DebugMetricSink()])
+    local.start()
+    try:
+        FAULTS.arm(FORWARD_ACK, error=True, times=1)
+        _send_udp(local.local_addr(), [b"eo.re:5|c|#veneurglobalonly"])
+        _wait_processed(local, 1)
+        assert local.trigger_flush()
+        _wait_until(lambda: local.forward_errors >= 1,
+                    what="lost-ack forward failure")
+        assert len(local.forward_spill) == 1
+        epoch0 = local._fwd_epoch
+    finally:
+        local.shutdown()          # graceful: tail checkpoint rides out
+    FAULTS.reset()
+
+    local2 = Server(small_config(restore_on_start=True, **local_cfg),
+                    metric_sinks=[DebugMetricSink()])
+    local2.start()
+    try:
+        assert local2._fwd_epoch == epoch0 + 1       # epoch bump
+        # the un-acked unit came back under its ORIGINAL epoch (the
+        # shutdown tail may have staged a trailing self-telemetry unit
+        # under the old epoch too)
+        units = [(u.epoch, u.seq)
+                 for u in local2.forward_spill.pending_units()]
+        assert units[0] == (0, 0)
+        assert all(epoch == 0 for epoch, _ in units)
+
+        restored = local2.aggregator.processed
+        _send_udp(local2.local_addr(), [b"eo.re:11|c|#veneurglobalonly"])
+        _wait_until(lambda: local2.aggregator.processed >= restored + 1,
+                    what="post-restart ingest")
+        assert local2.trigger_flush()
+        _wait_until(lambda: len(local2.forward_spill) == 0,
+                    what="replay + fresh unit both acked")
+        assert glob._c_dup_suppressed.value() == 1   # the old-epoch replay
+
+        _wait_until(lambda: glob.aggregator.processed >= 2,
+                    what="global imports")
+        glob.trigger_flush()
+        assert by_name(gsink.flushed)["eo.re"].value == 16.0   # 5 + 11
+    finally:
+        local2.shutdown()
+        glob.shutdown()
+
+
+# -- proxy: stored grouping survives a reroute mid-retry --------------------
+
+class _StaticDisc:
+    def __init__(self, dests):
+        self.dests = dests
+
+    def get_destinations_for_service(self, service):
+        return self.dests
+
+
+class _FakeConn:
+    def __init__(self, dest, delivered):
+        self.dest = dest
+        self.fail = False
+        self.delivered = delivered
+
+    def send_metrics(self, batch, envelope=None, **kw):
+        if self.fail:
+            raise OSError("injected destination failure")
+        self.delivered.setdefault(self.dest, []).extend(
+            (m.name, envelope.epoch, envelope.seq) for m in batch)
+
+    def close(self):
+        pass
+
+
+class _PM:
+    def __init__(self, i):
+        self.name = f"pm{i}"
+        self.type = "counter"
+        self.tags = []
+
+
+def test_proxy_reroute_mid_retry_does_not_double_deliver():
+    """Crash-matrix row `proxy-reroute-mid-retry`: destination b fails
+    mid-unit, the ring then changes (b's keyspace would re-hash to c),
+    and the sender retries the same seq. The proxy's pinned grouping
+    re-attempts the STORED undelivered sub-batch at b — nothing is
+    re-routed to c, nothing already at a is re-sent, and every metric
+    lands exactly once."""
+    from veneur_tpu.forward.proxysrv import ProxyServer
+
+    disc = _StaticDisc(["a:1", "b:1"])
+    p = ProxyServer(disc, dedup_window=16)
+    delivered = {}
+    conns = {}
+    p._conn = lambda dest: conns.setdefault(
+        dest, _FakeConn(dest, delivered))
+
+    metrics = [_PM(i) for i in range(16)]
+    env = Envelope(SID, 0, 0)
+
+    # force b to fail: partial delivery raises so the sender retries
+    p._conn("b:1").fail = True
+    with pytest.raises(RuntimeError):
+        p.handle(metrics, envelope=env)
+    assert len(p._inflight) == 1
+    got_a = len(delivered.get("a:1", []))
+    assert 0 < got_a < 16
+
+    # the ring changes while the unit is in flight
+    disc.dests = ["a:1", "c:1"]
+    p.refresh()
+
+    p._conn("b:1").fail = False
+    assert p.handle(metrics, envelope=env) is True
+    assert "c:1" not in delivered                 # no re-route
+    assert len(delivered["a:1"]) == got_a         # no re-send to a
+    total = sum(len(v) for v in delivered.values())
+    assert total == 16
+    assert len(p._inflight) == 0
+
+    # the sender's own duplicate retry (lost ack) is suppressed + acked
+    assert p.handle(metrics, envelope=env) is True
+    assert p.dup_suppressed == 1
+    assert sum(len(v) for v in delivered.values()) == 16
+
+
+def test_proxy_passes_envelope_through_to_destinations():
+    """Each destination receives the SENDER'S (epoch, seq) so its own
+    dedup window can suppress ambiguous re-sends end-to-end."""
+    from veneur_tpu.forward.proxysrv import ProxyServer
+
+    p = ProxyServer(_StaticDisc(["a:1", "b:1"]), dedup_window=16)
+    delivered = {}
+    conns = {}
+    p._conn = lambda dest: conns.setdefault(
+        dest, _FakeConn(dest, delivered))
+    assert p.handle([_PM(i) for i in range(8)],
+                    envelope=Envelope(SID, 4, 9)) is True
+    for dest, rows in delivered.items():
+        assert all((epoch, seq) == (4, 9) for _, epoch, seq in rows)
+
+
+def test_proxy_rejects_bad_envelope_with_accounting():
+    from veneur_tpu.forward.proxysrv import ProxyServer
+
+    p = ProxyServer(_StaticDisc(["a:1"]), dedup_window=4, )
+    with pytest.raises(EnvelopeError):
+        p.handle([_PM(0)], envelope=Envelope(SID, 0, 10 ** 9))
+    assert p.envelope_rejected == 1
+
+
+# -- lint: failure arms never ack/evict (satellite f) -----------------------
+
+def test_forward_failure_paths_pass_ambiguity_lint():
+    script = (pathlib.Path(__file__).resolve().parent.parent
+              / "scripts" / "check_ambiguous_paths.py")
+    proc = subprocess.run([sys.executable, str(script)],
+                          capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stdout + proc.stderr

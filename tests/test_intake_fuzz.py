@@ -208,6 +208,171 @@ def test_parse_ssf_garbage_raises_only_decode_error():
                         f"{type(e).__name__}: {e}")
 
 
+# -- malformed-envelope corpus (exactly-once forwarding) ---------------------
+# The (source_id, epoch, seq) envelope is attacker-reachable surface on
+# the global tier's /import: a malformed one must be REJECTED with
+# accounting (veneur.forward.envelope_rejected_total), never folded and
+# never fatal; a duplicate/regressing seq must be SUPPRESSED WITH a 202
+# (the ack the sender needs to evict its unit), counted in
+# veneur.forward.dup_suppressed_total.
+
+_SID_OK = "0123456789abcdef0123456789abcdef"
+
+# header dicts that must 400 + count one rejection each.
+# forward_dedup_window=8 in the test server -> max seq skip 8*64 = 512.
+ENVELOPE_REJECT_CORPUS = [
+    # partial envelopes: half-present is corruption, not a legacy peer
+    {"veneur-source-id": _SID_OK},
+    {"veneur-epoch": "0", "veneur-seq": "0"},
+    {"veneur-source-id": _SID_OK, "veneur-epoch": "0"},
+    {"veneur-seq": "0"},
+    # wrong source_id shapes (length, case, charset)
+    {"veneur-source-id": "abcd", "veneur-epoch": "0", "veneur-seq": "0"},
+    {"veneur-source-id": _SID_OK * 2, "veneur-epoch": "0",
+     "veneur-seq": "0"},
+    {"veneur-source-id": _SID_OK.upper(), "veneur-epoch": "0",
+     "veneur-seq": "0"},
+    {"veneur-source-id": "zz" * 16, "veneur-epoch": "0",
+     "veneur-seq": "0"},
+    # non-integer / negative epoch and seq
+    {"veneur-source-id": _SID_OK, "veneur-epoch": "x", "veneur-seq": "0"},
+    {"veneur-source-id": _SID_OK, "veneur-epoch": "0",
+     "veneur-seq": "1.5"},
+    {"veneur-source-id": _SID_OK, "veneur-epoch": "-1",
+     "veneur-seq": "0"},
+    {"veneur-source-id": _SID_OK, "veneur-epoch": "0",
+     "veneur-seq": "-2"},
+    {"veneur-source-id": _SID_OK, "veneur-epoch": "0",
+     "veneur-seq": "nan"},
+    # a seq skip past the window bound must not wipe the bitmap
+    {"veneur-source-id": _SID_OK, "veneur-epoch": "0",
+     "veneur-seq": "513"},
+    {"veneur-source-id": _SID_OK, "veneur-epoch": "0",
+     "veneur-seq": str(10 ** 18)},
+]
+
+# wrapped-body envelopes that must 400 + count one rejection each
+ENVELOPE_REJECT_BODY_CORPUS = [
+    "notadict", 7, ["x"],
+    {"source_id": _SID_OK, "epoch": "x", "seq": 0},
+    {"source_id": _SID_OK, "epoch": 0},
+    {"source_id": "short", "epoch": 0, "seq": 0},
+    {"source_id": _SID_OK, "epoch": 0, "seq": -1},
+]
+
+
+def _counter_jm(name="env.fuzz", value=3):
+    import base64
+    from veneur_tpu.forward import gob
+    return {"name": name, "type": "counter", "tagstring": "",
+            "tags": [],
+            "value": base64.b64encode(
+                bytes(gob.encode_counter(value))).decode()}
+
+
+def _post_import(port, body, headers=None):
+    import json
+    hdrs = {"Content-Type": "application/json"}
+    hdrs.update(headers or {})
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/import",
+        data=json.dumps(body).encode(), method="POST", headers=hdrs)
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status
+    except urllib.error.HTTPError as e:
+        return e.code
+
+
+def test_envelope_corpus_rejections_all_accounted():
+    """Every malformed envelope — header or wrapped-body form — 400s,
+    increments veneur.forward.envelope_rejected_total exactly once, and
+    never folds; duplicate and regressing seqs are suppressed WITH a 202
+    and counted; the server survives to import a clean batch after."""
+    sink = DebugMetricSink()
+    srv = Server(small_config(http_address="127.0.0.1:0",
+                              forward_dedup_window=8),
+                 metric_sinks=[sink])
+    srv.start()
+    port = srv.http_port
+    try:
+        for hdrs in ENVELOPE_REJECT_CORPUS:
+            assert _post_import(port, [_counter_jm()], hdrs) == 400, hdrs
+        for env in ENVELOPE_REJECT_BODY_CORPUS:
+            assert _post_import(
+                port, {"envelope": env, "metrics": [_counter_jm()]}
+            ) == 400, env
+        rejected = len(ENVELOPE_REJECT_CORPUS) \
+            + len(ENVELOPE_REJECT_BODY_CORPUS)
+        assert srv._c_envelope_rejected.value() == float(rejected)
+        # rejections landed in the registered counter, visible to ops
+        assert srv.metrics.flat_values()[
+            "veneur.forward.envelope_rejected_total"] == float(rejected)
+
+        # duplicate seq: suppressed, ACKED (202), counted — NOT folded
+        ok_env = {"veneur-source-id": _SID_OK, "veneur-epoch": "0",
+                  "veneur-seq": "5"}
+        assert _post_import(port, [_counter_jm()], ok_env) == 202
+        assert _post_import(port, [_counter_jm()], ok_env) == 202
+        assert srv._c_dup_suppressed.value() == 1.0
+        # a fresh forward jump (within max_skip) folds and drags the
+        # window forward so a regressing seq drops past its reach...
+        jump = {"veneur-source-id": _SID_OK, "veneur-epoch": "0",
+                "veneur-seq": "100"}
+        assert _post_import(port, [_counter_jm()], jump) == 202
+        # ...making seq 3 STALE: suppressed conservatively, still 202
+        old = {"veneur-source-id": _SID_OK, "veneur-epoch": "0",
+               "veneur-seq": "3"}
+        assert _post_import(port, [_counter_jm()], old) == 202
+        assert srv._c_dup_suppressed.value() == 2.0
+
+        # the pipeline survived all of it, and only the fresh imports
+        # (seq 5, seq 100, a legacy unenveloped batch) ever folded:
+        # env.fuzz == 2 folds x 3, despite 24 batches carrying it
+        before = srv.aggregator.processed
+        assert _post_import(port, [_counter_jm("env.legacy")]) == 202
+        _wait_until(lambda: srv.aggregator.processed > before,
+                    60, "clean imports after the corpus")
+        srv.trigger_flush()
+        from tests.test_server import by_name
+        flushed = by_name(sink.flushed)
+        assert flushed["env.fuzz"].value == 6.0
+        assert flushed["env.legacy"].value == 3.0
+    finally:
+        srv.shutdown()
+
+
+def test_grpc_envelope_rejections_accounted_and_not_acked():
+    """The gRPC flavor of the same contract: malformed metadata aborts
+    INVALID_ARGUMENT (counted server-side; the sender does NOT treat it
+    as an ack), a valid envelope imports, its duplicate is suppressed
+    but the RPC still SUCCEEDS (that success is the ack)."""
+    import grpc as _grpc
+
+    from veneur_tpu.forward.envelope import Envelope
+    from veneur_tpu.forward.rpc import ForwardClient
+
+    srv = Server(small_config(grpc_address="127.0.0.1:0",
+                              forward_dedup_window=8),
+                 metric_sinks=[DebugMetricSink()])
+    srv.start()
+    client = ForwardClient(f"127.0.0.1:{srv.grpc_port}")
+    try:
+        bad = Envelope("tooshort", 0, 0)          # never validated client-side
+        with pytest.raises(_grpc.RpcError) as ei:
+            client.send_metrics([], envelope=bad)
+        assert ei.value.code() == _grpc.StatusCode.INVALID_ARGUMENT
+        assert srv._c_envelope_rejected.value() == 1.0
+
+        good = Envelope(_SID_OK, 0, 0)
+        client.send_metrics([], envelope=good)    # fresh: imported
+        client.send_metrics([], envelope=good)    # duplicate: acked anyway
+        assert srv._c_dup_suppressed.value() == 1.0
+    finally:
+        client.close()
+        srv.shutdown()
+
+
 def test_server_accounts_every_corpus_rejection():
     """End to end: the full malformed corpus over real UDP. Every
     datagram must land in processed or in the registered drop counter

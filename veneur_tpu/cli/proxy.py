@@ -37,7 +37,8 @@ def main(argv=None):
     refresh = (parse_duration(cfg.consul_refresh_interval)
                if cfg.consul_refresh_interval else 0.0)
     proxy = ProxyServer(disc, service=service or "static",
-                        refresh_interval=refresh)
+                        refresh_interval=refresh,
+                        dedup_window=cfg.forward_dedup_window)
     proxy.start(cfg.grpc_address)
     if cfg.stats_address:
         # runtime-metrics ticker to an external statsd daemon

@@ -119,6 +119,17 @@ class Config:
     forward_spill_max_age_s: float = 60.0
     fault_injection: str = ""          # chaos spec (reliability/faults.py)
 
+    # exactly-once forwarding (forward/envelope.py; README §Exactly-once
+    # forwarding). 0 = off: senders don't stamp envelopes, receivers
+    # don't dedup — exactly the at-least-once behavior above. On a LOCAL
+    # (> 0) every forwarded interval carries a (source_id, epoch, seq)
+    # envelope and the spill becomes the ack-gated send queue; on a
+    # GLOBAL/proxy (> 0) it is the per-source dedup window size in seqs —
+    # replays more than `window` seqs behind a stream's high-water mark
+    # are conservatively suppressed (the documented staleness bound).
+    forward_dedup_window: int = 0
+    forward_dedup_max_sources: int = 1024  # LRU bound on tracked streams
+
     # durability layer (veneur_tpu/persistence/; README §Durability).
     # An empty checkpoint_dir keeps the whole subsystem inert — no
     # writer thread, no restore scan, no behavior change.

@@ -42,6 +42,11 @@ class ProxyConfig:
     # (reference proxy.go:122 ConsulTraceService; parsed for config
     # compatibility — span routing rides ssf_destination_address here)
     consul_trace_service_name: str = ""
+    # exactly-once relay window (forward/envelope.py): > 0 makes the
+    # proxy honor sender envelopes — pin per-destination groupings
+    # across retries and pass the idempotency key through to globals.
+    # Match the globals' forward_dedup_window.
+    forward_dedup_window: int = 0
     unknown_keys: List[str] = dataclasses.field(default_factory=list)
 
 

@@ -13,8 +13,11 @@ import bisect
 import logging
 import socket
 import threading
+from collections import OrderedDict
 from typing import Dict, List, Optional
 
+from veneur_tpu.forward.envelope import (FRESH, DedupWindow, Envelope,
+                                         EnvelopeError)
 from veneur_tpu.forward.rpc import ForwardClient, serve
 from veneur_tpu.observability.registry import TelemetryRegistry
 from veneur_tpu.reliability.faults import FAULTS, PROXY_FORWARD
@@ -65,7 +68,8 @@ class ProxyServer:
     def __init__(self, discoverer, service: str = "veneur-global",
                  refresh_interval: float = 0.0, replicas: int = 128,
                  failure_threshold: int = 0, cooldown_s: float = 30.0,
-                 readyz_port: int = 0, readyz_opener=None):
+                 readyz_port: int = 0, readyz_opener=None,
+                 dedup_window: int = 0):
         self.discoverer = discoverer
         self.service = service
         self.refresh_interval = refresh_interval
@@ -77,6 +81,25 @@ class ProxyServer:
         self.cooldown_s = cooldown_s
         self._breakers: Dict[str, CircuitBreaker] = {}
         self.rejected_open = 0
+        # exactly-once relay (dedup_window > 0): the proxy is NOT a dedup
+        # endpoint — it passes the sender's envelope through to each
+        # destination — but it must survive its OWN retry hazard: a ring
+        # change between a partial failure and the sender's retry would
+        # re-route already-delivered keys to a different global, which
+        # would fold them as fresh. So the first attempt at a
+        # (source_id, epoch, seq) STORES its per-destination grouping,
+        # retries re-attempt only the still-undelivered sub-batches, and
+        # _done marks the seq only once every destination has it.
+        self._done = (DedupWindow(dedup_window) if dedup_window > 0
+                      else None)
+        self._inflight: "OrderedDict[tuple, dict]" = OrderedDict()
+        self._inflight_cap = 4096
+        self._inflight_lock = threading.Lock()
+        # plain ints, emitted under the lint-exempt veneur_proxy.*
+        # statsd namespace (emit_stats_once) — the veneur.* spellings
+        # belong to the server's registry
+        self.dup_suppressed = 0
+        self.envelope_rejected = 0
         self._ring = HashRing([], replicas)
         # overload-aware routing: peers answering /readyz non-200 (the
         # server's overload state machine) and OPEN-breaker destinations
@@ -215,9 +238,18 @@ class ProxyServer:
             return self._breakers[dest]
 
     # -- forwarding ---------------------------------------------------------
-    def handle(self, metrics: List):
+    def handle(self, metrics: List, envelope: Envelope = None):
         """Group by ring destination, then one SendMetrics per destination
-        (proxysrv/server.go:180-188, :286)."""
+        (proxysrv/server.go:180-188, :286). With an envelope (exactly-once
+        sender, dedup_window > 0) delivery is all-or-error: partial
+        failure raises so the sender retries the SAME seq, and the retry
+        re-attempts only the stored undelivered sub-batches."""
+        if envelope is not None and self._done is not None:
+            return self._deliver_enveloped(
+                metrics, envelope, "grpc",
+                lambda m: f"{m.name}{m.type}{','.join(m.tags)}".encode(),
+                lambda dest, batch: self._conn(dest).send_metrics(
+                    batch, envelope=envelope))
         by_dest: Dict[str, List] = {}
         ring = self._routing_ring()  # rings are immutable once built
         for m in metrics:
@@ -246,6 +278,76 @@ class ProxyServer:
                     breaker.record_failure()
                 log.warning("proxy forward to %s failed: %s", dest, e)
 
+    def _deliver_enveloped(self, items: List, envelope: Envelope,
+                           protocol: str, keyfn, sendfn) -> bool:
+        """Exactly-once relay of one (source_id, epoch, seq) unit: peek
+        the done-window (suppressed units were already fully delivered —
+        ack without re-sending), pin the per-destination grouping on
+        first attempt, deliver undelivered sub-batches with the SENDER'S
+        envelope attached (each destination's own dedup window absorbs
+        ambiguous re-sends), and mark done only when none remain."""
+        try:
+            verdict = self._done.peek(envelope)
+        except EnvelopeError:
+            self.envelope_rejected += 1
+            raise
+        if verdict != FRESH:
+            self.dup_suppressed += 1
+            return True
+        key = (protocol, envelope.source_id, envelope.epoch, envelope.seq)
+        # _routing_ring acquires self._lock internally: call it before
+        # taking any proxy lock of our own
+        ring = self._routing_ring()
+        with self._inflight_lock:
+            stored = self._inflight.get(key)
+            if stored is None:
+                stored = {}
+                for it in items:
+                    dest = ring.get(keyfn(it))
+                    if dest is None:
+                        self.errors += 1
+                        continue
+                    stored.setdefault(dest, []).append(it)
+                self._inflight[key] = stored
+                while len(self._inflight) > self._inflight_cap:
+                    # dropping a pinned grouping degrades that unit's
+                    # retry to re-hash-on-current-ring; bounded memory
+                    # wins over a pathological backlog of dead seqs
+                    self._inflight.popitem(last=False)
+            pending = list(stored.items())
+        failed = 0
+        for dest, batch in pending:
+            breaker = self._breaker(dest)
+            if breaker is not None and not breaker.allow():
+                self.errors += len(batch)
+                self.rejected_open += len(batch)
+                failed += 1
+                continue
+            try:
+                FAULTS.inject(PROXY_FORWARD, name=dest)
+                sendfn(dest, batch)
+                self.forwarded += len(batch)
+                self._count_dest(dest, protocol, len(batch))
+                if breaker is not None:
+                    breaker.record_success()
+                with self._inflight_lock:
+                    stored.pop(dest, None)
+            except Exception as e:
+                failed += 1
+                self.errors += len(batch)
+                if breaker is not None:
+                    breaker.record_failure()
+                log.warning("proxy forward to %s failed: %s", dest, e)
+        if failed:
+            raise RuntimeError(
+                f"delivered {len(pending) - failed}/{len(pending)} "
+                f"destinations for seq {envelope.seq}; sender must "
+                "retry the same seq")
+        self._done.mark(envelope)
+        with self._inflight_lock:
+            self._inflight.pop(key, None)
+        return True
+
     def _count_dest(self, dest: str, protocol: str, n: int) -> None:
         with self._lock:
             key = (dest, protocol)
@@ -269,16 +371,28 @@ class ProxyServer:
             by_dest.setdefault(dest, []).append(jm)
         return by_dest
 
-    def _post_import(self, dest: str, batch: List[dict]) -> None:
+    def _post_import(self, dest: str, batch: List[dict],
+                     envelope: Envelope = None) -> None:
         """POST one batch to <dest>/import as deflate-compressed JSON
         (the reference's vhttp.PostHelper with compress=true,
         proxy.go:622 doPost). HTTPForwardClient owns scheme handling."""
         from veneur_tpu.forward.rpc import HTTPForwardClient
-        HTTPForwardClient(dest).send_json(batch)
+        HTTPForwardClient(dest).send_json(batch, envelope=envelope)
 
-    def proxy_json_metrics(self, json_metrics: List[dict]) -> None:
+    def proxy_json_metrics(self, json_metrics: List[dict],
+                           envelope: Envelope = None) -> None:
         """ProxyMetrics (proxy.go:580): hash-split, then one POST per
-        destination, counting errors per batch like the gRPC path."""
+        destination, counting errors per batch like the gRPC path.
+        With an envelope, the all-or-error exactly-once relay applies
+        (see _deliver_enveloped)."""
+        if envelope is not None and self._done is not None:
+            self._deliver_enveloped(
+                json_metrics, envelope, "http",
+                lambda jm: (f"{jm.get('name', '')}{jm.get('type', '')}"
+                            f"{jm.get('tagstring', '')}").encode(),
+                lambda dest, batch: self._post_import(
+                    dest, batch, envelope=envelope))
+            return
         for dest, batch in self.handle_json(json_metrics).items():
             breaker = self._breaker(dest)
             if breaker is not None and not breaker.allow():
@@ -342,9 +456,39 @@ class ProxyServer:
                 except ValueError:
                     self._reply(400, b"bad JSON body")
                     return
+                body_env = None
+                if isinstance(jms, dict):
+                    # exactly-once wrapped form: {"envelope": ...,
+                    # "metrics": [...]} (forward/rpc.py send_metrics)
+                    body_env = jms.get("envelope")
+                    jms = jms.get("metrics")
                 if not isinstance(jms, list) or not all(
                         isinstance(jm, dict) for jm in jms):
                     self._reply(400, b"bad JSONMetric array")
+                    return
+                envelope = None
+                if srv._done is not None:
+                    try:
+                        envelope = (Envelope.from_json(body_env)
+                                    if body_env is not None else
+                                    Envelope.from_mapping(self.headers))
+                    except EnvelopeError:
+                        srv.envelope_rejected += 1
+                        self._reply(400, b"bad envelope")
+                        return
+                if envelope is not None:
+                    # the 202 IS the ack: send it only once every
+                    # destination has the batch, else the sender evicts
+                    # a unit the ring never fully delivered
+                    try:
+                        srv.proxy_json_metrics(jms, envelope=envelope)
+                    except EnvelopeError:
+                        self._reply(400, b"bad envelope")
+                        return
+                    except Exception:
+                        self._reply(503, b"partial delivery; retry")
+                        return
+                    self._reply(202, b"accepted")
                     return
                 # an empty array is a valid no-op, not an error
                 self._reply(202, b"accepted")
@@ -418,6 +562,8 @@ class ProxyServer:
         with self._lock:
             counts = dict(self.metrics_by_destination)
             counts[("", "error")] = self.errors
+            counts[("", "dup")] = self.dup_suppressed
+            counts[("", "rej")] = self.envelope_rejected
         for key, total in counts.items():
             delta = total - self._stats_last.get(key, 0)
             self._stats_last[key] = total
@@ -427,6 +573,14 @@ class ProxyServer:
             if proto == "error":
                 lines.append(format_line(
                     "veneur_proxy.forward.error_total", delta, "c"))
+            elif proto == "dup":
+                lines.append(format_line(
+                    "veneur_proxy.forward.dup_suppressed_total",
+                    delta, "c"))
+            elif proto == "rej":
+                lines.append(format_line(
+                    "veneur_proxy.forward.envelope_rejected_total",
+                    delta, "c"))
             else:
                 lines.append(format_line(
                     "veneur_proxy.metrics_by_destination", delta, "c",
@@ -435,7 +589,11 @@ class ProxyServer:
 
     # -- lifecycle ----------------------------------------------------------
     def start(self, address: str = "127.0.0.1:0"):
-        self._grpc, self.port = serve(self.handle, address)
+        def _count_reject():
+            self.envelope_rejected += 1
+        self._grpc, self.port = serve(
+            self.handle, address, with_metadata=self._done is not None,
+            on_reject=_count_reject)
         if self.refresh_interval > 0:
             t = threading.Thread(target=self._refresh_loop, daemon=True)
             t.start()

@@ -100,6 +100,14 @@ def encode_to_dir(dirpath: str, snap: dict, fsync: bool = True) -> int:
                        list(arr.shape)))
     chunks.append(("tables", _tables_json(snap["tables"]), None, None))
     chunks.append(("spill", snap.get("spill") or b"", None, None))
+    # exactly-once forwarding identity + dedup window (JSON; optional —
+    # readers of older checkpoints see no "forward" chunk and old readers
+    # ignore unknown chunk names, so no format-version bump is needed)
+    if snap.get("forward"):
+        chunks.append(("forward",
+                       json.dumps(snap["forward"],
+                                  separators=(",", ":")).encode(),
+                       None, None))
 
     index = []
     offset = 0
@@ -221,6 +229,12 @@ def load_dir(dirpath: str) -> dict:
     for kind in TABLE_KINDS:
         if kind not in tables:
             raise CorruptSnapshot(f"{dirpath}: tables chunk lacks {kind}")
+    forward = None
+    if chunks.get("forward"):
+        try:
+            forward = json.loads(chunks["forward"])
+        except ValueError as e:
+            raise CorruptSnapshot(f"{dirpath}: forward chunk: {e}")
     return {
         "agg_kind": manifest["agg_kind"],
         "n_shards": manifest["n_shards"],
@@ -231,6 +245,7 @@ def load_dir(dirpath: str) -> dict:
         "tables": tables,
         "arrays": arrays,
         "spill": chunks.get("spill", b""),
+        "forward": forward,
     }
 
 

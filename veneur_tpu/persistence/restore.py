@@ -62,11 +62,22 @@ def restore_latest(root: str, on_corrupt=None
     return None
 
 
-def fold_snapshot(aggregator, snap: dict) -> int:
+def fold_snapshot(aggregator, snap: dict, skip_forwarded: bool = False) -> int:
     """Merge every snapshot row into `aggregator` via restore_metric;
     returns the number of rows folded. Capacity overflow in a smaller
     target table is counted in the aggregator's dropped_capacity, same
-    as live ingest."""
+    as live ingest.
+
+    With `skip_forwarded` (a local restoring under exactly-once
+    forwarding), rows a local's flush would export forward-ONLY — global
+    counters/gauges/histos, non-local sets (the exact complement of
+    flusher.py's local-flush masks) — are NOT folded back: their
+    payloads were staged into the spill (under their original envelopes)
+    BEFORE this snapshot was written, so the spill replay delivers them
+    and re-folding here would re-export the same data under a fresh seq
+    the receiver cannot dedup. Mixed-scope histograms flush both tiers
+    and must stay."""
+    from veneur_tpu.aggregation.host import SCOPE_GLOBAL, SCOPE_LOCAL
     arrays = snap["arrays"]
     n = 0
 
@@ -76,7 +87,15 @@ def fold_snapshot(aggregator, snap: dict) -> int:
                 actual_kind, joined_tags = entry
             if joined_tags is None:
                 joined_tags = ",".join(tags)
-            yield (i, actual_kind, name, tuple(tags), int(scope),
+            scope = int(scope)
+            if skip_forwarded:
+                if kind in ("counter", "gauge", "histo"):
+                    if scope == SCOPE_GLOBAL:
+                        continue
+                elif kind == "set":
+                    if scope != SCOPE_LOCAL:
+                        continue
+            yield (i, actual_kind, name, tuple(tags), scope,
                    hostname, message, bool(imported_only), joined_tags)
 
     for i, kind, name, tags, scope, host, _msg, imp, joined in \
@@ -131,6 +150,9 @@ def restore_spill(spill_buffer, spill_bytes: bytes) -> int:
     if not spill_bytes or spill_buffer is None:
         return 0
     from veneur_tpu.reliability.spill import parse_spill_bytes
-    entries, _caps = parse_spill_bytes(spill_bytes)
-    spill_buffer.readd(entries)
+    # with_envelope keeps each staged unit's (epoch, seq) attached so the
+    # post-restart replay re-sends the ORIGINAL seqs the receiver's dedup
+    # window knows how to suppress (exactly-once across a crash)
+    entries, _caps = parse_spill_bytes(spill_bytes, with_envelope=True)
+    spill_buffer.restore_entries(entries)
     return len(entries)

@@ -22,6 +22,9 @@ Snapshot layout (the dict codec.encode_to_dir serializes):
                hll u8[ns, R]; h_mean/h_weight f32[nh, C+T];
                h_min/h_max f32[nh]; h_recip f64[nh]
   spill        ForwardSpillBuffer.to_bytes() wire bytes (b"" if none)
+  forward      exactly-once forwarding identity + receiver dedup state
+               ({"source_id", "epoch", "next_seq", "dedup"}; absent when
+               forward_dedup_window is 0) — see forward/envelope.py
 """
 
 from __future__ import annotations
@@ -53,7 +56,8 @@ def build_snapshot(spec, table: KeyTable, result: Dict[str, np.ndarray],
                    raw: Dict[str, np.ndarray], *, agg_kind: str,
                    n_shards: int, interval_ts: float, hostname: str = "",
                    spill: Optional[bytes] = None,
-                   spill_entries: int = 0) -> dict:
+                   spill_entries: int = 0,
+                   forward_meta: Optional[dict] = None) -> dict:
     """`result`/`raw` are compute_flush's outputs for the interval being
     checkpointed (want_raw=True — both backends emit identical raw keys).
     `table` is the interval's detached KeyTable."""
@@ -106,4 +110,6 @@ def build_snapshot(spec, table: KeyTable, result: Dict[str, np.ndarray],
         "arrays": arrays,
         "spill": spill or b"",
         "spill_entries": int(spill_entries),
+        # exactly-once forwarding state; None/absent = feature off
+        "forward": forward_meta,
     }

@@ -30,6 +30,11 @@ log = logging.getLogger("veneur_tpu.reliability.faults")
 
 # the canonical point names (keep in sync with the wiring listed above)
 FORWARD_SEND = "forward.send"
+# injected AFTER a forward send succeeded on the wire: the receiver has
+# folded the batch but the sender sees a failure — i.e. a lost ack. The
+# sender must retry the SAME (source_id, epoch, seq) and the receiver's
+# dedup window must suppress the re-fold.
+FORWARD_ACK = "forward.ack"
 HTTP_POST = "http.post"
 PROXY_FORWARD = "proxy.forward"
 SINK_FLUSH = "sink.flush"

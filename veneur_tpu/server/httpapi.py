@@ -248,14 +248,43 @@ def start_http_server(server, address) -> "http.server.ThreadingHTTPServer":
             else:
                 self._import_protobuf(body)
 
+        def _extract_envelope(self, body_env=None):
+            """Exactly-once envelope for this import: the wrapped-body
+            form wins, else the veneur-source-id/-epoch/-seq headers;
+            None when neither is present (legacy sender). Raises
+            EnvelopeError on a partial or malformed envelope."""
+            from veneur_tpu.forward.envelope import Envelope
+            if body_env is not None:
+                return Envelope.from_json(body_env)
+            return Envelope.from_mapping(self.headers)
+
+        def _reject_envelope(self, e) -> None:
+            # every rejection is accounted: this counter is asserted
+            # against the fuzz corpus (tests/test_intake_fuzz.py)
+            server._c_envelope_rejected.inc()
+            self._import_error("envelope")
+            self._reply(400, str(e).encode())
+
         def _import_json(self, body: bytes) -> None:
-            """Reference JSONMetric array (handlers_global.go:115)."""
+            """Reference JSONMetric array (handlers_global.go:115), or
+            the exactly-once wrapped form {"envelope": {...},
+            "metrics": [...]} the enveloped proxy/forward path POSTs."""
+            from veneur_tpu.forward.envelope import EnvelopeError
             from veneur_tpu.forward.jsonmetric import from_json_metric
             try:
                 jms = json.loads(body)
             except ValueError:
                 self._import_error("json")
                 self._reply(400, b"bad JSON body")
+                return
+            body_env = None
+            if isinstance(jms, dict):
+                body_env = jms.get("envelope")
+                jms = jms.get("metrics")
+            try:
+                envelope = self._extract_envelope(body_env)
+            except EnvelopeError as e:
+                self._reject_envelope(e)
                 return
             if not isinstance(jms, list) or not jms:
                 self._reply(400, b"Received empty /import request")
@@ -277,24 +306,46 @@ def start_http_server(server, address) -> "http.server.ThreadingHTTPServer":
                 self._reply(400, b"Received empty or improperly-formed "
                                  b"metrics")
                 return
-            if not server.import_metrics(metrics):
+            try:
+                ok = server.import_metrics(metrics, envelope=envelope)
+            except EnvelopeError as e:
+                # window-skip rejection (already counted by the server)
+                self._import_error("envelope")
+                self._reply(400, str(e).encode())
+                return
+            if not ok:
                 # CRITICAL overload sheds imports: 503 tells the sending
                 # tier to retry elsewhere (or later) instead of 202-ing
-                # data we discarded
+                # data we discarded. A dedup-suppressed duplicate is NOT
+                # a shed — import_metrics acks it True, the 202 below is
+                # the ack the sender needs to evict its unit.
                 self._reply(503, b"overloaded: import shed")
                 return
             self._import_timing(self._import_t0, "request")
             self._reply(202, b"imported")
 
         def _import_protobuf(self, body: bytes) -> None:
+            from veneur_tpu.forward.envelope import EnvelopeError
             from veneur_tpu.proto import forwardrpc_pb2 as fpb
+            try:
+                envelope = self._extract_envelope()
+            except EnvelopeError as e:
+                self._reject_envelope(e)
+                return
             try:
                 mlist = fpb.MetricList.FromString(body)
             except Exception:
                 self._import_error("protobuf")
                 self._reply(400, b"bad MetricList protobuf")
                 return
-            if not server.import_metrics(list(mlist.metrics)):
+            try:
+                ok = server.import_metrics(list(mlist.metrics),
+                                           envelope=envelope)
+            except EnvelopeError as e:
+                self._import_error("envelope")
+                self._reply(400, str(e).encode())
+                return
+            if not ok:
                 self._reply(503, b"overloaded: import shed")
                 return
             self._import_timing(self._import_t0, "request")

@@ -29,6 +29,7 @@ from typing import List, Optional
 from veneur_tpu.aggregation.host import BatchSpec
 from veneur_tpu.aggregation.state import TableSpec
 from veneur_tpu.config import Config
+from veneur_tpu.forward.envelope import FRESH, Envelope, EnvelopeError
 from veneur_tpu.reliability.faults import FAULTS, FLUSH_WORKER
 from veneur_tpu.reliability.policy import (OPEN, CircuitBreaker,
                                            CircuitOpenError, RetryPolicy)
@@ -280,6 +281,16 @@ class Server:
             "veneur.forward.sends_total", "completed forward sends")
         self._c_forward_retries = M.counter(
             "veneur.forward.retries_total", "forward send retry attempts")
+        # exactly-once forwarding (forward/envelope.py) — registered even
+        # with the dedup window off so the inventory is stable
+        self._c_dup_suppressed = M.counter(
+            "veneur.forward.dup_suppressed_total",
+            "already-folded forward intervals suppressed by the dedup "
+            "window (duplicates are still acked so senders evict)")
+        self._c_envelope_rejected = M.counter(
+            "veneur.forward.envelope_rejected_total",
+            "forward imports rejected for malformed or out-of-bound "
+            "(source_id, epoch, seq) envelopes — never folded")
         self._c_flush_count = M.counter(
             "veneur.flush.completed_total",
             "flush intervals run to completion (success or failure)")
@@ -377,6 +388,36 @@ class Server:
             from veneur_tpu.reliability.spill import ForwardSpillBuffer
             self.forward_spill = ForwardSpillBuffer(
                 cfg.forward_spill_max_bytes, cfg.forward_spill_max_age_s)
+
+        # -- exactly-once forwarding (veneur_tpu/forward/envelope.py) -----
+        # Off by default (forward_dedup_window == 0): no envelopes, no
+        # dedup state — the at-least-once semantics above stay untouched.
+        # With a window, this server deduplicates every enveloped import
+        # it receives; a LOCAL with a forward_address additionally mints
+        # a source identity and ack-gates its spill buffer (the spill
+        # becomes the durable send queue — see reliability/spill.py).
+        self._dedup = None
+        self._fwd_source_id = None
+        self._fwd_epoch = 0
+        self._fwd_next_seq = 0
+        self._fwd_acked_seq = -1
+        self._fwd_meta_lock = threading.Lock()
+        self._fwd_send_lock = threading.Lock()
+        if cfg.forward_dedup_window > 0:
+            from veneur_tpu.forward.envelope import (DedupWindow,
+                                                     mint_source_id)
+            self._dedup = DedupWindow(
+                cfg.forward_dedup_window,
+                max_sources=cfg.forward_dedup_max_sources)
+            if cfg.is_local and cfg.forward_address:
+                self._fwd_source_id = mint_source_id()
+                if self.forward_spill is None:
+                    # ack-gating needs the spill as its send queue even
+                    # when the merge-on-retry buffer wasn't configured
+                    from veneur_tpu.reliability.spill import (
+                        ForwardSpillBuffer)
+                    self.forward_spill = ForwardSpillBuffer(
+                        32 << 20, cfg.forward_spill_max_age_s)
 
         # -- overload management (veneur_tpu/reliability/overload.py) -----
         # Off by default: no controller object, and every hot-path gate
@@ -541,6 +582,18 @@ class Server:
                             if self.forward_spill is not None else None),
                    kind="counter",
                    help="spilled metrics dropped at the cap or max age")
+        M.callback("veneur.forward.acked_seq",
+                   lambda: (float(self._fwd_acked_seq)
+                            if self._fwd_source_id is not None
+                            and self._fwd_acked_seq >= 0 else None),
+                   help="highest sequence number the receiving tier has "
+                        "acked in the current epoch")
+        M.callback("veneur.dedup.window_evictions_total",
+                   lambda: (float(self._dedup.evictions)
+                            if self._dedup is not None else None),
+                   kind="counter",
+                   help="dedup streams evicted at the "
+                        "forward_dedup_max_sources LRU bound")
         M.callback("veneur.checkpoint.age_s",
                    lambda: (time.time() - self._ckpt_writer.last_write_ts
                             if self._ckpt_writer is not None
@@ -1469,10 +1522,16 @@ class Server:
                 if "//" in self.cfg.grpc_address
                 else f"tcp://{self.cfg.grpc_address}")
             native_import = hasattr(self.aggregator, "import_pb_bytes")
+            # with a dedup window the service runs the exactly-once
+            # contract: envelopes parsed from metadata, malformed ones
+            # rejected (INVALID_ARGUMENT), and a shed import NACKed
+            # (RESOURCE_EXHAUSTED) so the sender keeps its unit staged
             self._grpc_server, self.grpc_port = rpc.serve(
                 self.import_bytes if native_import
                 else self.import_metrics,
-                f"{target[0]}:{target[1]}", raw=native_import)
+                f"{target[0]}:{target[1]}", raw=native_import,
+                with_metadata=self._dedup is not None,
+                on_reject=self._c_envelope_rejected.inc)
         # forwarding client, dialed once at start (server.go:843-851);
         # http(s):// addresses take the HTTP /import path unless
         # forward_use_grpc forces gRPC (flusher.go:84-95 dispatch)
@@ -1522,24 +1581,52 @@ class Server:
                 {"name": d.get("name", ""), "api_key": "REDACTED"}
                 for d in self.cfg.signalfx_per_tag_api_keys]
 
-    def import_metrics(self, metrics: List) -> bool:
+    def _dedup_check(self, envelope) -> Optional[bool]:
+        """Exactly-once admission for one enveloped import batch. Runs
+        AFTER overload admission (a shed batch must not mark the window:
+        the sender re-sends and would read 'duplicate' for data that was
+        never folded) and BEFORE the enqueue, which cannot fail.
+
+        Returns None = fold it (fresh, or dedup/envelope off), True =
+        suppress but ACK (already folded, or past the window's staleness
+        bound — acking lets the sender evict; NACKing would replay
+        forever). Raises EnvelopeError (counted) for envelopes the
+        window refuses to accept at all."""
+        if self._dedup is None or envelope is None:
+            return None
+        try:
+            verdict = self._dedup.observe(envelope)
+        except EnvelopeError:
+            self._c_envelope_rejected.inc()
+            raise
+        if verdict == FRESH:
+            return None
+        self._c_dup_suppressed.inc()
+        return True
+
+    def import_metrics(self, metrics: List, envelope=None) -> bool:
         """gRPC import entry: enqueue onto the pipeline thread
         (importsrv/server.go:102 SendMetrics → IngestMetrics). Returns
         False when CRITICAL overload sheds the batch (HTTP callers turn
-        that into a 503 so the sender retries elsewhere)."""
+        that into a 503, the enveloped gRPC service into
+        RESOURCE_EXHAUSTED, so the sender retries elsewhere/later)."""
         if self._overload is not None \
                 and not self._overload.admit_import(len(metrics)):
             return False
+        if self._dedup_check(envelope):
+            return True
         self.packet_queue.put(_ImportBatch(metrics))
         return True
 
-    def import_bytes(self, data: bytes) -> bool:
+    def import_bytes(self, data: bytes, envelope=None) -> bool:
         """Raw-bytes gRPC import entry (native decode path): the
         pipeline thread hands the serialized MetricList straight to the
         C++ importer. Same CRITICAL-shed contract as import_metrics."""
         if self._overload is not None \
                 and not self._overload.admit_import():
             return False
+        if self._dedup_check(envelope):
+            return True
         self.packet_queue.put(_ImportBytes(data))
         return True
 
@@ -1605,13 +1692,53 @@ class Server:
                 agg_kind="sharded" if n_shards > 1 else "single",
                 n_shards=n_shards, interval_ts=ts,
                 hostname=self.hostname, spill=spill_bytes,
-                spill_entries=spill_n)
+                spill_entries=spill_n,
+                forward_meta=self._forward_meta_snapshot())
             self._ckpt_writer.submit(snap)
         except Exception:
             log.exception("checkpoint snapshot build failed; interval "
                           "not checkpointed")
         self._t_flush_phase.observe(time.perf_counter_ns() - ck_t0,
                                     phase="checkpoint_build")
+
+    def _forward_meta_snapshot(self) -> Optional[dict]:
+        """Exactly-once forwarding state for the checkpoint: the sender
+        identity (source_id + epoch + next seq) and/or this receiver's
+        dedup window. None (chunk omitted) when the feature is off."""
+        if self._fwd_source_id is None and self._dedup is None:
+            return None
+        meta: dict = {}
+        if self._fwd_source_id is not None:
+            with self._fwd_meta_lock:
+                meta.update({"source_id": self._fwd_source_id,
+                             "epoch": self._fwd_epoch,
+                             "next_seq": self._fwd_next_seq})
+        if self._dedup is not None:
+            meta["dedup"] = self._dedup.snapshot()
+        return meta
+
+    def _restore_forward_meta(self, meta: dict) -> None:
+        """Adopt a checkpoint's forwarding identity. The epoch BUMPS by
+        one with seq reset: seqs minted after the checkpoint died with
+        the process, and reusing them for NEW data would make the
+        receiver suppress it as duplicates. Spill units restored
+        alongside keep their ORIGINAL old-epoch envelopes — those are
+        replays of already-possibly-folded payloads, exactly what the
+        receiver's window for the old epoch knows how to suppress."""
+        try:
+            sid = str(meta.get("source_id") or "")
+            if self._fwd_source_id is not None and sid:
+                Envelope(sid, int(meta.get("epoch", 0)), 0).validate()
+                with self._fwd_meta_lock:
+                    self._fwd_source_id = sid
+                    self._fwd_epoch = int(meta.get("epoch", 0)) + 1
+                    self._fwd_next_seq = 0
+                    self._fwd_acked_seq = -1
+            if self._dedup is not None and meta.get("dedup"):
+                self._dedup.restore(meta["dedup"])
+        except (EnvelopeError, TypeError, ValueError) as e:
+            log.warning("ignoring malformed forward metadata in "
+                        "checkpoint: %s", e)
 
     def _restore_from_checkpoint(self) -> None:
         """Fold the newest valid snapshot into the live aggregator.
@@ -1628,9 +1755,24 @@ class Server:
                          self.cfg.checkpoint_dir)
                 return
             snap, path = found
-            n = fold_snapshot(self.aggregator, snap)
+            fwd_meta = snap.get("forward") or None
+            # skip re-folding forward-ONLY rows iff their payloads travel
+            # via the spill replay instead: the snapshot was written by
+            # an exactly-once sender (it staged the export BEFORE the
+            # checkpoint, so the spill chunk holds those rows under their
+            # envelopes) and this server will replay that spill. Folding
+            # them too would re-export the same data under a fresh seq
+            # the receiver cannot correlate — a guaranteed double-count.
+            skip_fwd = (fwd_meta is not None
+                        and fwd_meta.get("source_id")
+                        and self._fwd_source_id is not None
+                        and self.forward_spill is not None)
+            n = fold_snapshot(self.aggregator, snap,
+                              skip_forwarded=bool(skip_fwd))
             if self.forward_spill is not None and snap.get("spill"):
                 restore_spill(self.forward_spill, snap["spill"])
+            if fwd_meta:
+                self._restore_forward_meta(fwd_meta)
             self._c_ckpt_restores.inc()
             log.info("restored %d metrics from %s (interval_ts=%d)",
                      n, path, snap["interval_ts"])
@@ -1714,12 +1856,25 @@ class Server:
         if trace:
             sp.set_tag("h2d_bytes", str(h2d_delta))
         sp.client_finish(self.trace_client)
+        # exactly-once forwarding: export + stage this interval's unit
+        # under a fresh (epoch, seq) BEFORE the checkpoint build, so the
+        # snapshot's spill chunk carries the payload with its envelope
+        # (_stage_forward_unit explains the crash-replay invariant)
+        if self._fwd_source_id is not None and raw is not None:
+            self._stage_forward_unit(raw, table)
         if self._ckpt_writer is not None:
             if ckpt_due:
                 # capture the spill BEFORE the forward drains it: a crash
                 # between here and a successful send replays those
-                # payloads (at-least-once; mergeable sketches make the
-                # duplicate fold idempotent at the receiving tier)
+                # payloads. The replay is NOT uniformly idempotent at the
+                # receiving tier — HLL register folds and LWW gauges
+                # absorb duplicates, but counter accumulators and
+                # t-digest centroid weights are ADDITIVE and double-count
+                # — so with forward_dedup_window > 0 the staged unit
+                # replays under its original (source_id, epoch, seq) and
+                # the receiver's dedup window suppresses the re-fold;
+                # without a window the replay is at-least-once for the
+                # additive kinds (forward/envelope.py).
                 self._checkpoint_interval(flush_arrays, table, raw, ts)
                 self._flushes_since_ckpt = 0
             else:
@@ -1729,7 +1884,12 @@ class Server:
             # (flusher.go:84-95); _forward logs and counts its own errors,
             # and the flush thread must never block on a slow global tier
             fsp = stage("forward")
-            self._spawn_aux(self._forward_traced, fsp, raw, table)
+            if self._fwd_source_id is not None:
+                # ack-gated mode: the interval was staged above; the pump
+                # replays every pending unit under its original envelope
+                self._spawn_aux(self._pump_traced, fsp)
+            else:
+                self._spawn_aux(self._forward_traced, fsp, raw, table)
 
         if self.cfg.count_unique_timeseries:
             from veneur_tpu.server.flusher import unique_timeseries
@@ -1916,6 +2076,106 @@ class Server:
             self._forward(raw, table, span=span)
         finally:
             span.client_finish(self.trace_client)
+
+    # -- exactly-once forwarding (forward/envelope.py; README
+    # §Exactly-once forwarding) --------------------------------------------
+    def _next_envelope(self) -> Envelope:
+        with self._fwd_meta_lock:
+            seq = self._fwd_next_seq
+            self._fwd_next_seq += 1
+            return Envelope(self._fwd_source_id, self._fwd_epoch, seq)
+
+    def _stage_forward_unit(self, raw, table) -> None:
+        """Export this interval's forwardable sketches and stage them as
+        an immutable ack-gated unit under a fresh (epoch, seq), on the
+        flush worker thread BEFORE the checkpoint build and the send.
+
+        That ordering is the crash-exactly-once invariant: every
+        checkpoint's forward-eligible rows are inside its spill chunk
+        WITH their envelope, so a crash-restore replays the same bytes
+        under the same seq (which the receiver's dedup window can
+        suppress) while fold_snapshot(skip_forwarded=True) keeps those
+        rows from re-exporting under a fresh seq it couldn't.
+
+        Legacy (unenveloped) spill entries — restored from a pre-upgrade
+        checkpoint — fold into this unit so they too travel enveloped."""
+        from veneur_tpu.forward.convert import export_metrics
+        try:
+            fresh = export_metrics(
+                raw, table, compression=self.aggregator.spec.compression,
+                hll_precision=self.aggregator.spec.hll_precision)
+            legacy = [m for _, m in self.forward_spill.take_legacy()]
+            if legacy:
+                log.info("forward: folding %d legacy spilled payloads "
+                         "into this interval's unit", len(legacy))
+                fresh = legacy + fresh
+            if fresh:
+                env = self._next_envelope()
+                self.forward_spill.add_unit(fresh, env.epoch, env.seq)
+        except Exception:
+            # containment: a failed export degrades forwarding for this
+            # interval, never the flush (errors surface at the pump)
+            self._c_forward_errors.inc()
+            log.exception("forward export/staging failed; interval not "
+                          "staged")
+
+    def _pump_traced(self, span):
+        try:
+            self._pump_forward_units(span=span)
+        finally:
+            span.client_finish(self.trace_client)
+
+    def _pump_forward_units(self, span=None) -> None:
+        """Send every staged unit oldest-first; a successful send IS the
+        receiver's ack for that seq (the RPC/202 returns only after the
+        import was admitted — or recognized as a duplicate, which is
+        acked too), so the unit is evicted. A failed or AMBIGUOUS send
+        leaves the unit in place untouched: the next interval's pump
+        re-sends the SAME bytes under the SAME seq.
+
+        Single-flight (non-blocking lock): a slow failing pump may
+        overlap the next interval's; a second concurrent pump would
+        re-send units already in flight — harmless to the receiver
+        (dedup) but a bandwidth and breaker-accounting mess."""
+        if not self._fwd_send_lock.acquire(blocking=False):
+            return
+        t0 = time.perf_counter_ns()
+        n_metrics = 0
+        try:
+            if (self._forward_breaker is not None
+                    and not self._forward_breaker.allow()):
+                raise CircuitOpenError("forward: circuit open")
+            for unit in self.forward_spill.pending_units():
+                env = Envelope(self._fwd_source_id, unit.epoch, unit.seq)
+                n_metrics += len(unit.metrics)
+                self._send_forward(unit.metrics, span, envelope=env)
+                self.forward_spill.ack(unit.epoch, unit.seq)
+                with self._fwd_meta_lock:
+                    if (unit.epoch == self._fwd_epoch
+                            and unit.seq > self._fwd_acked_seq):
+                        self._fwd_acked_seq = unit.seq
+                if self._forward_breaker is not None:
+                    self._forward_breaker.record_success()
+                self._c_forward_sends.inc()
+        except Exception as e:
+            if (self._forward_breaker is not None
+                    and not isinstance(e, CircuitOpenError)):
+                self._forward_breaker.record_failure()
+            # NO spill mutation here: the unsent units (including the
+            # one that just failed) are still staged under their seqs —
+            # re-sending the same envelope is the whole point
+            self._c_forward_errors.inc()
+            if span is not None:
+                span.error = True
+            log.warning("forward failed: %s", e)
+        finally:
+            dur_ns = time.perf_counter_ns() - t0
+            self._t_flush_phase.observe(dur_ns, phase="forward")
+            if span is not None and self._flush_trace:
+                span.set_tag("rows", str(n_metrics))
+            with self._sink_stats_lock:
+                self._forward_stats.append((dur_ns, n_metrics))
+            self._fwd_send_lock.release()
 
     def _report_self_metrics(self, n_flushed: int, flush_seconds: float,
                              stats: dict, final=None):
@@ -2214,16 +2474,26 @@ class Server:
             with self._sink_stats_lock:
                 self._forward_stats.append((dur_ns, n_metrics))
 
-    def _send_forward(self, metrics, span) -> None:
+    def _send_forward(self, metrics, span, envelope=None) -> None:
         """One forward send under the retry policy. The HTTP client
         carries the policy itself (each attempt re-runs the whole
         traced_post pipeline), so only wrap clients without one — a
-        double wrap would square the attempt count."""
+        double wrap would square the attempt count.
+
+        The envelope kwarg is passed through only when set, so embedder
+        fakes with the legacy send_metrics signature keep working.
+        Every retry attempt re-sends the SAME envelope — an ambiguous
+        failure (DEADLINE_EXCEEDED/CANCELLED, rpc.AmbiguousResultError)
+        may have folded at the receiver, and only a same-seq re-send
+        lets the dedup window suppress the duplicate."""
+        kw = {}
+        if envelope is not None:
+            kw["envelope"] = envelope
 
         def once():
             self._forward_client.send_metrics(
                 metrics, timeout=self.interval, parent_span=span,
-                trace_client=self.trace_client)
+                trace_client=self.trace_client, **kw)
 
         if (self.retry_policy is None
                 or getattr(self._forward_client, "retry_policy", None)
@@ -2430,10 +2700,18 @@ class Server:
         # never reached a flush. Written SYNCHRONOUSLY (shutdown is the
         # one caller that must not race interpreter teardown) and always
         # newest, so a graceful restart restores ONLY the tail — flushed
-        # intervals already left through the sinks, and restoring them
-        # too would double-count downstream (exactly-once across a
-        # graceful restart; a crash falls back to the last periodic
-        # checkpoint, i.e. at-least-once for that interval).
+        # intervals already left through the sinks. Restoring them too
+        # would NOT wash out downstream: HLL registers and LWW gauges do
+        # merge a duplicate fold idempotently, but counter accumulators
+        # and t-digest centroid weights are ADDITIVE — a re-forwarded
+        # interval double-counts them at the global tier. With
+        # forward_dedup_window > 0 the tail's export is staged below as
+        # an ack-gated unit, so the restart replays it under its
+        # original (source_id, epoch, seq) exactly once and the dedup
+        # layer (forward/envelope.py) suppresses any crash-driven
+        # replay; without a window a crash falls back to the last
+        # periodic checkpoint, i.e. at-least-once for the additive kinds
+        # of that interval.
         if self._ckpt_writer is not None:
             if self.cfg.checkpoint_on_shutdown:
                 try:
@@ -2441,6 +2719,13 @@ class Server:
                     state, table = self.aggregator.swap()
                     flush_arrays, table, raw = self.aggregator.compute_flush(
                         state, table, self.cfg.percentiles, want_raw=True)
+                    # stage the tail's forward payload BEFORE serializing
+                    # the spill: the tail snapshot then carries the unit
+                    # with its envelope, the restart replays it once, and
+                    # fold_snapshot(skip_forwarded) keeps its rows from
+                    # re-exporting under a second seq
+                    if self._fwd_source_id is not None:
+                        self._stage_forward_unit(raw, table)
                     spill_bytes, spill_n = None, 0
                     if self.forward_spill is not None:
                         spill_bytes = self.forward_spill.to_bytes()
@@ -2451,7 +2736,8 @@ class Server:
                         agg_kind="sharded" if n_shards > 1 else "single",
                         n_shards=n_shards, interval_ts=int(time.time()),
                         hostname=self.hostname, spill=spill_bytes,
-                        spill_entries=spill_n))
+                        spill_entries=spill_n,
+                        forward_meta=self._forward_meta_snapshot()))
                 except Exception:
                     log.exception("final checkpoint failed; last periodic "
                                   "checkpoint remains newest")
