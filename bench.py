@@ -257,7 +257,8 @@ def main():
                  "--only", "parse_metric_native",
                  "--only", "parse_metric_warm",
                  "--only", "worker_ingest", "--only", "flush_label_frame",
-                 "--only", "import_decode_native"],
+                 "--only", "import_decode_native",
+                 "--only", "pipeline_pump"],
                 capture_output=True, text=True, timeout=micro_t,
                 cwd=here, env=cache_env(force_cpu=True))
             host = {}
@@ -268,6 +269,11 @@ def main():
                     continue
                 if "ops_per_sec" in row:
                     host[row["bench"]] = row["ops_per_sec"]
+                    # pipeline_pump also reports the host→device byte
+                    # rate of the packed feed; ride it in the artifact
+                    if "h2d_mb_per_sec" in row:
+                        host[row["bench"] + "_h2d_mb_per_sec"] = \
+                            row["h2d_mb_per_sec"]
                 elif "skipped" in row:
                     host[row["bench"]] = row["skipped"]
             if proc.returncode != 0:
@@ -341,7 +347,7 @@ def main():
             # ingest, 4: global merge, 9: exactly-once under ack loss):
             # under the wall-clock guard the TAIL gets truncated, never
             # the head
-            out["e2e"] = e2e.main(configs=[2, 1, 4, 9, 3, 5, 6, 7, 8],
+            out["e2e"] = e2e.main(configs=[2, 1, 4, 9, 10, 3, 5, 6, 7, 8],
                                   scale=scale,
                                   force_cpu=on_cpu, on_result=on_result,
                                   deadline=T0 + guard - 45.0)

@@ -1154,11 +1154,229 @@ def config9_duplicate_storm(scale=1.0):
         glob.shutdown()
 
 
+# -- config 10: native wire→flush firehose — in-engine admission --------------
+
+def config10_wire_to_flush_firehose(scale=1.0):
+    """Loopback UDP firehose through the NATIVE ingest path end-to-end:
+    C++ recvmmsg readers → in-engine admission (config 8's guarantees
+    pushed into the reader ring) → datagram ring → pump parse/stage →
+    zero-copy packed emit → donated-state device step → flush. The
+    senders deliberately outrun the pump so the ring saturates and the
+    overload controller drives the C++ admission into shedding; the
+    acceptance identity is EXACT: every under-limit datagram the senders
+    put on the wire is counted exactly once as admitted or shed by the
+    reader (ring-full drops are post-admission and accounted
+    separately). Senders bound their in-flight window against the
+    reader's received-datagram counter so the kernel socket buffer — the
+    one lossy hop the identity cannot see — never overflows. The on-chip
+    throughput gate (≥5M samples/sec/host through the pump) arms on TPU
+    only; CPU smoke checks the accounting + shedding behavior."""
+    import jax
+
+    from veneur_tpu import native as native_mod
+    from veneur_tpu.reliability.overload import SHEDDING
+    from veneur_tpu.sinks.blackhole import BlackholeMetricSink
+
+    if not native_mod.available():
+        return {"config": 10, "name": "wire_to_flush_firehose",
+                "skipped": "native ingest engine unavailable"}
+
+    low_names = 512
+    high_names = 64
+    lines_per = 100            # ~2KB datagrams, under metric_max_length
+    # must out-fill the 64k-datagram ring to force shedding; scale only
+    # grows the storm, the floor is the ring + margin
+    datagrams = max(100_000, int(400_000 * scale))
+    n_senders = 4
+    window = 512               # in-flight datagrams vs the reader counter
+
+    # counter-firehose sizing: big counter lanes, everything else small —
+    # at the server defaults the periodic compact step spends seconds
+    # compacting 16k EMPTY t-digests on a CPU host, which would measure
+    # the idle histogram table instead of the feed path under test
+    srv = _mk_server(
+        [BlackholeMetricSink()], udp=True, num_readers=2,
+        overload_enabled=True, overload_poll_interval_s=0.05,
+        overload_hold_s=0.5,
+        shed_priority_tags=["veneur.priority:high"],
+        tpu_counter_capacity=1 << 14, tpu_batch_counter=1 << 16,
+        tpu_gauge_capacity=1 << 10, tpu_status_capacity=64,
+        tpu_set_capacity=256, tpu_histo_capacity=256,
+        tpu_batch_gauge=256, tpu_batch_status=64, tpu_batch_set=256,
+        tpu_batch_histo=256)
+    try:
+        if not srv._native_readers_active:
+            return {"config": 10, "name": "wire_to_flush_firehose",
+                    "skipped": "native readers did not start"}
+        ov = srv._overload
+        addr = srv.local_addr()
+        rng = np.random.default_rng(7)
+
+        def rc():
+            return srv.aggregator.reader_counters()
+
+        # pre-built traffic: 10% high-priority datagrams (every line
+        # tagged — classification is per datagram), 90% low
+        high_pool = []
+        for i in range(8):
+            ns = rng.integers(0, high_names, lines_per)
+            high_pool.append(b"\n".join(
+                b"storm.h%d:1|c|#veneur.priority:high" % n for n in ns))
+        low_pool = []
+        for i in range(64):
+            ns = rng.integers(0, low_names, lines_per)
+            low_pool.append(b"\n".join(
+                b"storm.l%d:1|c" % n for n in ns))
+        payloads = []
+        sent = {"high": 0, "low": 0}
+        for i in range(datagrams):
+            if i % 10 == 0:
+                payloads.append(high_pool[(i // 10) % len(high_pool)])
+                sent["high"] += 1
+            else:
+                payloads.append(low_pool[i % len(low_pool)])
+                sent["low"] += 1
+
+        # warm: every storm name through the real wire path once, then a
+        # flush so the ingest + flush compiles land at the storm's true
+        # size buckets, all before t0
+        phase("warm")
+        warm_tx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            base = srv.aggregator.processed
+            warm_lines = 0
+            for lo in range(0, low_names, lines_per):
+                ns = range(lo, min(lo + lines_per, low_names))
+                warm_tx.sendto(b"\n".join(
+                    b"storm.l%d:1|c" % n for n in ns), addr)
+                warm_lines += min(lines_per, low_names - lo)
+            warm_tx.sendto(b"\n".join(
+                b"storm.h%d:1|c|#veneur.priority:high" % n
+                for n in range(high_names)), addr)
+            warm_lines += high_names
+        finally:
+            warm_tx.close()
+        deadline = time.time() + WARM_TIMEOUT
+        while srv.aggregator.processed < base + warm_lines \
+                and time.time() < deadline:
+            time.sleep(0.02)
+        if srv.aggregator.processed < base + warm_lines:
+            raise RuntimeError("warm feed did not drain through the "
+                               "native path")
+        _flush_checked(srv, timeout=WARM_TIMEOUT)
+
+        # quiesce, fold any outstanding C++ admission counts into the
+        # controller, then snapshot — the storm deltas below must start
+        # from a drained engine
+        srv._sync_native_admission(ov)
+        rc0 = rc()
+        adm0 = dict(ov.admitted)
+        shed0 = dict(ov.shed)
+        proc0 = srv.aggregator.processed
+        send_errors = []
+        sent_lock = threading.Lock()
+        sent_n = [0]
+
+        def send_slice(idx):
+            s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            try:
+                k = 0
+                for p in payloads[idx::n_senders]:
+                    s.sendto(p, addr)
+                    with sent_lock:
+                        sent_n[0] += 1
+                        mine = sent_n[0]
+                    k += 1
+                    if k % 64 == 0:
+                        # bounded in-flight: the reader consumes (shed or
+                        # ring) far faster than Python sends, so this
+                        # almost never spins — it exists so the kernel
+                        # rcvbuf can NEVER overflow and break exactness
+                        while mine - rc()["datagrams"] + rc0["datagrams"] \
+                                > window:
+                            time.sleep(0.0005)
+            except OSError as e:
+                send_errors.append(e)
+            finally:
+                s.close()
+
+        phase("firehose")
+        t0 = time.perf_counter()
+        t_storm = time.monotonic()
+        threads = [threading.Thread(target=send_slice, args=(i,))
+                   for i in range(n_senders)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if send_errors:
+            raise RuntimeError(f"sender failed: {send_errors[0]}")
+
+        phase("drain")
+        deadline = time.time() + DRAIN_TIMEOUT
+        while rc()["datagrams"] - rc0["datagrams"] < len(payloads) \
+                and time.time() < deadline:
+            time.sleep(0.01)
+        last = -1
+        while time.time() < deadline:
+            cur = srv.aggregator.processed
+            if rc()["ring_depth"] == 0 and cur == last:
+                break
+            last = cur
+            time.sleep(0.05)
+        dt = time.perf_counter() - t0
+        # final fold so the accounting below sees every C++ decision
+        srv._sync_native_admission(ov)
+        rc1 = rc()
+
+        phase("flush")
+        _flush_checked(srv, timeout=WARM_TIMEOUT)
+
+        received = rc1["datagrams"] - rc0["datagrams"]
+        toolong_d = rc1["toolong"] - rc0["toolong"]
+        adm_d = {k: v - adm0.get(k, 0) for k, v in ov.admitted.items()}
+        shed_d = {k: v - shed0.get(k, 0) for k, v in ov.shed.items()}
+        shed_d.pop("flush", None)
+        # the identity covers the firehose's classes; "self" carries the
+        # server's own telemetry loop-back and is admission-exempt anyway
+        adm_hl = adm_d.get("high", 0) + adm_d.get("low", 0)
+        shed_hl = shed_d.get("high", 0) + shed_d.get("low", 0)
+        processed = srv.aggregator.processed - proc0
+        peak = max((to for ts, _f, to in ov.transitions if ts >= t_storm),
+                   default=ov.state)
+        sps = processed / dt
+        on_tpu = jax.default_backend() == "tpu"
+        return {
+            "config": 10, "name": "wire_to_flush_firehose",
+            "datagrams_sent": len(payloads),
+            "lines_per_datagram": lines_per,
+            "sent": sent,
+            "datagrams_received": int(received),
+            "no_kernel_drops": received == len(payloads),
+            "toolong": int(toolong_d),
+            "admitted": adm_d, "shed": shed_d,
+            "accounting_exact": (adm_hl + shed_hl == len(payloads)
+                                 and toolong_d == 0),
+            "shed_active": shed_d.get("low", 0) > 0,
+            "peak_state": int(peak),
+            "reached_shedding": peak >= SHEDDING,
+            "ring_dropped": int(rc1["ring_dropped"]
+                                - rc0["ring_dropped"]),
+            "samples_processed": int(processed),
+            "samples_per_sec": round(sps, 1),
+            "on_chip_gate_5m_armed": on_tpu,
+            "samples_per_sec_ge_5m": (sps >= 5e6) if on_tpu else None,
+            "wall_seconds": round(dt, 3),
+        }
+    finally:
+        srv.shutdown()
+
+
 CONFIGS = {1: config1_counter_replay, 2: config2_zipf_timers,
            3: config3_set_cardinality, 4: config4_global_merge,
            5: config5_span_firehose, 6: config6_cardinality_stress,
            7: config7_checkpoint_restore, 8: config8_overload_storm,
-           9: config9_duplicate_storm}
+           9: config9_duplicate_storm, 10: config10_wire_to_flush_firehose}
 
 # Per-config subprocess budget: backend init + first XLA compiles of the
 # config's size buckets (~tens of seconds each on the tunneled chip) +

@@ -172,12 +172,88 @@ def bench_worker_ingest_native(seconds):
 
     def run():
         for buf in bufs:
-            if eng.feed(buf):
+            full, off = eng.feed(buf)
+            while full:
                 eng.emit_into(arrays)
+                full, off = eng.feed(buf, off)
         if eng.pending() > (1 << 15):
             eng.emit_into(arrays)
 
     return _timeit(run, seconds, batch=64 * 40)
+
+
+def bench_pipeline_pump(seconds):
+    """The COMPLETE wire→device cycle: loopback UDP datagrams through the
+    C++ recvmmsg reader ring, vr_pump parse/stage, zero-copy packed emit
+    (vt_emit_packed into the double-buffered flat host buffers), and the
+    jitted donated-state ingest dispatch. worker_ingest_native excludes
+    the device dispatch; this row is the number the host feed actually
+    sustains end-to-end, plus the h2d bytes it ships."""
+    from veneur_tpu import native
+    if not native.available():
+        return None
+    import socket
+
+    from veneur_tpu.aggregation.host import BatchSpec
+    from veneur_tpu.aggregation.state import TableSpec
+    from veneur_tpu.server.native_aggregator import NativeAggregator
+    # Counter-heavy workload (config 1's replay model): size the unused
+    # lanes down so the dispatch cost reflects the traffic instead of
+    # idle histogram capacity, and use a 64k counter batch so each step
+    # amortizes the fixed jit-dispatch overhead over more samples.
+    agg = NativeAggregator(
+        TableSpec(counter_capacity=1 << 14, gauge_capacity=8,
+                  status_capacity=8, set_capacity=8, histo_capacity=8),
+        BatchSpec(counter=1 << 16, gauge=8, status=8, set=8, histo=8))
+    # 10k counter names, 200 lines per datagram
+    rng = np.random.default_rng(1)
+    bufs = []
+    for _ in range(128):
+        ns = rng.integers(0, 10_000, 200)
+        bufs.append(b"\n".join(b"replay.counter.%d:1|c" % n for n in ns))
+    per_round = 128 * 200
+    rx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    rx.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 1 << 22)
+    rx.bind(("127.0.0.1", 0))
+    tx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    tx.connect(rx.getsockname())
+    agg.readers_start([rx.fileno()], max_len=65536)
+    try:
+        def one_round():
+            # bounded in-flight (128 datagrams ≪ the 4MB rcvbuf) so the
+            # kernel never drops on loopback and the wait below is exact
+            target = agg.processed + per_round
+            for buf in bufs:
+                tx.send(buf)
+            deadline = time.perf_counter() + 10.0
+            while agg.processed < target:
+                agg.pump(1)
+                if time.perf_counter() > deadline:
+                    raise RuntimeError("pipeline_pump lost datagrams")
+
+        # warmup until at least two full batches dispatched, so the XLA
+        # compile AND the first donated-state step are outside the timing
+        while agg.steps_total < 2:
+            one_round()
+        import jax
+        jax.block_until_ready(jax.tree.leaves(agg.state))
+        rounds = 0
+        h2d0 = agg.h2d_bytes
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < seconds:
+            one_round()
+            rounds += 1
+        jax.block_until_ready(jax.tree.leaves(agg.state))
+        dt = time.perf_counter() - t0
+        ops = rounds * per_round
+        return {"iters": ops, "ns_per_op": round(dt / ops * 1e9, 1),
+                "ops_per_sec": round(ops / dt, 1),
+                "h2d_mb_per_sec": round(
+                    (agg.h2d_bytes - h2d0) / dt / 1e6, 2)}
+    finally:
+        agg.readers_stop()
+        tx.close()
+        rx.close()
 
 
 # -- full flush (server_test.go:1139 BenchmarkServerFlush) -------------------
@@ -496,6 +572,7 @@ MICROS = {
     "parse_ssf": bench_parse_ssf,
     "worker_ingest": bench_worker_ingest,
     "worker_ingest_native": bench_worker_ingest_native,
+    "pipeline_pump": bench_pipeline_pump,
     "server_flush": bench_server_flush,
     "handle_ssf": bench_handle_ssf,
     "import_metrics": bench_import_metrics,
@@ -526,6 +603,10 @@ def main(argv=None):
         out = MICROS[name](args.seconds)
         if out is None:
             line = {"bench": name, "skipped": "native engine unavailable"}
+        elif isinstance(out, dict):
+            # a micro may report extra columns (h2d_mb_per_sec) or a
+            # skip reason; pass its row through as-is
+            line = {"bench": name, **out}
         else:
             iters, ns = out
             line = {"bench": name, "iters": iters,

@@ -172,14 +172,18 @@ def test_batch_full_backpressure():
     eng = mk()
     lines = b"\n".join(b"k%d:1|c" % (i % 100)
                        for i in range(BSPEC.counter + 10))
-    full = eng.feed(lines)
+    full, off = eng.feed(lines)
     assert full
+    assert 0 < off < len(lines)
     assert eng.pending() == BSPEC.counter
     arrays = emit_arrays()
     nc, _, _, _ = eng.emit_into(arrays)
     assert nc == BSPEC.counter
-    # the unconsumed tail can be re-fed
-    assert not eng.feed(eng._pending_tail)
+    # the unconsumed tail resumes from the returned absolute offset —
+    # same buffer, no re-slice copy
+    full2, off2 = eng.feed(lines, off)
+    assert not full2
+    assert off2 == len(lines)
     nc2, _, _, _ = eng.emit_into(emit_arrays())
     assert nc2 == 10
 
@@ -484,3 +488,260 @@ def test_full_server_native_vs_python_differential():
     assert nat[("d.g", ())][0] == 4.0
     assert nat[("d.rate", ())][0] == 4.0
     assert nat[("d.scoped", ("env:x",))][0] == 5.0
+
+
+# -- zero-copy packed emit: golden parity + invariants (r06) -----------------
+# The packed-emit tentpole replaced the Batch path (sentinel-filled
+# arrays -> emit_into -> ten .copy()s -> Batch -> pack_batch repack)
+# with vt_emit_packed writing staged lanes straight into the flat
+# double-buffered host buffer. These tests pin the new path against an
+# in-test reconstruction of the removed one: same wire bytes, byte-
+# identical device state.
+
+def _attach_old_batch_emit(ref):
+    """Reattach the pre-packed-emit (r05) native emit as an instance
+    attribute: fresh sentinel-initialized lanes, emit_into, a Batch with
+    constant status/histo-stat lanes, then the _on_batch repack. This is
+    the reference the zero-copy path must match bit-for-bit."""
+    from veneur_tpu.aggregation.step import Batch
+
+    def old_emit():
+        b, sp = ref.bspec, ref.spec
+        c_slot = np.full(b.counter, sp.counter_capacity, np.int32)
+        c_inc = np.zeros(b.counter, np.float32)
+        g_slot = np.full(b.gauge, sp.gauge_capacity, np.int32)
+        g_val = np.zeros(b.gauge, np.float32)
+        s_slot = np.full(b.set, sp.set_capacity, np.int32)
+        s_reg = np.zeros(b.set, np.int32)
+        s_rho = np.zeros(b.set, np.uint8)
+        h_slot = np.full(b.histo, sp.histo_capacity, np.int32)
+        h_val = np.zeros(b.histo, np.float32)
+        h_wt = np.zeros(b.histo, np.float32)
+        nc, ng, ns, nh = ref.eng.emit_into(
+            (c_slot, c_inc, g_slot, g_val, s_slot, s_reg, s_rho,
+             h_slot, h_val, h_wt))
+        if nc + ng + ns + nh == 0:
+            return
+        batch = Batch(
+            counter_slot=c_slot, counter_inc=c_inc,
+            gauge_slot=g_slot, gauge_val=g_val,
+            status_slot=np.full(b.status, sp.status_capacity, np.int32),
+            status_val=np.zeros(b.status, np.float32),
+            set_slot=s_slot, set_reg=s_reg, set_rho=s_rho,
+            histo_slot=h_slot, histo_val=h_val, histo_wt=h_wt,
+            histo_stat_slot=np.full(b.histo_stat, sp.histo_capacity,
+                                    np.int32),
+            histo_stat_min=np.full(b.histo_stat, np.inf, np.float32),
+            histo_stat_max=np.full(b.histo_stat, -np.inf, np.float32),
+            histo_stat_recip=np.zeros(b.histo_stat, np.float32),
+        )
+        ref._on_batch(batch)
+
+    ref._emit_native = old_emit
+
+
+def _parity_waves():
+    """Mixed-kind traffic in waves; emit between waves so successive
+    emits alternate packed buffers AND leave stale tails (wave sizes
+    shrink, so later emits must re-sentinel rows the earlier ones
+    dirtied)."""
+    waves = []
+    for scale in (40, 25, 7, 1):
+        lines = []
+        for i in range(scale):
+            lines.append(b"pz.c%d:%d|c" % (i, i + 1))
+            lines.append(b"pz.c%d:2|c|@0.5" % (i % 11))
+            if i < 30:
+                lines.append(b"pz.g%d:%d.25|g" % (i % 30, i))
+                lines.append(b"pz.h%d:%d|ms" % (i % 20, i * 3))
+            if i < 10:
+                lines.append(b"pz.s%d:u%d|s" % (i % 4, i))
+        waves.append(b"\n".join(lines))
+    return waves
+
+
+def test_packed_emit_state_parity_with_batch_path():
+    """GOLDEN: zero-copy packed emit vs the removed Batch path on
+    identical wire bytes -> byte-identical device state and identical
+    flushed values. Any divergence (sentinel restore bound, lane
+    offsets, compact-flag cadence, stale-tail handling) fails here."""
+    import jax
+
+    _spec, nat = _small_native_agg()
+    _spec2, ref = _small_native_agg()
+    _attach_old_batch_emit(ref)
+
+    for wave in _parity_waves():
+        for agg in (nat, ref):
+            agg.feed(wave)
+            agg._emit_native()
+
+    assert nat.steps_total == ref.steps_total > 1
+
+    state_n, table_n = nat.swap()
+    state_r, table_r = ref.swap()
+    leaves_n = jax.tree.leaves(state_n)
+    leaves_r = jax.tree.leaves(state_r)
+    assert len(leaves_n) == len(leaves_r)
+    for a, b in zip(leaves_n, leaves_r):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # second interval straight through flush: values identical too
+    for agg in (nat, ref):
+        agg.feed(b"\n".join([b"pz2.c:3|c", b"pz2.g:1.5|g",
+                             b"pz2.h:7|ms", b"pz2.h:9|ms",
+                             b"pz2.s:ua|s", b"pz2.s:ub|s"]))
+    got_n = _flush_names(nat)
+    got_r = _flush_names(ref)
+    assert got_n == got_r
+    assert got_n["pz2.c"] == 3.0 and got_n["pz2.g"] == 1.5
+
+
+def test_packed_emit_sharded_flush_parity():
+    """Sharded fan-out (argsort/searchsorted shard split) vs the single
+    backend on the same wire bytes: identical flushed names and values.
+    Percentile names are compared by value too — identical arrival order
+    per key means identical digest folds on one host."""
+    from veneur_tpu.server.native_aggregator import (
+        NativeAggregator, NativeShardedAggregator)
+
+    spec = TableSpec(counter_capacity=64, gauge_capacity=64,
+                     status_capacity=16, set_capacity=16,
+                     histo_capacity=64)
+    bspec = BatchSpec(counter=128, gauge=64, status=16, set=64, histo=128)
+    single = NativeAggregator(spec, bspec)
+    shard = NativeShardedAggregator(spec, bspec, n_shards=2)
+
+    for wave in _parity_waves():
+        for agg in (single, shard):
+            agg.feed(wave)
+            agg._emit_native()
+
+    got_s = _flush_names(single)
+    got_h = _flush_names(shard)
+    assert set(got_s) == set(got_h), set(got_s) ^ set(got_h)
+    for name in got_s:
+        if "percentile" in name:
+            assert got_h[name] == pytest.approx(got_s[name]), name
+        else:
+            assert got_h[name] == got_s[name], name
+
+
+def test_packed_sentinel_tail_invariant_after_partial_emit():
+    """vt_emit_packed's incremental sentinel contract: after a big emit
+    then a small emit into the SAME buffer, every row past the new count
+    in the six C++-maintained lanes (slot lanes, counter_inc, histo_wt)
+    is back at its sentinel — only rows the previous emit dirtied are
+    rewritten, value-lane tails stay stale by design (the in-kernel
+    sentinel scatter drops them)."""
+    from veneur_tpu.aggregation.step import packed_layout
+
+    spec, agg = _small_native_agg()
+    eng = agg.eng
+    layout, _words = packed_layout(agg._pk_sizes)
+    flat = agg._pk_bufs[0]
+    prev = agg._pk_prev[0]
+
+    for i in range(40):
+        eng.feed(b"t.c%d:1|c" % i)
+    for i in range(10):
+        eng.feed(b"t.g%d:2|g" % i)
+        eng.feed(b"t.h%d:3|ms" % i)
+        eng.feed(b"t.s%d:u%d|s" % (i, i))
+    counts = eng.emit_packed(flat, agg._pk_offs, prev)
+    assert counts == (40, 10, 10, 10)
+    assert tuple(prev) == counts      # updated in place for next emit
+
+    eng.feed(b"t.zz:5|c")
+    counts = eng.emit_packed(flat, agg._pk_offs, prev)
+    assert counts == (1, 0, 0, 0)
+    assert tuple(prev) == counts
+
+    def lane(name, f32=False):
+        off, n, _w = layout[name]
+        v = flat[off:off + n]
+        return v.view(np.float32) if f32 else v
+
+    # staged row 0 is live, rows [1:40) were dirtied last emit and must
+    # be sentinel again; rows [40:] were never touched
+    assert lane("counter_slot")[0] != spec.counter_capacity
+    assert lane("counter_inc", f32=True)[0] == 5.0
+    assert (lane("counter_slot")[1:] == spec.counter_capacity).all()
+    assert (lane("counter_inc", f32=True)[1:] == 0.0).all()
+    for name, cap in (("gauge_slot", spec.gauge_capacity),
+                      ("set_slot", spec.set_capacity),
+                      ("histo_slot", spec.histo_capacity)):
+        assert (lane(name) == cap).all(), name
+    assert (lane("histo_wt", f32=True) == 0.0).all()
+    # Python-owned constant regions never touched by C++
+    assert (lane("status_slot") == spec.status_capacity).all()
+    assert (lane("histo_stat_slot") == spec.histo_capacity).all()
+    assert (lane("histo_stat_min", f32=True) == np.inf).all()
+    assert (lane("histo_stat_max", f32=True) == -np.inf).all()
+
+
+def test_native_admission_shed_accounting_exact():
+    """In-engine admission (tentpole (c)): with the ring forced to
+    SHEDDING, per-class admitted/shed counts drained from C++ are exact
+    against what was sent, drain-and-reset is exact-once, and
+    fold_native_counts lands them in the controller's own counters —
+    sent == admitted + shed with no Python in the datagram path."""
+    import socket
+    import time as _time
+
+    from veneur_tpu.reliability.overload import OverloadController
+
+    _spec, agg = _small_native_agg()
+    rx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    rx.bind(("127.0.0.1", 0))
+    tx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    tx.connect(rx.getsockname())
+    try:
+        agg.readers_start([rx.fileno()], max_len=4097)
+        agg.admission_set(True, 2, 0.0, 0.0, ("veneur.priority:high",))
+        for _ in range(5):
+            tx.send(b"veneur.self.x:1|c")                    # self class
+        for _ in range(7):
+            tx.send(b"app.h:1|c|#veneur.priority:high")      # high class
+        for _ in range(9):
+            tx.send(b"app.l:1|c")                            # low class
+        deadline = _time.monotonic() + 10
+        while (agg.reader_counters()["datagrams"] < 21
+               and _time.monotonic() < deadline):
+            _time.sleep(0.005)
+        rc = agg.reader_counters()
+        assert rc["datagrams"] == 21 and rc["toolong"] == 0
+
+        d = agg.admission_drain()
+        assert d["admitted"] == {"self": 5, "high": 7}
+        assert d["shed"] == {"low": 9}
+        d2 = agg.admission_drain()                 # exact-once drain
+        assert d2 == {"admitted": {}, "shed": {}}
+
+        # shed datagrams never reached the ring; admitted ones did
+        agg.pump(50)
+        assert agg.processed == 12
+
+        ov = OverloadController(signals=lambda: {})
+        ov.fold_native_counts(d)
+        assert ov.admitted == {"self": 5, "high": 7}
+        assert ov.shed == {"low": 9}
+        assert sum(ov.admitted.values()) + sum(ov.shed.values()) == 21
+    finally:
+        agg.readers_stop()
+        rx.close()
+        tx.close()
+
+
+def test_hot_path_alloc_lint_passes():
+    """The per-batch hot path stays allocation-free (no .copy() /
+    np.empty / np.concatenate creeping back into the packed feed)."""
+    import pathlib
+    import subprocess
+    import sys
+
+    script = (pathlib.Path(__file__).resolve().parent.parent
+              / "scripts" / "check_hot_path_alloc.py")
+    proc = subprocess.run([sys.executable, str(script)],
+                          capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -236,24 +236,54 @@ def batch_sizes(batch: Batch) -> tuple:
     return tuple(0 if a is None else int(a.size) for a in batch)
 
 
-def pack_batch(batch: Batch, do_compact: bool = False):
+def packed_layout(sizes: tuple):
+    """Word layout of the pack_batch buffer for the given lane sizes:
+    ({lane_name: (word_off, n, words)}, total_words). Word 0 is the
+    control word; lanes follow in Batch._fields order, u8 lanes padded
+    to word multiples, 0-size (None) lanes absent. This is the one
+    definition of the wire<->device layout — pack_batch writes it, the
+    native engine's vt_emit_packed is handed these offsets, and
+    unpack_batch walks the same order inside jit."""
+    layout = {}
+    off = 1
+    for name, n in zip(Batch._fields, sizes):
+        if n == 0:
+            continue
+        words = (n + 3) // 4 if name in _U8_LANES else n
+        layout[name] = (off, n, words)
+        off += words
+    return layout, off
+
+
+def pack_batch(batch: Batch, do_compact: bool = False, out=None):
     """Host side: one contiguous i32 buffer holding every lane (f32 lanes
     bit-viewed, u8 lanes padded to word multiples, None lanes skipped),
     preceded by one control word (the in-band compact flag — a separate
-    scalar argument would be a second transfer). Pure numpy; ~microseconds
-    next to the transfer it replaces."""
+    scalar argument would be a second transfer). Each lane is written
+    straight into its packed_layout slice — no intermediate parts list or
+    concatenation — so hot-path callers pass a persistent zero-initialized
+    `out` (aggregator.py double-buffers two; sharded packs into rows of
+    one [1, S, W] array) and the pack costs one pass with zero
+    allocations. Without `out` a fresh zeroed buffer is returned. A
+    reused `out` must have been zero-initialized once at allocation: u8
+    pad bytes are never rewritten, and every non-pad word is overwritten
+    on every pack, so the buffer stays bit-identical to a fresh pack."""
     import numpy as np
-    parts = [np.asarray([1 if do_compact else 0], np.int32)]
+    layout, words = packed_layout(batch_sizes(batch))
+    if out is None:
+        out = np.zeros(words, np.int32)
+    out[0] = 1 if do_compact else 0
     for name, a in zip(Batch._fields, batch):
         if a is None:
             continue
-        a = np.ascontiguousarray(a)
-        if a.dtype == np.uint8:
-            pad = (-a.size) % 4
-            if pad:
-                a = np.concatenate([a, np.zeros(pad, np.uint8)])
-        parts.append(a.view(np.int32).ravel())
-    return np.concatenate(parts)
+        off, n, w = layout[name]
+        if name in _U8_LANES:
+            out[off:off + w].view(np.uint8)[:n] = a
+        elif name in _F32_LANES:
+            out[off:off + n].view(np.float32)[:] = a
+        else:
+            out[off:off + n] = a
+    return out
 
 
 def unpack_batch(flat, sizes: tuple) -> Batch:

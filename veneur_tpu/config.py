@@ -163,6 +163,10 @@ class Config:
     shed_priority_tags: List[str] = dataclasses.field(
         default_factory=list)          # substrings shed LAST (e.g.
     #                                    "veneur.priority:high")
+    overload_native_admission: bool = True  # run statsd admission inside
+    #                                    the C++ reader ring (off = prior
+    #                                    Python-side behavior: the native
+    #                                    path bypasses admission)
 
     # TCP statsd hardening: connection cap + per-connection idle
     # deadline (a slowloris peer must not pin reader threads forever).

@@ -57,10 +57,15 @@ def _build_and_load():
         lib.vt_free.argtypes = [ctypes.c_void_p]
         lib.vt_feed.restype = ctypes.c_int
         lib.vt_feed.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
-                                ctypes.c_int,
+                                ctypes.c_int, ctypes.c_int,
                                 ctypes.POINTER(ctypes.c_int)]
         lib.vt_emit.argtypes = [ctypes.c_void_p] + \
             [ctypes.c_void_p] * 10 + [ctypes.POINTER(ctypes.c_uint32)]
+        lib.vt_emit_packed.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_uint32),
+            ctypes.POINTER(ctypes.c_uint32)]
         lib.vt_pending.restype = ctypes.c_int
         lib.vt_pending.argtypes = [ctypes.c_void_p]
         lib.vt_new_keys.restype = ctypes.c_int
@@ -86,6 +91,11 @@ def _build_and_load():
                                 ctypes.POINTER(ctypes.c_uint64)]
         lib.vr_counters.argtypes = [ctypes.c_void_p,
                                     ctypes.POINTER(ctypes.c_uint64)]
+        lib.vr_admission_set.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_double,
+            ctypes.c_double, ctypes.c_char_p, ctypes.c_int]
+        lib.vr_admission_counters.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64)]
         lib.vr_stop.argtypes = [ctypes.c_void_p]
         lib.vt_hash64_batch.argtypes = [
             ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
@@ -160,17 +170,18 @@ class NativeIngest:
             _lib.vt_free(h)
             self._h = None
 
-    def feed(self, data: bytes) -> bool:
-        """Parse a packet buffer; returns True if a staging area filled and
-        emit() should run (remaining bytes are auto-refed after emit by the
-        caller loop in NativeAggregator)."""
+    def feed(self, data: bytes, start: int = 0) -> tuple:
+        """Parse a packet buffer from byte offset `start`. Returns
+        (full, consumed): full means a staging area filled and emit()
+        should run; consumed is the absolute offset of the first
+        unhandled byte — resume with feed(data, consumed) after emitting.
+        The same bytes object is passed back unsliced, so a lane-full
+        stop never copies a multi-KB remainder (same offset model as
+        import_metriclist)."""
         consumed = ctypes.c_int(0)
-        self._pending_tail = b""
-        rc = _lib.vt_feed(self._h, data, len(data), ctypes.byref(consumed))
-        if rc:
-            self._pending_tail = data[consumed.value:]
-            return True
-        return False
+        rc = _lib.vt_feed(self._h, data, len(data), start,
+                          ctypes.byref(consumed))
+        return bool(rc), consumed.value
 
     def emit_into(self, batcher_arrays) -> tuple:
         """Copy staged samples into numpy arrays. batcher_arrays is the
@@ -179,6 +190,24 @@ class NativeIngest:
         counts = (ctypes.c_uint32 * 4)()
         ptrs = [a.ctypes.data_as(ctypes.c_void_p) for a in batcher_arrays]
         _lib.vt_emit(self._h, *ptrs, counts)
+        return tuple(counts)
+
+    def emit_packed(self, flat: "np.ndarray", lane_offs: "np.ndarray",
+                    prev_counts: "np.ndarray") -> tuple:
+        """Zero-copy emit into a caller-owned flat i32 buffer laid out
+        exactly like aggregation/step.py pack_batch. `lane_offs` is the
+        int32[10] word offsets of the ten native lanes in that layout;
+        `prev_counts` is this buffer's uint32[4] counts from ITS previous
+        emit (updated in place — the engine re-sentinels only the rows the
+        previous emit dirtied past the new counts). Returns (nc, ng, ns,
+        nh) and resets staging."""
+        counts = (ctypes.c_uint32 * 4)()
+        _lib.vt_emit_packed(
+            self._h,
+            flat.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            lane_offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            prev_counts.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            counts)
         return tuple(counts)
 
     def pending(self) -> int:
@@ -332,6 +361,34 @@ class NativeIngest:
         _lib.vr_counters(r, out)
         return {"datagrams": out[0], "ring_dropped": out[1],
                 "ring_depth": out[2], "toolong": out[3]}
+
+    def admission_set(self, enabled: bool, state: int, rate: float,
+                      burst: float, high_tags) -> None:
+        """Push the OverloadController's statsd admission knobs into the
+        reader ring (called from the controller poll thread). high_tags is
+        an iterable of shed_priority_tags strings."""
+        r = getattr(self, "_readers", None)
+        if not r:
+            return
+        joined = "\n".join(high_tags).encode("utf-8", "surrogateescape")
+        _lib.vr_admission_set(r, 1 if enabled else 0, int(state),
+                              float(rate), float(burst), joined,
+                              len(joined))
+
+    def admission_drain(self) -> dict:
+        """Drain-and-reset exact per-class ring admission deltas:
+        {"admitted": {class: n}, "shed": {class: n}} with zero entries
+        omitted (classes: self/high/low, mirroring PriorityClassifier)."""
+        r = getattr(self, "_readers", None)
+        if not r:
+            return {"admitted": {}, "shed": {}}
+        out = (ctypes.c_uint64 * 6)()
+        _lib.vr_admission_counters(r, out)
+        names = ("self", "high", "low")
+        return {
+            "admitted": {names[i]: out[i] for i in range(3) if out[i]},
+            "shed": {names[i]: out[3 + i] for i in range(3) if out[3 + i]},
+        }
 
     def readers_stop(self) -> None:
         r = getattr(self, "_readers", None)

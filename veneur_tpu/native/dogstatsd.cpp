@@ -460,12 +460,15 @@ void* vt_new(uint32_t counter_cap, uint32_t gauge_cap, uint32_t set_cap,
 
 void vt_free(void* h) { delete (Parser*)h; }
 
-// Feed a newline-separated packet buffer. Stops early if a staging area
-// fills; *consumed reports how many input bytes were handled. Returns 1 if
+// Feed a newline-separated packet buffer starting at byte `start` (so a
+// caller resuming after a full-lane stop passes the same buffer back with
+// the previous *consumed — no remainder slice/copy, mirroring vi_import's
+// offset). Stops early if a staging area fills; *consumed reports the
+// absolute offset of the first unhandled byte. Returns 1 if
 // stopped-for-full, else 0.
-int vt_feed(void* hp, const char* data, int len, int* consumed) {
+int vt_feed(void* hp, const char* data, int len, int start, int* consumed) {
   auto* p = (Parser*)hp;
-  int off = 0;
+  int off = start < 0 ? 0 : start;
   while (off < len) {
     if (p->any_full()) {
       *consumed = off;
@@ -502,6 +505,61 @@ void vt_emit(void* hp, int32_t* c_slot, float* c_inc, int32_t* g_slot,
   counts_out[1] = p->ng;
   counts_out[2] = p->ns;
   counts_out[3] = p->nh;
+  p->nc = p->ng = p->ns = p->nh = 0;
+}
+
+// Zero-copy emit: write staged lanes straight into a caller-owned flat
+// i32 buffer laid out exactly like aggregation/step.py pack_batch (word 0
+// is the control word, then lanes in Batch._fields order; f32 lanes bit-
+// cast, set_rho as packed bytes). `off` gives the word offset of each of
+// the ten native lanes in that buffer (c_slot, c_inc, g_slot, g_val,
+// s_slot, s_reg, s_rho, h_slot, h_val, h_wt — Python computes these once
+// since it alone knows the status/histo_stat lane sizes interleaved
+// between them; those regions are Python-initialized constants we never
+// touch). Sentinel tails are maintained INCREMENTALLY: `prev` carries the
+// row counts this buffer held after ITS previous emit (in/out, [4]), and
+// only rows [n_new, prev_n) are re-sentineled — the rest of the buffer is
+// already in the padded state Batcher.emit would have produced, so the
+// flat bytes stay byte-identical to pack_batch(batch) of the old copy
+// path (including harmlessly-stale value-lane rows past the counts,
+// which the slot sentinels make the scatter drop — same contract as
+// aggregation/host.py Batcher.emit's partial reset). counts_out: [nc,
+// ng, ns, nh]; staging is reset like vt_emit.
+void vt_emit_packed(void* hp, int32_t* buf, const int32_t* off,
+                    uint32_t* prev, uint32_t* counts_out) {
+  auto* p = (Parser*)hp;
+  int32_t* c_slot = buf + off[0];
+  float*   c_inc  = (float*)(buf + off[1]);
+  int32_t* g_slot = buf + off[2];
+  float*   g_val  = (float*)(buf + off[3]);
+  int32_t* s_slot = buf + off[4];
+  int32_t* s_reg  = buf + off[5];
+  uint8_t* s_rho  = (uint8_t*)(buf + off[6]);
+  int32_t* h_slot = buf + off[7];
+  float*   h_val  = (float*)(buf + off[8]);
+  float*   h_wt   = (float*)(buf + off[9]);
+  const int32_t c_cap = (int32_t)p->counters.capacity;
+  const int32_t g_cap = (int32_t)p->gauges.capacity;
+  const int32_t s_cap = (int32_t)p->sets.capacity;
+  const int32_t h_cap = (int32_t)p->histos.capacity;
+  for (uint32_t i = p->nc; i < prev[0]; i++) { c_slot[i] = c_cap; c_inc[i] = 0.0f; }
+  for (uint32_t i = p->ng; i < prev[1]; i++) g_slot[i] = g_cap;
+  for (uint32_t i = p->ns; i < prev[2]; i++) s_slot[i] = s_cap;
+  for (uint32_t i = p->nh; i < prev[3]; i++) { h_slot[i] = h_cap; h_wt[i] = 0.0f; }
+  memcpy(c_slot, p->c_slot.data(), p->nc * sizeof(int32_t));
+  memcpy(c_inc, p->c_inc.data(), p->nc * sizeof(float));
+  memcpy(g_slot, p->g_slot.data(), p->ng * sizeof(int32_t));
+  memcpy(g_val, p->g_val.data(), p->ng * sizeof(float));
+  memcpy(s_slot, p->s_slot.data(), p->ns * sizeof(int32_t));
+  memcpy(s_reg, p->s_reg.data(), p->ns * sizeof(int32_t));
+  memcpy(s_rho, p->s_rho.data(), p->ns * sizeof(uint8_t));
+  memcpy(h_slot, p->h_slot.data(), p->nh * sizeof(int32_t));
+  memcpy(h_val, p->h_val.data(), p->nh * sizeof(float));
+  memcpy(h_wt, p->h_wt.data(), p->nh * sizeof(float));
+  counts_out[0] = p->nc; prev[0] = p->nc;
+  counts_out[1] = p->ng; prev[1] = p->ng;
+  counts_out[2] = p->ns; prev[2] = p->ns;
+  counts_out[3] = p->nh; prev[3] = p->nh;
   p->nc = p->ng = p->ns = p->nh = 0;
 }
 
@@ -992,6 +1050,27 @@ int vi_stats(void* hp, int32_t* slot, float* mn, float* mx, float* recip,
 
 namespace {
 
+// In-ring admission control: the OverloadController's statsd-source
+// admission decision (reliability/overload.py OverloadController.admit)
+// replicated at the ring boundary so the native path honors the same
+// shedding guarantees as _process_packets instead of bypassing them.
+// State is pushed down on every controller poll (vr_admission_set) and
+// exact per-class counts are drained back (vr_admission_counters), so
+// sent == admitted + shed stays exact with the decision running off-GIL.
+struct Admission {
+  bool enabled = false;
+  int state = 0;                      // 0 HEALTHY .. 3 CRITICAL
+  double rate = 0.0, burst = 0.0;     // token bucket params (rate<=0: allow)
+  std::vector<std::string> high_tags; // shed_priority_tags substrings
+  // token buckets: [0] = "statsd" (low), [1] = "statsd/high"
+  double tokens[2] = {0.0, 0.0};
+  std::chrono::steady_clock::time_point last[2];
+  bool primed = false;
+  // exact per-class accounting: [self, high, low]
+  uint64_t admitted[3] = {0, 0, 0};
+  uint64_t shed[3] = {0, 0, 0};
+};
+
 struct ReaderGroup {
   void* parser = nullptr;
   std::vector<std::thread> threads;
@@ -1004,10 +1083,74 @@ struct ReaderGroup {
   uint64_t ring_dropped = 0;      // guarded by mu
   uint64_t datagrams = 0;         // guarded by mu
   uint64_t toolong = 0;           // guarded by mu; MSG_TRUNC drops
-  // unconsumed remainder of a datagram whose parse hit a full lane
+  Admission adm;                  // guarded by mu
+  // datagram whose parse hit a full lane, parked whole with a resume
+  // offset (no remainder copy)
   std::string tail;
   size_t tail_off = 0;
 };
+
+// Priority classes mirror reliability/overload.py PriorityClassifier:
+// self-metrics (never shed) / high (shed last) / low.
+enum { CLS_SELF = 0, CLS_HIGH = 1, CLS_LOW = 2 };
+
+int classify_datagram(const Admission& a, const char* p, size_t n) {
+  static const char kSelf1[] = "veneur.";
+  static const char kSelf2[] = "veneur_tpu.";
+  if ((n >= sizeof(kSelf1) - 1 && !memcmp(p, kSelf1, sizeof(kSelf1) - 1)) ||
+      (n >= sizeof(kSelf2) - 1 && !memcmp(p, kSelf2, sizeof(kSelf2) - 1)))
+    return CLS_SELF;
+  for (const auto& tag : a.high_tags) {
+    if (tag.empty() || tag.size() > n) continue;
+    if (memmem(p, n, tag.data(), tag.size()) != nullptr) return CLS_HIGH;
+  }
+  return CLS_LOW;
+}
+
+// TokenBucket.allow (overload.py:63-84) under the ring mutex. rate<=0
+// means the bucket is disabled (always admit), matching _bucket_allow.
+bool bucket_allow(Admission& a, int which,
+                  std::chrono::steady_clock::time_point now) {
+  if (a.rate <= 0.0) return true;
+  double burst = a.burst > 0.0 ? a.burst : a.rate;
+  if (!a.primed) {
+    a.tokens[0] = a.tokens[1] = burst;
+    a.last[0] = a.last[1] = now;
+    a.primed = true;
+  }
+  double dt = std::chrono::duration<double>(now - a.last[which]).count();
+  a.last[which] = now;
+  double t = a.tokens[which] + dt * a.rate;
+  if (t > burst) t = burst;
+  if (t >= 1.0) {
+    a.tokens[which] = t - 1.0;
+    return true;
+  }
+  a.tokens[which] = t;
+  return false;
+}
+
+// OverloadController.admit for source="statsd", states per overload.py:
+// HEALTHY(0) admits all; self never shed; high-priority admits until
+// CRITICAL(3) then runs the "statsd/high" bucket; low is shed outright
+// at SHEDDING(2)+ and bucketed at PRESSURED(1). Returns true to admit;
+// counts either way.
+bool admit_datagram(Admission& a, const char* p, size_t n,
+                    std::chrono::steady_clock::time_point now) {
+  int cls = classify_datagram(a, p, n);
+  bool ok;
+  if (a.state <= 0 || cls == CLS_SELF) {
+    ok = true;
+  } else if (cls == CLS_HIGH) {
+    ok = a.state < 3 || bucket_allow(a, 1, now);
+  } else if (a.state >= 2) {
+    ok = false;
+  } else {
+    ok = bucket_allow(a, 0, now);
+  }
+  if (ok) a.admitted[cls]++; else a.shed[cls]++;
+  return ok;
+}
 
 void reader_main(ReaderGroup* g, int fd, int max_len) {
   constexpr int VLEN = 64;
@@ -1055,6 +1198,15 @@ void reader_main(ReaderGroup* g, int fd, int max_len) {
           g->toolong++;
           continue;
         }
+        // admission runs here — before the ring, off the GIL — so a shed
+        // datagram costs one classify, not a parse + Python round-trip.
+        // Every under-limit datagram is counted exactly once as admitted
+        // or shed (ring-full drops below are post-admission and counted
+        // separately), preserving sent == admitted + shed.
+        if (g->adm.enabled &&
+            !admit_datagram(g->adm, bufs[i].data(), (size_t)msgs[i].msg_len,
+                            std::chrono::steady_clock::now()))
+          continue;
         if (g->ring.size() >= g->ring_cap) {
           g->ring_dropped++;  // kernel-rcvbuf-overflow analogue, counted
           continue;
@@ -1100,9 +1252,9 @@ int vr_pump(void* gp, int max_wait_ms, uint64_t* out) {
   int full = 0;
   int consumed = 0;
   if (g->tail_off < g->tail.size()) {
-    full = vt_feed(g->parser, g->tail.data() + g->tail_off,
-                   (int)(g->tail.size() - g->tail_off), &consumed);
-    g->tail_off += (size_t)consumed;
+    full = vt_feed(g->parser, g->tail.data(), (int)g->tail.size(),
+                   (int)g->tail_off, &consumed);
+    g->tail_off = (size_t)consumed;
     if (!full) {
       g->tail.clear();
       g->tail_off = 0;
@@ -1119,12 +1271,11 @@ int vr_pump(void* gp, int max_wait_ms, uint64_t* out) {
       g->ring.pop_front();
     }
     parsed_dg++;
-    size_t off = 0;
-    full = vt_feed(g->parser, local.data(), (int)local.size(), &consumed);
-    off = (size_t)consumed;
+    full = vt_feed(g->parser, local.data(), (int)local.size(), 0, &consumed);
     if (full) {
-      g->tail.assign(local.data() + off, local.size() - off);
-      g->tail_off = 0;
+      // park the whole datagram with a resume offset — no remainder copy
+      g->tail = std::move(local);
+      g->tail_off = (size_t)consumed;
     }
   }
   {
@@ -1135,6 +1286,45 @@ int vr_pump(void* gp, int max_wait_ms, uint64_t* out) {
   }
   out[0] = parsed_dg;
   return full;
+}
+
+// Push the OverloadController's current admission knobs down into the
+// ring (called from the controller's poll thread and at reader start).
+// `tags` is a '\n'-joined shed_priority_tags list (tags_len bytes; may be
+// empty). Rate/burst changes re-prime the buckets on the next decision.
+void vr_admission_set(void* gp, int enabled, int state, double rate,
+                      double burst, const char* tags, int tags_len) {
+  auto* g = (ReaderGroup*)gp;
+  std::lock_guard<std::mutex> lk(g->mu);
+  Admission& a = g->adm;
+  if (a.rate != rate || a.burst != burst) a.primed = false;
+  a.enabled = enabled != 0;
+  a.state = state;
+  a.rate = rate;
+  a.burst = burst;
+  a.high_tags.clear();
+  const char* p = tags;
+  const char* end = tags + (tags_len > 0 ? tags_len : 0);
+  while (p && p < end) {
+    const char* nl = (const char*)memchr(p, '\n', (size_t)(end - p));
+    size_t n = nl ? (size_t)(nl - p) : (size_t)(end - p);
+    if (n) a.high_tags.emplace_back(p, n);
+    p += n + 1;
+  }
+}
+
+// Drain-and-reset the exact per-class admission deltas so the controller
+// can fold them into its registry counters: out = [admitted_self,
+// admitted_high, admitted_low, shed_self, shed_high, shed_low].
+void vr_admission_counters(void* gp, uint64_t* out) {
+  auto* g = (ReaderGroup*)gp;
+  std::lock_guard<std::mutex> lk(g->mu);
+  for (int i = 0; i < 3; i++) {
+    out[i] = g->adm.admitted[i];
+    out[3 + i] = g->adm.shed[i];
+    g->adm.admitted[i] = 0;
+    g->adm.shed[i] = 0;
+  }
 }
 
 // Thread-safe counter snapshot (any thread): [0]=datagrams received,

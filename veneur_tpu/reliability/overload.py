@@ -294,6 +294,30 @@ class OverloadController:
         with self._lock:
             return [((cls,), n) for cls, n in sorted(self.shed.items())]
 
+    # -- native-ring admission push-down / fold-back -------------------------
+    def native_admission_params(self) -> tuple:
+        """(state, admit_rate, admit_burst, high_tags) snapshot for
+        push-down into the C++ reader ring (vr_admission_set), which
+        replicates admit(source='statsd') off-GIL at the ring boundary.
+        Pushed on every poll so state transitions reach the ring within
+        one poll interval."""
+        tags = tuple(t.decode("utf-8", "surrogateescape")
+                     for t in self.classifier._high)
+        return self.state, self.admit_rate, self.admit_burst, tags
+
+    def fold_native_counts(self, drained: dict) -> None:
+        """Fold the exact per-class admitted/shed deltas drained from the
+        C++ reader ring (vr_admission_counters drain-and-reset) into the
+        same counters admit() feeds, preserving sent == admitted + shed
+        exactly across both admission sites."""
+        with self._lock:
+            for cls, n in drained.get("admitted", {}).items():
+                if n:
+                    self.admitted[cls] = self.admitted.get(cls, 0) + int(n)
+            for cls, n in drained.get("shed", {}).items():
+                if n:
+                    self.shed[cls] = self.shed.get(cls, 0) + int(n)
+
     # -- poller thread -------------------------------------------------------
     def start(self, poll_interval: float,
               on_poll: Optional[Callable[["OverloadController"], None]]

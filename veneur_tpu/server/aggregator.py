@@ -62,6 +62,10 @@ class Aggregator:
         # step count — _steps resets every swap, steps_total never does
         self.step_ns = 0
         self.steps_total = 0
+        # persistent pack targets, two per lane-size signature: batch N+1
+        # packs into one buffer while batch N's h2d + donated step is
+        # still in flight against the other (pack_batch `out` contract)
+        self._pack_bufs: dict = {}
         self._init_degrade()
 
     def _init_degrade(self) -> None:
@@ -136,11 +140,23 @@ class Aggregator:
         # program via the control word (step.py pack_batch rationale)
         self._steps += 1
         self.steps_total += 1
-        flat = pack_batch(batch, self._steps % self.compact_every == 0)
+        sizes = batch_sizes(batch)
+        bufs = self._pack_bufs.get(sizes)
+        if bufs is None:
+            from veneur_tpu.aggregation.step import packed_layout
+            words = packed_layout(sizes)[1]
+            # [buf_a, buf_b, next_index]: allocated once per size
+            # signature, alternated every step (double buffering — the
+            # step dispatched last turn may still be reading its buffer)
+            bufs = self._pack_bufs[sizes] = [
+                np.zeros(words, np.int32), np.zeros(words, np.int32), 0]
+        flat = bufs[bufs[2]]
+        bufs[2] ^= 1
+        pack_batch(batch, self._steps % self.compact_every == 0, out=flat)
         self.h2d_bytes += flat.nbytes
         t0 = time.perf_counter_ns()
         self.state = ingest_step_packed(
-            self.state, flat, spec=self.spec, sizes=batch_sizes(batch))
+            self.state, flat, spec=self.spec, sizes=sizes)
         self.step_ns += time.perf_counter_ns() - t0
 
     def process_metric(self, m: UDPMetric) -> None:

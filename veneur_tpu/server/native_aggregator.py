@@ -136,74 +136,105 @@ class NativeAggregator(Aggregator):
         super().__init__(spec, bspec, n_shards, compact_every)
         self.eng = NativeIngest(spec, bspec, n_shards)
         self.table = NativeKeyTable(spec, self.eng, n_shards)
-        self._alloc_emit_buffers()
+        self._alloc_packed_buffers()
 
-    def _alloc_emit_buffers(self):
+    def _alloc_packed_buffers(self):
+        """Two flat i32 host buffers in the exact pack_batch device layout,
+        plus the lane word-offsets vt_emit_packed writes at. The native
+        emit is zero-copy: C++ writes staged rows straight into one of
+        these (double-buffered — the engine stages batch N+1 while batch
+        N's h2d + donated step is in flight) and the buffer goes to
+        ingest_step_packed as-is; no Batch pytree, no per-lane copies, no
+        Python repack. All 16 lanes are present at the Python Batcher's
+        sizes so the compile key (spec, sizes) matches the Python path
+        and ONE compiled ingest program serves both — the status and
+        histo_stat lanes never ride the native wire path and stay
+        Python-initialized constant sentinel regions that C++ never
+        touches."""
+        from veneur_tpu.aggregation.step import packed_layout
         b, spec = self.bspec, self.spec
-        self._c_slot = np.empty(b.counter, np.int32)
-        self._c_inc = np.zeros(b.counter, np.float32)
-        self._g_slot = np.empty(b.gauge, np.int32)
-        self._g_val = np.zeros(b.gauge, np.float32)
-        self._s_slot = np.empty(b.set, np.int32)
-        self._s_reg = np.zeros(b.set, np.int32)
-        self._s_rho = np.zeros(b.set, np.uint8)
-        self._h_slot = np.empty(b.histo, np.int32)
-        self._h_val = np.zeros(b.histo, np.float32)
-        self._h_wt = np.zeros(b.histo, np.float32)
-        # status / imported-digest stats never ride the native path;
-        # constant empty lanes keep the Batch pytree STRUCTURALLY
-        # identical to the Python Batcher's (host.py emit), so one
-        # compiled ingest program serves both — a native-only batch
-        # shape would force a second multi-second XLA compile the first
-        # time a Python-path sample (self-telemetry, import, service
-        # check) flushes
-        self._st_slot = np.full(b.status, spec.status_capacity, np.int32)
-        self._st_val = np.zeros(b.status, np.float32)
-        self._hs_slot = np.full(b.histo_stat, spec.histo_capacity, np.int32)
-        self._hs_min = np.full(b.histo_stat, np.inf, np.float32)
-        self._hs_max = np.full(b.histo_stat, -np.inf, np.float32)
-        self._hs_recip = np.zeros(b.histo_stat, np.float32)
+        # lane sizes in Batch._fields order — identical to batch_sizes()
+        # of a Python Batcher emit, which is what keys the compiled step
+        sizes = (b.counter, b.counter, b.gauge, b.gauge,
+                 b.status, b.status, b.set, b.set, b.set,
+                 b.histo, b.histo, b.histo,
+                 b.histo_stat, b.histo_stat, b.histo_stat, b.histo_stat)
+        layout, words = packed_layout(sizes)
+        self._pk_sizes = sizes
+        # the ten lanes the C++ engine stages, in vt_emit_packed's
+        # argument order; the interleaved status/histo_stat lane offsets
+        # stay Python-owned
+        self._pk_offs = np.asarray(
+            [layout[name][0] for name in (
+                "counter_slot", "counter_inc", "gauge_slot", "gauge_val",
+                "set_slot", "set_reg", "set_rho", "histo_slot",
+                "histo_val", "histo_wt")], np.int32)
+        self._pk_bufs = []
+        self._pk_prev = []
+        for _ in range(2):
+            flat = np.zeros(words, np.int32)
+            self._init_packed_sentinels(flat, layout, spec)
+            self._pk_bufs.append(flat)
+            # per-buffer staged-row counts from that buffer's previous
+            # emit — vt_emit_packed's incremental sentinel-restore bound
+            self._pk_prev.append(np.zeros(4, np.uint32))
+        self._pk_idx = 0
+
+    @staticmethod
+    def _init_packed_sentinels(flat, layout, spec):
+        """One-time sentinel fill of a fresh packed buffer: every slot
+        lane at its table capacity (scatter mode='drop' padding), weight
+        lanes 0, histo-stat min/max at +/-inf — the state Batcher.emit's
+        partial reset maintains on the Python path. After this, the six
+        C++-maintained lanes are kept in this state incrementally by
+        vt_emit_packed and the status/histo_stat regions are never
+        written again."""
+
+        def lane(name, value, f32=False):
+            off, n, _ = layout[name]
+            view = flat[off:off + n]
+            (view.view(np.float32) if f32 else view)[:] = value
+
+        lane("counter_slot", spec.counter_capacity)
+        lane("gauge_slot", spec.gauge_capacity)
+        lane("set_slot", spec.set_capacity)
+        lane("histo_slot", spec.histo_capacity)
+        lane("status_slot", spec.status_capacity)
+        lane("histo_stat_slot", spec.histo_capacity)
+        lane("histo_stat_min", np.inf, f32=True)
+        lane("histo_stat_max", -np.inf, f32=True)
 
     # -- wire path -----------------------------------------------------------
     def feed(self, data: bytes) -> List[bytes]:
         """Parse a packet buffer natively; returns escalated event/service-
-        check lines for the caller to handle via the Python parser."""
-        full = self.eng.feed(data)
+        check lines for the caller to handle via the Python parser. A
+        lane-full stop resumes at the consumed offset — the buffer is
+        never re-sliced (NativeIngest.feed offset contract)."""
+        full, off = self.eng.feed(data)
         while full:
             self._emit_native()
-            tail = self.eng._pending_tail
-            if not tail:
-                break
-            full = self.eng.feed(tail)
+            full, off = self.eng.feed(data, off)
         return self.eng.drain_specials()
 
     def _emit_native(self):
-        from veneur_tpu.aggregation.step import Batch
-        spec = self.spec
-        self._c_slot.fill(spec.counter_capacity)
-        self._g_slot.fill(spec.gauge_capacity)
-        self._s_slot.fill(spec.set_capacity)
-        self._h_slot.fill(spec.histo_capacity)
-        self._h_wt.fill(0.0)
-        self._c_inc.fill(0.0)
-        nc, ng, ns, nh = self.eng.emit_into(
-            (self._c_slot, self._c_inc, self._g_slot, self._g_val,
-             self._s_slot, self._s_reg, self._s_rho, self._h_slot,
-             self._h_val, self._h_wt))
+        import time
+
+        from veneur_tpu.aggregation.step import ingest_step_packed
+        idx = self._pk_idx
+        flat = self._pk_bufs[idx]
+        nc, ng, ns, nh = self.eng.emit_packed(flat, self._pk_offs,
+                                              self._pk_prev[idx])
         if nc + ng + ns + nh == 0:
             return
-        batch = Batch(
-            counter_slot=self._c_slot.copy(), counter_inc=self._c_inc.copy(),
-            gauge_slot=self._g_slot.copy(), gauge_val=self._g_val.copy(),
-            status_slot=self._st_slot, status_val=self._st_val,
-            set_slot=self._s_slot.copy(), set_reg=self._s_reg.copy(),
-            set_rho=self._s_rho.copy(),
-            histo_slot=self._h_slot.copy(), histo_val=self._h_val.copy(),
-            histo_wt=self._h_wt.copy(),
-            histo_stat_slot=self._hs_slot, histo_stat_min=self._hs_min,
-            histo_stat_max=self._hs_max, histo_stat_recip=self._hs_recip,
-        )
-        self._on_batch(batch)
+        self._pk_idx = 1 - idx
+        self._steps += 1
+        self.steps_total += 1
+        flat[0] = 1 if self._steps % self.compact_every == 0 else 0
+        self.h2d_bytes += flat.nbytes
+        t0 = time.perf_counter_ns()
+        self.state = ingest_step_packed(
+            self.state, flat, spec=self.spec, sizes=self._pk_sizes)
+        self.step_ns += time.perf_counter_ns() - t0
 
     def extra_parse_errors(self) -> int:
         return self.eng.stats()["parse_errors"]
@@ -283,6 +314,16 @@ class NativeAggregator(Aggregator):
     def reader_counters(self) -> dict:
         return self.eng.reader_counters()
 
+    def admission_set(self, enabled: bool, state: int, rate: float,
+                      burst: float, high_tags) -> None:
+        """Push OverloadController statsd-admission knobs into the C++
+        reader ring (tentpole (c): shedding runs in-engine, off-GIL)."""
+        self.eng.admission_set(enabled, state, rate, burst, high_tags)
+
+    def admission_drain(self) -> dict:
+        """Exact per-class {admitted, shed} deltas since the last drain."""
+        return self.eng.admission_drain()
+
     def readers_stop(self) -> None:
         self.eng.readers_stop()
 
@@ -340,8 +381,26 @@ class NativeShardedAggregator(ShardedAggregator):
         self.table = NativeKeyTable(spec, self.eng, n_shards)
         self._py_processed = 0
         self._py_dropped = 0
-        # reuse NativeAggregator's emit buffer layout
-        NativeAggregator._alloc_emit_buffers(self)
+        self._alloc_emit_buffers()
+
+    def _alloc_emit_buffers(self):
+        """Staging targets for emit_into — the sharded backend re-stages
+        emitted rows into per-shard Python Batchers (the per-shard packed
+        layout differs from the engine's global slot space), so it keeps
+        the array-based emit rather than the single backend's direct
+        packed emit. Only the ten native lanes are needed; slot lanes are
+        re-sentineled per emit below."""
+        b = self.bspec
+        self._c_slot = np.empty(b.counter, np.int32)
+        self._c_inc = np.zeros(b.counter, np.float32)
+        self._g_slot = np.empty(b.gauge, np.int32)
+        self._g_val = np.zeros(b.gauge, np.float32)
+        self._s_slot = np.empty(b.set, np.int32)
+        self._s_reg = np.zeros(b.set, np.int32)
+        self._s_rho = np.zeros(b.set, np.uint8)
+        self._h_slot = np.empty(b.histo, np.int32)
+        self._h_val = np.zeros(b.histo, np.float32)
+        self._h_wt = np.zeros(b.histo, np.float32)
 
     # engine-backed stats (same split as NativeAggregator)
     extra_parse_errors = NativeAggregator.extra_parse_errors
@@ -365,54 +424,64 @@ class NativeShardedAggregator(ShardedAggregator):
                       self._PER_SHARD_FIELD[KeyTable._table_name(kind)])
         return slot // per, slot % per
 
+    def _split_shards(self, global_slots, per_shard):
+        """One-pass shard split of a staged slot lane: a stable argsort
+        groups rows by shard (stability preserves arrival order within a
+        shard — gauge last-write-wins depends on it), searchsorted finds
+        the [start, end) bounds per shard. Replaces the per-shard
+        boolean-mask loop, which scanned the whole lane n_shards times.
+        Returns (order, local_slots_sorted, bounds)."""
+        sh = global_slots // per_shard
+        order = np.argsort(sh, kind="stable")
+        lo = (global_slots - sh * per_shard).astype(np.int32, copy=False)
+        bounds = np.searchsorted(sh, np.arange(self.n_shards + 1),
+                                 sorter=order)
+        return order, lo[order], bounds
+
     def _emit_native(self):
-        spec = self.spec
-        self._c_slot.fill(spec.counter_capacity)
-        self._g_slot.fill(spec.gauge_capacity)
-        self._s_slot.fill(spec.set_capacity)
-        self._h_slot.fill(spec.histo_capacity)
-        self._h_wt.fill(0.0)
-        self._c_inc.fill(0.0)
         nc, ng, ns, nh = self.eng.emit_into(
             (self._c_slot, self._c_inc, self._g_slot, self._g_val,
              self._s_slot, self._s_reg, self._s_rho, self._h_slot,
              self._h_val, self._h_wt))
         if nc + ng + ns + nh == 0:
             return
-
-        def split(global_slots, per_shard):
-            return (global_slots // per_shard).astype(np.int32), \
-                   (global_slots % per_shard).astype(np.int32)
-
         p = self.pspec
         if nc:
-            sh, lo = split(self._c_slot[:nc], p.counter_capacity)
+            order, lo, at = self._split_shards(self._c_slot[:nc],
+                                               p.counter_capacity)
+            inc = self._c_inc[:nc][order]
             for i in range(self.n_shards):
-                m = sh == i
-                if m.any():
+                if at[i + 1] > at[i]:
                     self.batchers[i].add_counters_bulk(
-                        lo[m], self._c_inc[:nc][m])
+                        lo[at[i]:at[i + 1]], inc[at[i]:at[i + 1]])
         if ng:
-            sh, lo = split(self._g_slot[:ng], p.gauge_capacity)
+            order, lo, at = self._split_shards(self._g_slot[:ng],
+                                               p.gauge_capacity)
+            val = self._g_val[:ng][order]
             for i in range(self.n_shards):
-                m = sh == i
-                if m.any():
+                if at[i + 1] > at[i]:
                     self.batchers[i].add_gauges_bulk(
-                        lo[m], self._g_val[:ng][m])
+                        lo[at[i]:at[i + 1]], val[at[i]:at[i + 1]])
         if ns:
-            sh, lo = split(self._s_slot[:ns], p.set_capacity)
+            order, lo, at = self._split_shards(self._s_slot[:ns],
+                                               p.set_capacity)
+            reg = self._s_reg[:ns][order]
+            rho = self._s_rho[:ns][order]
             for i in range(self.n_shards):
-                m = sh == i
-                if m.any():
+                if at[i + 1] > at[i]:
                     self.batchers[i].add_sets_bulk(
-                        lo[m], self._s_reg[:ns][m], self._s_rho[:ns][m])
+                        lo[at[i]:at[i + 1]], reg[at[i]:at[i + 1]],
+                        rho[at[i]:at[i + 1]])
         if nh:
-            sh, lo = split(self._h_slot[:nh], p.histo_capacity)
+            order, lo, at = self._split_shards(self._h_slot[:nh],
+                                               p.histo_capacity)
+            val = self._h_val[:nh][order]
+            wt = self._h_wt[:nh][order]
             for i in range(self.n_shards):
-                m = sh == i
-                if m.any():
+                if at[i + 1] > at[i]:
                     self.batchers[i].add_histos_bulk(
-                        lo[m], self._h_val[:nh][m], self._h_wt[:nh][m])
+                        lo[at[i]:at[i + 1]], val[at[i]:at[i + 1]],
+                        wt[at[i]:at[i + 1]])
 
     def swap(self):
         self._emit_native()

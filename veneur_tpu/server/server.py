@@ -758,7 +758,38 @@ class Server:
                                          / budget)
         except Exception as e:
             log.debug("overload signal checkpoint_age failed: %s", e)
+        try:
+            # native datagram ring: the packet_queue signal reads ~0 when
+            # UDP rides the C++ ring, so ring depth is the native path's
+            # queue-pressure analogue; any ring overflow since the last
+            # poll means datagrams are ALREADY being lost — saturate,
+            # same policy as capacity_drops
+            if self._native_readers_active:
+                rcs = self.aggregator.reader_counters()
+                sig["native_ring"] = rcs["ring_depth"] / 65536.0
+                drops = rcs["ring_dropped"]
+                prev = getattr(self, "_ov_prev_ring_drops", None)
+                self._ov_prev_ring_drops = drops
+                if prev is not None and drops > prev:
+                    sig["native_ring"] = 1.0
+        except Exception as e:
+            log.debug("overload signal native_ring failed: %s", e)
         return sig
+
+    def _sync_native_admission(self, ov) -> None:
+        """Push the controller's statsd admission knobs into the C++
+        reader ring and fold its exact per-class decisions back into the
+        controller's counters. Gated on overload_native_admission (off =
+        prior behavior: the native path bypasses admission entirely)."""
+        if not (self._native_readers_active
+                and self.cfg.overload_native_admission):
+            return
+        try:
+            state, rate, burst, tags = ov.native_admission_params()
+            self.aggregator.admission_set(True, state, rate, burst, tags)
+            ov.fold_native_counts(self.aggregator.admission_drain())
+        except Exception as e:
+            log.warning("native admission sync failed: %s", e)
 
     # -- tag exclusion wiring (server.go:1467-1510) -------------------------
     def _wire_excluded_tags(self):
@@ -807,8 +838,12 @@ class Server:
                 and not self._overload.admit(data, "statsd"):
             # shed BEFORE the parse — the cost being refused is the
             # parse+stage itself. Counted per-class in
-            # veneur.overload.shed_total; native ring traffic bypasses
-            # this path (its drops are accounted by the reader ring).
+            # veneur.overload.shed_total. Native ring traffic never
+            # reaches this path; with overload_native_admission the SAME
+            # decision runs inside the C++ reader ring (vr_admission_set
+            # push-down, exact counters folded back per poll), so the
+            # shedding guarantees hold there too instead of being
+            # bypassed.
             return
         if self._native:
             for special in self.aggregator.feed(data):
@@ -1361,6 +1396,10 @@ class Server:
             def _push_degrade(ov):
                 self.aggregator.degraded_timer_rate = ov.degraded_timer_rate()
                 self.aggregator.pending_set_shift = ov.degraded_set_shift()
+                # native ring admission rides the same poll tick: push
+                # the current state/bucket knobs down, fold the exact
+                # per-class decisions made since the last tick back up
+                self._sync_native_admission(ov)
 
             self._overload.start(self.cfg.overload_poll_interval_s,
                                  on_poll=_push_degrade)
@@ -1458,6 +1497,10 @@ class Server:
                 native_reader_fds,
                 max_len=(self.cfg.metric_max_length or 65536) + 1)
             self._native_readers_active = True
+            # arm ring admission from the first datagram — the poller's
+            # first tick is up to poll_interval away
+            if self._overload is not None:
+                self._sync_native_admission(self._overload)
 
         # SSF span listeners (networking.go:198 StartSSF)
         self.span_pipeline.start()
@@ -2627,6 +2670,15 @@ class Server:
                 self._packets_received += rc["datagrams"]
                 self._packets_dropped_py += rc["ring_dropped"]
                 self._packets_toolong_py += rc["toolong"]
+                # final admission drain for the same reason: shed/admit
+                # decisions since the last poll tick must land in the
+                # registry before the counters become unreachable
+                if self._overload is not None:
+                    try:
+                        self._overload.fold_native_counts(
+                            self.aggregator.admission_drain())
+                    except Exception:
+                        log.exception("native admission drain failed")
             self._native_readers_active = False
         for s in self._sockets:
             try:

@@ -255,16 +255,29 @@ class ShardedAggregator(Aggregator):
                 for i in range(self.n_shards)]
 
     def _dispatch_row(self, row):
-        """Pack each shard's batch into its flat buffer and run the fused
-        mesh step; compaction rides the in-band control word at the same
-        cadence as the single-device backend (Aggregator._on_batch)."""
+        """Pack each shard's batch straight into its row of a persistent
+        [1, S, W] buffer (pack_batch `out`: no per-step allocation, no
+        np.stack pass) and run the fused mesh step; compaction rides the
+        in-band control word at the same cadence as the single-device
+        backend (Aggregator._on_batch). Two whole [1, S, W] buffers
+        alternate so step N+1 packs while step N's transfer is in
+        flight."""
         import time
 
-        from veneur_tpu.aggregation.step import pack_batch
+        from veneur_tpu.aggregation.step import pack_batch, packed_layout
         self._steps += 1
         self.steps_total += 1
         dc = self._steps % self.compact_every == 0
-        flat = np.stack([[pack_batch(b, dc) for b in row]])  # [1, S, W]
+        bufs = getattr(self, "_row_bufs", None)
+        if bufs is None:
+            words = packed_layout(self._sizes)[1]
+            bufs = self._row_bufs = [
+                np.zeros((1, self.n_shards, words), np.int32),
+                np.zeros((1, self.n_shards, words), np.int32), 0]
+        flat = bufs[bufs[2]]
+        bufs[2] ^= 1
+        for i, b in enumerate(row):
+            pack_batch(b, dc, out=flat[0, i])
         self.h2d_bytes += flat.nbytes
         t0 = time.perf_counter_ns()
         self.state = self._ingest(self.state, flat)
