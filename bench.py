@@ -368,6 +368,29 @@ def main():
                 cfg9["p99_unchanged_vs_config4"] = abs(delta) <= 2e-3
         except Exception as e:  # bench must still print its line
             out["e2e_error"] = f"{type(e).__name__}: {e}"
+
+    # vtlint rides the artifact as build metadata: which static passes
+    # the tree held at this measurement, and what the one-parse-per-file
+    # framework costs (a proxy for repo size). Cheap (~seconds) and
+    # device-independent, so it runs even on a cpu_smoke artifact.
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "veneur_tpu.analysis", "--all",
+             "--json"],
+            capture_output=True, text=True, timeout=min(240.0, max(
+                30.0, remaining(30.0))),
+            cwd=here, env=cache_env(force_cpu=True))
+        lint = parse_last_json_line(proc.stdout) or {}
+        out["vtlint"] = {
+            "ok": bool(lint.get("ok")) and proc.returncode == 0,
+            "passes": len(lint.get("passes", [])),
+            "findings": len(lint.get("findings", [])),
+            "files_parsed": lint.get("files_parsed", 0),
+            "runtime_s": lint.get("runtime_s", 0),
+        }
+    except Exception as e:
+        out["vtlint"] = {"error": f"{type(e).__name__}: {e}"}
+    checkpoint()
     out["elapsed_s"] = round(time.monotonic() - T0, 1)
     out["guard_s"] = guard
     print(json.dumps(out))

@@ -1,185 +1,19 @@
 #!/usr/bin/env python
-"""Lint: forward send/retry failure paths preserve exactly-once.
+"""Lint shim: forward send/retry failure paths preserve exactly-once.
 
-The exactly-once contract (forward/envelope.py) hangs on one discipline
-in the send/retry code: a failed or AMBIGUOUS send must leave the unit
-staged under its ORIGINAL (source_id, epoch, seq) so the retry re-sends
-the same envelope and the receiver's dedup window can suppress it. The
-three legal dispositions for an except branch on that surface are:
+The check lives in veneur_tpu/analysis/ambiguous_paths.py (vtlint pass
+`ambiguous-paths`), strengthened by the `accounting-flow` dataflow pass
+over the same send/retry handlers. This entry point runs both.
+Equivalent:
 
-  ack       -- only after a verdict that the receiver HAS the data
-               (success path, never inside an except handler)
-  re-raise  -- propagate so the caller retries the same seq
-  spill     -- keep/return the payload, envelope intact, and count it
-
-This lint enforces the mechanical halves of that contract over the
-named send/retry functions:
-
-1. Every except handler must ACCOUNT its failure — a `raise`, a counter
-   `.inc(...)`, or an `x += 1`-style increment. A handler that only
-   logs swallowed a delivery failure silently.
-
-2. No except handler may fake an ack or evict staged state: calls to
-   `.ack(...)`/`.drain(...)`/`.popleft(...)`/`.clear(...)` and
-   `return True` are forbidden inside failure arms — an un-acked unit
-   must stay staged under its seq.
-
-3. The ambiguous-result classification that satellite change introduced
-   must stay put: forward/rpc.py's _AMBIGUOUS_CODES must still contain
-   DEADLINE_EXCEEDED and CANCELLED, and AmbiguousResultError must still
-   be raised there — losing either silently reverts ambiguous timeouts
-   to fresh-seq re-sends (duplicate folds at the global tier).
-
-AST-based like check_drop_accounting.py; run directly or via
-tests/test_exactly_once.py.
+    python -m veneur_tpu.analysis ambiguous-paths accounting-flow
 """
-
-from __future__ import annotations
-
-import ast
 import pathlib
 import sys
 
-REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
-# (file, function names lexically containing send/retry except arms)
-TARGETS = {
-    "veneur_tpu/forward/rpc.py": {
-        "send_metrics", "send_serialized", "send_json", "_post"},
-    "veneur_tpu/server/server.py": {
-        "_forward", "_forward_traced", "_send_forward",
-        "_stage_forward_unit", "_pump_forward_units", "_pump_traced"},
-    "veneur_tpu/forward/proxysrv.py": {
-        "handle", "_deliver_enveloped", "proxy_json_metrics",
-        "_post_import"},
-}
-
-# calls that evict/ack staged send state; illegal in a failure arm
-_EVICT_CALLS = ("ack", "drain", "popleft", "clear")
-
-
-def _accounts(handler: ast.ExceptHandler) -> bool:
-    for node in ast.walk(ast.Module(body=handler.body, type_ignores=[])):
-        if isinstance(node, ast.Raise):
-            return True
-        if isinstance(node, ast.AugAssign) and isinstance(node.op, ast.Add):
-            return True
-        if (isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Attribute)
-                and node.func.attr == "inc"):
-            return True
-    return False
-
-
-def _evicts_or_acks(handler: ast.ExceptHandler):
-    """Offending nodes: spill/window eviction calls or `return True`
-    (a fabricated ack) anywhere in the handler body."""
-    bad = []
-    for node in ast.walk(ast.Module(body=handler.body, type_ignores=[])):
-        if (isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Attribute)
-                and node.func.attr in _EVICT_CALLS):
-            bad.append((node.lineno, f".{node.func.attr}(...)"))
-        if (isinstance(node, ast.Return)
-                and isinstance(node.value, ast.Constant)
-                and node.value.value is True):
-            bad.append((node.lineno, "return True"))
-    return bad
-
-
-def _function_handlers(tree: ast.AST, wanted: set):
-    """Yield (funcname, ExceptHandler) for handlers lexically inside the
-    wanted function defs (nested defs inherit the enclosing name)."""
-    for node in ast.walk(tree):
-        if (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
-                and node.name in wanted):
-            for sub in ast.walk(node):
-                if isinstance(sub, ast.ExceptHandler):
-                    yield node.name, sub
-
-
-def check_send_paths() -> list:
-    problems = []
-    for rel, funcs in TARGETS.items():
-        path = REPO / rel
-        tree = ast.parse(path.read_text(), filename=str(path))
-        seen = set()
-        for fname, handler in _function_handlers(tree, funcs):
-            seen.add(fname)
-            if not _accounts(handler):
-                problems.append(
-                    f"{rel}:{handler.lineno}: except in {fname}() "
-                    "swallows a send failure without raise/.inc()/+=")
-            for lineno, what in _evicts_or_acks(handler):
-                problems.append(
-                    f"{rel}:{lineno}: except in {fname}() contains "
-                    f"{what} — a failure arm must not ack or evict the "
-                    "staged unit (retry must re-send the same seq)")
-        missing = funcs - seen - _no_handler_ok(tree, funcs)
-        for fname in sorted(missing):
-            problems.append(
-                f"{rel}: expected function {fname}() not found — update "
-                "scripts/check_ambiguous_paths.py TARGETS if it moved")
-    return problems
-
-
-def _no_handler_ok(tree: ast.AST, wanted: set) -> set:
-    """Functions that exist but contain no except handler: fine (all
-    errors propagate = re-send same seq), but they must still EXIST so a
-    rename doesn't silently shrink the lint surface."""
-    present = set()
-    for node in ast.walk(tree):
-        if (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
-                and node.name in wanted):
-            present.add(node.name)
-    return present
-
-
-def check_ambiguous_classification() -> list:
-    """Rule 3: rpc.py still classifies DEADLINE_EXCEEDED/CANCELLED as
-    ambiguous and raises AmbiguousResultError somewhere."""
-    path = REPO / "veneur_tpu/forward/rpc.py"
-    tree = ast.parse(path.read_text(), filename=str(path))
-    problems = []
-    codes = set()
-    raises_ambiguous = False
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Assign):
-            targets = [t.id for t in node.targets
-                       if isinstance(t, ast.Name)]
-            if "_AMBIGUOUS_CODES" in targets and isinstance(
-                    node.value, (ast.Tuple, ast.List)):
-                for elt in node.value.elts:
-                    if isinstance(elt, ast.Attribute):
-                        codes.add(elt.attr)
-        if isinstance(node, ast.Raise) and node.exc is not None:
-            call = node.exc
-            name = (call.func if isinstance(call, ast.Call) else call)
-            if (isinstance(name, ast.Name)
-                    and name.id == "AmbiguousResultError"):
-                raises_ambiguous = True
-    for want in ("DEADLINE_EXCEEDED", "CANCELLED"):
-        if want not in codes:
-            problems.append(
-                f"forward/rpc.py: _AMBIGUOUS_CODES no longer includes "
-                f"{want} — ambiguous timeouts would re-send under a "
-                "fresh seq and double-fold at the global tier")
-    if not raises_ambiguous:
-        problems.append(
-            "forward/rpc.py: AmbiguousResultError is never raised — "
-            "the ambiguous classification satellite regressed")
-    return problems
-
-
-def main() -> int:
-    problems = check_send_paths() + check_ambiguous_classification()
-    if problems:
-        print("ambiguous-path lint failed:")
-        for p in problems:
-            print(" ", p)
-        return 1
-    return 0
-
+from veneur_tpu.analysis import run_cli
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(run_cli(["ambiguous-paths", "accounting-flow"]))

@@ -1,158 +1,19 @@
 #!/usr/bin/env python
-"""Lint: every data-discarding code path increments a registered counter.
+"""Lint shim: every data-discarding code path increments a counter.
 
-The overload PR's contract is "nothing is shed silently": an operator
-must be able to reconstruct sent == processed + sum(drop counters) from
-telemetry alone. This lint enforces the two mechanical halves of that
-contract over the ingest/egress surface:
+The check lives in veneur_tpu/analysis/drop_accounting.py (vtlint pass
+`drop-accounting`), strengthened by the `accounting-flow` dataflow pass
+(every BRANCH of a drop handler accounts, not just some statement in
+its body). This entry point runs both. Equivalent:
 
-1. Every `except queue.Full` / `except Full` handler (a capacity drop by
-   definition) and every ParseError/FramingError handler in the listener
-   modules must do accounting in its body — a counter `.inc(...)` call or
-   an `x += 1`-style increment. A handler that only logs (or only
-   returns) is a silent discard.
-
-2. The canonical drop-counter families must each still be REGISTERED
-   somewhere in the tree as a string literal — renaming one away without
-   updating its discard site would otherwise pass rule 1 while breaking
-   the accounting identity downstream dashboards rely on.
-
-AST-based like check_no_bare_except.py; run directly or via
-tests/test_overload.py.
+    python -m veneur_tpu.analysis drop-accounting accounting-flow
 """
-
-from __future__ import annotations
-
-import ast
 import pathlib
 import sys
 
-REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
-# the ingest + egress surface: everywhere a sample can be discarded
-TARGETS = [
-    "veneur_tpu/server",
-    "veneur_tpu/samplers",
-    "veneur_tpu/protocol",
-    "veneur_tpu/forward",
-    "veneur_tpu/reliability",
-]
-
-# counter families that discard sites rely on; each must appear as a
-# registration literal somewhere under veneur_tpu/
-REQUIRED_COUNTERS = [
-    "veneur.packets_dropped_total",
-    "veneur.parse_errors_total",
-    "veneur.worker.metrics_dropped_total",
-    "veneur.overload.shed_total",
-    "veneur.forward.spill.dropped_total",
-    "veneur.tcp.rejected_total",
-    "veneur.tcp.idle_closed_total",
-]
-
-# exception names whose handlers ARE discard sites
-_DROP_EXCS = ("Full", "ParseError", "FramingError")
-
-
-def _target_files():
-    for entry in TARGETS:
-        p = REPO / entry
-        if p.is_file():
-            yield p
-        else:
-            yield from sorted(p.rglob("*.py"))
-
-
-def _exc_names(node: ast.ExceptHandler):
-    """Leaf names of the handled exception type(s): `queue.Full` -> Full,
-    `(Full, OSError)` -> both."""
-    t = node.type
-    if t is None:
-        return []
-    parts = t.elts if isinstance(t, ast.Tuple) else [t]
-    names = []
-    for p in parts:
-        if isinstance(p, ast.Attribute):
-            names.append(p.attr)
-        elif isinstance(p, ast.Name):
-            names.append(p.id)
-    return names
-
-
-_REJECT_NAMES = ("invalid", "drop", "reject", "shed", "error")
-
-
-def _accounts(handler: ast.ExceptHandler) -> bool:
-    """True when the handler body increments something: an `.inc(...)`
-    method call, an augmented `+= ` assignment (the plain-int counter
-    idiom), a re-raise (the caller accounts), or an `.append(...)` onto
-    a rejection collection (`invalid.append(sample)` — the hand-off
-    idiom where the CALLER counts the returned rejects)."""
-    for stmt in ast.walk(ast.Module(body=handler.body, type_ignores=[])):
-        if isinstance(stmt, ast.Raise):
-            return True
-        if isinstance(stmt, ast.AugAssign) and isinstance(stmt.op, ast.Add):
-            return True
-        if (isinstance(stmt, ast.Call)
-                and isinstance(stmt.func, ast.Attribute)):
-            if stmt.func.attr == "inc":
-                return True
-            if stmt.func.attr == "append":
-                target = stmt.func.value
-                name = (target.id if isinstance(target, ast.Name)
-                        else target.attr
-                        if isinstance(target, ast.Attribute) else "")
-                if any(r in name.lower() for r in _REJECT_NAMES):
-                    return True
-    return False
-
-
-def check_file(path: pathlib.Path) -> list:
-    tree = ast.parse(path.read_text(), filename=str(path))
-    problems = []
-    rel = path.relative_to(REPO)
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.ExceptHandler):
-            continue
-        dropped = [n for n in _exc_names(node) if n in _DROP_EXCS]
-        if dropped and not _accounts(node):
-            problems.append(
-                f"{rel}:{node.lineno}: `except {'/'.join(dropped)}` "
-                "discards data without incrementing a drop counter")
-    return problems
-
-
-def _registered_literals() -> set:
-    """Every veneur.* string literal in the tree (superset of
-    registration names; good enough to catch a renamed-away counter)."""
-    found = set()
-    for path in sorted((REPO / "veneur_tpu").rglob("*.py")):
-        tree = ast.parse(path.read_text(), filename=str(path))
-        for node in ast.walk(tree):
-            if (isinstance(node, ast.Constant)
-                    and isinstance(node.value, str)
-                    and node.value.startswith("veneur.")):
-                found.add(node.value)
-    return found
-
-
-def main() -> int:
-    problems = []
-    for path in _target_files():
-        problems.extend(check_file(path))
-    literals = _registered_literals()
-    for name in REQUIRED_COUNTERS:
-        if name not in literals:
-            problems.append(
-                f"required drop counter {name!r} is no longer registered "
-                "anywhere under veneur_tpu/")
-    if problems:
-        print("drop-accounting lint failed:")
-        for p in problems:
-            print(" ", p)
-        return 1
-    return 0
-
+from veneur_tpu.analysis import run_cli
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(run_cli(["drop-accounting", "accounting-flow"]))

@@ -1,100 +1,18 @@
 #!/usr/bin/env python
-"""Lint: the per-batch pump/emit hot path stays allocation-free.
+"""Lint shim: the per-batch pump/emit hot path stays allocation-free.
 
-The zero-copy ingest PR's contract: once the pipeline is warm, moving a
-batch from the wire to the device performs NO per-batch Python-side
-allocation — staged lanes land in pre-allocated double-buffered flat
-host buffers (C++ `vt_emit_packed` / `pack_batch(out=)`), and every
-array the dispatch touches is a view or a reused buffer. A `.copy()`,
-`np.concatenate`, `np.stack`, or `np.empty` creeping back into one of
-these functions silently reintroduces the ten-copies-per-batch repack
-this PR removed (measured ~6x on `worker_ingest` r05 -> r06).
+The check lives in veneur_tpu/analysis/hot_path_alloc.py (vtlint pass
+`hot-path-alloc`); this entry point remains so existing invocations and
+CI wiring keep working. Equivalent:
 
-Allocation in __init__/_alloc_* helpers is fine — buffers have to come
-from somewhere; the lint covers only the named per-batch functions.
-`np.zeros` is also allowed: the packed-layout contract REQUIRES
-zero-initialized buffers at allocation time, and none of the hot
-functions below allocate at all.
-
-AST-based like check_drop_accounting.py; run directly or via
-tests/test_native.py.
+    python -m veneur_tpu.analysis hot-path-alloc
 """
-
-from __future__ import annotations
-
-import ast
 import pathlib
 import sys
 
-REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
-# {file: functions that run once per batch (or per datagram) when warm}
-HOT_FUNCS = {
-    "veneur_tpu/server/native_aggregator.py": [
-        "_emit_native", "feed", "pump", "_split_shards"],
-    "veneur_tpu/aggregation/step.py": ["pack_batch"],
-    "veneur_tpu/server/aggregator.py": ["_on_batch"],
-    "veneur_tpu/server/sharded_aggregator.py": ["_dispatch_row"],
-}
-
-# numpy constructors that allocate a fresh array per call
-_NP_ALLOCS = ("empty", "concatenate", "stack")
-
-
-def _violations_in(fn: ast.FunctionDef, rel: str) -> list:
-    problems = []
-    for node in ast.walk(fn):
-        if not (isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Attribute)):
-            continue
-        attr = node.func.attr
-        if attr == "copy":
-            problems.append(
-                f"{rel}:{node.lineno}: `.copy()` in hot-path function "
-                f"{fn.name}() — use the pre-allocated packed buffer")
-        elif attr in _NP_ALLOCS:
-            base = node.func.value
-            if isinstance(base, ast.Name) and base.id in ("np", "numpy"):
-                problems.append(
-                    f"{rel}:{node.lineno}: `np.{attr}` in hot-path "
-                    f"function {fn.name}() — per-batch allocation; "
-                    "move it to an _alloc_* init helper")
-    return problems
-
-
-def check_file(path: pathlib.Path, func_names: list) -> list:
-    tree = ast.parse(path.read_text(), filename=str(path))
-    rel = str(path.relative_to(REPO))
-    problems = []
-    seen = set()
-    for node in ast.walk(tree):
-        if (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
-                and node.name in func_names):
-            seen.add(node.name)
-            problems.extend(_violations_in(node, rel))
-    for name in func_names:
-        if name not in seen:
-            problems.append(
-                f"{rel}: hot-path function {name}() not found — renamed? "
-                "update HOT_FUNCS in scripts/check_hot_path_alloc.py")
-    return problems
-
-
-def main() -> int:
-    problems = []
-    for rel, funcs in HOT_FUNCS.items():
-        path = REPO / rel
-        if not path.exists():
-            problems.append(f"{rel}: file missing — update HOT_FUNCS")
-            continue
-        problems.extend(check_file(path, funcs))
-    if problems:
-        print("hot-path allocation lint failed:")
-        for p in problems:
-            print(" ", p)
-        return 1
-    return 0
-
+from veneur_tpu.analysis import run_cli
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(run_cli(["hot-path-alloc"]))

@@ -1,131 +1,18 @@
 #!/usr/bin/env python
-"""Lint: every self-telemetry metric name is registered once and documented.
+"""Lint shim: self-telemetry names registered once and documented.
 
-The telemetry registry (veneur_tpu/observability/registry.py) is supposed
-to be the single source of truth for `veneur.*` series. This check keeps
-three invariants from rotting:
+The check lives in veneur_tpu/analysis/metric_names.py (vtlint pass
+`metric-names`); this entry point remains so existing invocations keep
+working. Equivalent:
 
-  1. a name is REGISTERED (registry.counter/gauge/timer/callback with a
-     literal name) at most once across the tree — two registration sites
-     for one name means two owners and an eventual conflict error at
-     runtime;
-  2. every name the code can emit or register appears in the README's
-     metric inventory (the block between the metric-inventory markers);
-  3. every inventory row corresponds to a name the code actually uses —
-     no documentation of metrics that no longer exist.
-
-"Emitted" covers the literal-name ssf_samples.count/gauge/... call sites
-and dict literals whose keys are mostly `veneur.*` strings (the
-self-telemetry delta snapshot in server.py). Dynamically-built names
-(forward/tracedhttp.py's "veneur." + action + ...) can't be
-string-checked; they are documented as a pattern in the README prose and
-intentionally out of scope here.
-
-AST-based. Run directly or via tests/test_observability.py.
+    python -m veneur_tpu.analysis metric-names
 """
-
-from __future__ import annotations
-
-import ast
 import pathlib
-import re
 import sys
-from collections import defaultdict
 
-REPO = pathlib.Path(__file__).resolve().parent.parent
-README = REPO / "README.md"
-PKG = REPO / "veneur_tpu"
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
-SAMPLE_FNS = {"count", "gauge", "timing", "histogram", "set_", "status"}
-REGISTER_FNS = {"counter", "gauge", "timer", "callback"}
-
-INV_BEGIN = "<!-- metric-inventory:begin -->"
-INV_END = "<!-- metric-inventory:end -->"
-
-
-def _literal_name(call: ast.Call):
-    if call.args and isinstance(call.args[0], ast.Constant) \
-            and isinstance(call.args[0].value, str) \
-            and call.args[0].value.startswith("veneur."):
-        return call.args[0].value
-    return None
-
-
-def scan_file(path: pathlib.Path, emitted: dict, registered: dict):
-    tree = ast.parse(path.read_text(), filename=str(path))
-    rel = str(path.relative_to(REPO))
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Call) \
-                and isinstance(node.func, ast.Attribute):
-            name = _literal_name(node)
-            if name is None:
-                continue
-            func = node.func
-            on_samples = (isinstance(func.value, ast.Name)
-                          and func.value.id == "ssf_samples")
-            if on_samples and func.attr in SAMPLE_FNS:
-                emitted[name].append(f"{rel}:{node.lineno}")
-            elif not on_samples and func.attr in REGISTER_FNS:
-                registered[name].append(f"{rel}:{node.lineno}")
-        elif isinstance(node, ast.Dict):
-            # the self-telemetry snapshot dict: {"veneur.x": ..., ...}
-            keys = [k.value for k in node.keys
-                    if isinstance(k, ast.Constant)
-                    and isinstance(k.value, str)
-                    and k.value.startswith("veneur.")]
-            if len(keys) >= 3:
-                for k in keys:
-                    emitted[k].append(f"{rel}:{node.lineno}")
-
-
-def inventory_names(text: str):
-    try:
-        block = text.split(INV_BEGIN, 1)[1].split(INV_END, 1)[0]
-    except IndexError:
-        return None
-    return set(re.findall(r"`(veneur\.[a-zA-Z0-9._]+)`", block))
-
-
-def main() -> int:
-    emitted: dict = defaultdict(list)
-    registered: dict = defaultdict(list)
-    for path in sorted(PKG.rglob("*.py")):
-        scan_file(path, emitted, registered)
-
-    failures = []
-    for name, sites in sorted(registered.items()):
-        if len(sites) > 1:
-            failures.append(f"{name}: registered at {len(sites)} sites "
-                            f"({', '.join(sites)}); one owner only")
-
-    known = set(emitted) | set(registered)
-    if not README.is_file():
-        failures.append("README.md missing")
-        inv = set()
-    else:
-        inv = inventory_names(README.read_text())
-        if inv is None:
-            failures.append(
-                f"README.md lacks the {INV_BEGIN} .. {INV_END} block")
-            inv = set()
-    for name in sorted(known - inv):
-        sites = (emitted.get(name) or registered.get(name))[:2]
-        failures.append(f"{name}: used at {', '.join(sites)} but absent "
-                        "from the README metric inventory")
-    for name in sorted(inv - known):
-        failures.append(f"{name}: in the README inventory but no code "
-                        "emits or registers it")
-
-    if failures:
-        print(f"check_metric_names: {len(failures)} problem(s)")
-        for f in failures:
-            print(f"  {f}")
-        return 1
-    print(f"check_metric_names: OK ({len(known)} names: "
-          f"{len(registered)} registered, {len(emitted)} emitted, "
-          f"{len(inv)} documented)")
-    return 0
-
+from veneur_tpu.analysis import run_cli
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(run_cli(["metric-names"]))

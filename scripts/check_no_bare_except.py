@@ -1,94 +1,18 @@
 #!/usr/bin/env python
-"""Lint: no silent error swallowing in the egress paths.
+"""Lint shim: no silent error swallowing in the egress paths.
 
-Fails on two patterns inside the egress modules (sinks/, forward/,
-server/server.py, reliability/):
+The check lives in veneur_tpu/analysis/bare_except.py (vtlint pass
+`bare-except`); this entry point remains so existing invocations keep
+working. Equivalent:
 
-  except:                      # bare except — catches KeyboardInterrupt
-  except Exception: pass       # swallow with NO logging/accounting
-
-Both hide exactly the failures the reliability layer exists to count:
-a dropped flush that is neither retried, spilled, nor reported is an
-invisible data loss. Handlers must at minimum log the exception (the
-`except Exception as e: log.debug(...)` shape passes).
-
-AST-based, not regex: `except Exception:` whose body does real work is
-fine; only a body that is exclusively `pass`/`...` fails. `except
-BaseException:` with a bare re-raise also passes (the resource-cleanup
-idiom). Run directly or via tests/test_chaos.py.
+    python -m veneur_tpu.analysis bare-except
 """
-
-from __future__ import annotations
-
-import ast
 import pathlib
 import sys
 
-REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
-# the egress surface: everything that ships data out of the process
-EGRESS = [
-    "veneur_tpu/sinks",
-    "veneur_tpu/forward",
-    "veneur_tpu/reliability",
-    "veneur_tpu/server/server.py",
-]
-
-
-def _egress_files():
-    for entry in EGRESS:
-        p = REPO / entry
-        if p.is_file():
-            yield p
-        else:
-            yield from sorted(p.rglob("*.py"))
-
-
-def _is_swallow(handler: ast.ExceptHandler) -> bool:
-    """True for a body that does nothing at all."""
-    return all(isinstance(stmt, ast.Pass)
-               or (isinstance(stmt, ast.Expr)
-                   and isinstance(stmt.value, ast.Constant)
-                   and stmt.value.value is Ellipsis)
-               for stmt in handler.body)
-
-
-def _is_reraise_only(handler: ast.ExceptHandler) -> bool:
-    return (len(handler.body) == 1
-            and isinstance(handler.body[0], ast.Raise)
-            and handler.body[0].exc is None)
-
-
-def check_file(path: pathlib.Path) -> list:
-    tree = ast.parse(path.read_text(), filename=str(path))
-    problems = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.ExceptHandler):
-            continue
-        rel = path.relative_to(REPO)
-        if node.type is None and not _is_reraise_only(node):
-            problems.append(
-                f"{rel}:{node.lineno}: bare `except:` in egress path")
-        elif (isinstance(node.type, ast.Name)
-              and node.type.id in ("Exception", "BaseException")
-              and _is_swallow(node)):
-            problems.append(
-                f"{rel}:{node.lineno}: `except {node.type.id}:` "
-                "swallows silently (log it or count it)")
-    return problems
-
-
-def main() -> int:
-    problems = []
-    for path in _egress_files():
-        problems.extend(check_file(path))
-    if problems:
-        print("egress error-handling lint failed:")
-        for p in problems:
-            print(" ", p)
-        return 1
-    return 0
-
+from veneur_tpu.analysis import run_cli
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(run_cli(["bare-except"]))

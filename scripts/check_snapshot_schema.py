@@ -1,61 +1,18 @@
 #!/usr/bin/env python
-"""Lint: the checkpoint snapshot schema cannot drift silently.
+"""Lint shim: the checkpoint snapshot schema cannot drift silently.
 
-The on-disk checkpoint format (veneur_tpu/persistence/codec.py) pins a
-hash over the structures its meaning depends on — DeviceState's field
-list and TableSpec's field names. A checkpoint written by one build and
-read by another is only safe while those structures agree, so:
+The check lives in veneur_tpu/analysis/snapshot_schema.py (vtlint pass
+`snapshot-schema`); this entry point remains so existing invocations
+keep working. Equivalent:
 
-  - if DeviceState or TableSpec changes shape, this check FAILS until
-    SNAPSHOT_FORMAT_VERSION is bumped and the new version's hash is
-    pinned in codec._SCHEMA_PINS (and, when the layout truly changed,
-    the codec taught to read both versions or migration notes written);
-  - the pin also guards against accidental edits to schema_hash()
-    itself — any change to what the hash covers shows up here first.
-
-Run directly (JAX_PLATFORMS=cpu recommended) or via
-tests/test_persistence.py.
+    python -m veneur_tpu.analysis snapshot-schema
 """
-
-from __future__ import annotations
-
-import os
 import pathlib
 import sys
 
-REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
-
-def main() -> int:
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
-    sys.path.insert(0, str(REPO))
-    from veneur_tpu.persistence.codec import (SNAPSHOT_FORMAT_VERSION,
-                                              _SCHEMA_PINS, schema_hash)
-    live = schema_hash()
-    pinned = _SCHEMA_PINS.get(SNAPSHOT_FORMAT_VERSION)
-    if pinned is None:
-        print(f"check_snapshot_schema: SNAPSHOT_FORMAT_VERSION="
-              f"{SNAPSHOT_FORMAT_VERSION} has no pin in "
-              "codec._SCHEMA_PINS — add one:")
-        print(f"  {SNAPSHOT_FORMAT_VERSION}: \"{live}\"")
-        return 1
-    if live != pinned:
-        print("check_snapshot_schema: snapshot schema DRIFTED")
-        print(f"  pinned (version {SNAPSHOT_FORMAT_VERSION}): {pinned}")
-        print(f"  live:                 {live}")
-        print("DeviceState._fields or TableSpec changed shape. Old "
-              "checkpoints would be misread. To fix:")
-        print("  1. bump SNAPSHOT_FORMAT_VERSION in "
-              "veneur_tpu/persistence/codec.py")
-        print("  2. pin the new version's hash in _SCHEMA_PINS "
-              f"(live hash above)")
-        print("  3. decide what read_manifest does with the previous "
-              "version: reject (default) or migrate")
-        return 1
-    print(f"check_snapshot_schema: OK (version {SNAPSHOT_FORMAT_VERSION}, "
-          f"hash {live[:12]}…)")
-    return 0
-
+from veneur_tpu.analysis import run_cli
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(run_cli(["snapshot-schema"]))

@@ -210,16 +210,6 @@ def test_fault_injection_config_key_arms_on_start():
         srv.shutdown()
 
 
-def test_egress_paths_have_no_silent_excepts():
-    """Satellite (f): the bare-except lint over the egress surface runs
-    clean — every handler logs or counts what it catches."""
-    script = (pathlib.Path(__file__).resolve().parent.parent
-              / "scripts" / "check_no_bare_except.py")
-    proc = subprocess.run([sys.executable, str(script)],
-                          capture_output=True, text=True, timeout=60)
-    assert proc.returncode == 0, proc.stdout + proc.stderr
-
-
 # -- durability chaos (veneur_tpu/persistence/) -----------------------------
 
 def _kr_lines(part):

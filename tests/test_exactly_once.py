@@ -418,11 +418,37 @@ def test_proxy_rejects_bad_envelope_with_accounting():
     assert p.envelope_rejected == 1
 
 
-# -- lint: failure arms never ack/evict (satellite f) -----------------------
+# -- proxy stat counters: increments are thread-safe ------------------------
 
-def test_forward_failure_paths_pass_ambiguity_lint():
-    script = (pathlib.Path(__file__).resolve().parent.parent
-              / "scripts" / "check_ambiguous_paths.py")
-    proc = subprocess.run([sys.executable, str(script)],
-                          capture_output=True, text=True, timeout=60)
-    assert proc.returncode == 0, proc.stdout + proc.stderr
+def test_proxy_counter_bumps_are_thread_safe():
+    """Pinned regression for the counter races vtlint's lock-discipline
+    pass flagged: handle()/_deliver_enveloped() bump errors/forwarded/
+    dup_suppressed from concurrent gRPC worker threads, and a bare
+    `self.errors += 1` loses increments. All bumps route through
+    _bump(), which must count exactly under contention."""
+    import threading
+
+    from veneur_tpu.forward.proxysrv import ProxyServer
+
+    p = ProxyServer(_StaticDisc(["a:1"]))
+    n_threads, per_thread = 8, 2000
+
+    def hammer():
+        for _ in range(per_thread):
+            p._bump("errors")
+            p._bump("forwarded", 3)
+            p._bump("dup_suppressed")
+            p._bump("envelope_rejected")
+            p._bump("rejected_open", 2)
+
+    threads = [threading.Thread(target=hammer) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    total = n_threads * per_thread
+    assert p.errors == total
+    assert p.forwarded == 3 * total
+    assert p.dup_suppressed == total
+    assert p.envelope_rejected == total
+    assert p.rejected_open == 2 * total

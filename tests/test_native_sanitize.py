@@ -1,0 +1,99 @@
+"""ASan+UBSan-hardened build of the native DogStatsD engine.
+
+VENEUR_NATIVE_SANITIZE=1 makes veneur_tpu.native compile dogstatsd.cpp
+with -fsanitize=address,undefined under a distinct .so cache name.
+CPython itself is not instrumented, so the sanitizer runtime must be
+LD_PRELOADed into a child interpreter; these tests spawn that child and
+run (a) the packed-emit parity slice of test_native.py and (b) the
+malformed-intake fuzz corpora through NativeIngest, so any heap
+overflow / use-after-free / UB in the parser or packed-emit path
+aborts the child instead of silently corrupting the tables.
+
+Skips (with the reason) when g++ or the sanitizer runtimes are absent.
+"""
+
+import os
+import pathlib
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _sanitizer_env():
+    if shutil.which("g++") is None:
+        pytest.skip("g++ not on PATH — cannot build the native engine")
+    preload = []
+    for name in ("libasan.so", "libubsan.so"):
+        out = subprocess.run(
+            ["g++", f"-print-file-name={name}"],
+            capture_output=True, text=True).stdout.strip()
+        if os.path.sep not in out or not pathlib.Path(out).is_file():
+            pytest.skip(f"{name} not shipped with this g++ — "
+                        "sanitizer runtime unavailable")
+        preload.append(out)
+    env = dict(os.environ)
+    env.update({
+        "VENEUR_NATIVE_SANITIZE": "1",
+        # the child interpreter is not instrumented; the runtime must
+        # be resolvable before libpython allocates anything
+        "LD_PRELOAD": ":".join(preload),
+        # leak checking would report the whole CPython/jaxlib heap; the
+        # target is memory errors and UB in dogstatsd.cpp
+        "ASAN_OPTIONS": "detect_leaks=0",
+        "UBSAN_OPTIONS": "halt_on_error=1:print_stacktrace=1",
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": str(REPO),
+    })
+    return env
+
+
+def _run(env, *argv):
+    return subprocess.run(
+        [sys.executable, *argv], cwd=REPO, env=env,
+        capture_output=True, text=True, timeout=540)
+
+
+def test_sanitized_packed_emit_parity():
+    """The packed-emit parity suite passes under ASan+UBSan."""
+    env = _sanitizer_env()
+    proc = _run(env, "-m", "pytest", "tests/test_native.py",
+                "-q", "-p", "no:cacheprovider", "-k", "packed")
+    assert proc.returncode == 0, proc.stdout[-4000:] + proc.stderr[-4000:]
+    assert "passed" in proc.stdout, proc.stdout[-2000:]
+
+
+def test_sanitized_intake_fuzz_corpus():
+    """Every malformed-intake corpus packet feeds through the sanitized
+    engine without tripping ASan/UBSan; good packets still emit."""
+    env = _sanitizer_env()
+    child = """
+import sys
+sys.path.insert(0, "tests")
+from veneur_tpu import native
+assert native.available(), native._load_err
+import test_native as tn
+import test_intake_fuzz as fz
+
+corpus = (tn.GOOD_PACKETS + tn.BAD_PACKETS
+          + fz.MALFORMED_METRIC_CORPUS + fz.MALFORMED_EVENT_CORPUS
+          + fz.MALFORMED_CHECK_CORPUS)
+ing = tn.mk()
+fed = 0
+for pkt in corpus:
+    data = pkt if isinstance(pkt, bytes) else pkt.encode(
+        "utf-8", "surrogateescape")
+    full, _ = ing.feed(data + b"\\n")
+    if full:
+        ing.emit_into(tn.emit_arrays())
+    fed += 1
+ing.emit_into(tn.emit_arrays())
+ing.drain_new_keys()
+print("fuzz-fed", fed)
+"""
+    proc = _run(env, "-c", child)
+    assert proc.returncode == 0, proc.stdout[-4000:] + proc.stderr[-4000:]
+    assert "fuzz-fed" in proc.stdout

@@ -356,13 +356,3 @@ def test_flush_trace_off_by_default():
         assert "flush.frame_build" not in names
     finally:
         srv.shutdown()
-
-
-# -- satellite (e): the lint itself -----------------------------------------
-
-def test_metric_names_are_registered_once_and_documented():
-    script = (pathlib.Path(__file__).resolve().parent.parent
-              / "scripts" / "check_metric_names.py")
-    proc = subprocess.run([sys.executable, str(script)],
-                          capture_output=True, text=True, timeout=60)
-    assert proc.returncode == 0, proc.stdout + proc.stderr

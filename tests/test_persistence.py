@@ -514,14 +514,6 @@ def test_server_restore_quarantines_corrupt_and_cold_starts(tmp_path):
 
 # -- lints + CLI (satellites) -----------------------------------------------
 
-def test_snapshot_schema_lint_passes():
-    script = (pathlib.Path(__file__).resolve().parent.parent
-              / "scripts" / "check_snapshot_schema.py")
-    proc = subprocess.run([sys.executable, str(script)],
-                          capture_output=True, text=True, timeout=120)
-    assert proc.returncode == 0, proc.stdout + proc.stderr
-
-
 def test_schema_hash_is_pinned():
     from veneur_tpu.persistence.codec import (SNAPSHOT_FORMAT_VERSION,
                                               _SCHEMA_PINS)

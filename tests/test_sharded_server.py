@@ -187,3 +187,45 @@ def test_sharded_local_forwards_to_single_device_global():
     finally:
         local.shutdown()
         glob.shutdown()
+
+
+def test_hll_import_merge_on_device_matches_host_reference():
+    """Pinned regression for the _apply_hll_imports host sync vtlint's
+    jax-hot-path pass flagged: imported HLL rows must merge via a
+    device-side scatter-max (no np.array(self.state.hll) full-table
+    round trip on the pipeline thread), and duplicate slots in one
+    batch must fold exactly like a sequential host merge."""
+    from veneur_tpu.aggregation.host import BatchSpec
+    from veneur_tpu.aggregation.state import TableSpec
+
+    spec = TableSpec(counter_capacity=64, gauge_capacity=32,
+                     status_capacity=8, set_capacity=8, histo_capacity=32)
+    agg = ShardedAggregator(
+        spec, BatchSpec(counter=64, gauge=32, status=8, set=16, histo=64),
+        n_shards=8)
+    rng = np.random.default_rng(7)
+    n_regs = agg.pspec.registers
+    rows = [rng.integers(0, 30, size=n_regs).astype(np.uint8)
+            for _ in range(4)]
+    # three keys; key hll.a imported twice in the SAME batch so the
+    # scatter sees a duplicate slot
+    keys = [("hll.a", 1), ("hll.a", 1), ("hll.b", 2), ("hll.c", 3)]
+    for (name, digest), regs in zip(keys, rows):
+        agg.import_metric("set", name, (), 0, digest,
+                          {"registers": regs})
+    staged = list(zip(agg._hll_slots, agg._hll_rows))
+    assert len(staged) == 4
+    assert staged[0][0] == staged[1][0]
+    ref = np.asarray(agg.state.hll).copy()
+    for (shard, local), regs in staged:
+        ref[0, shard, local] = np.maximum(ref[0, shard, local], regs)
+    agg._apply_hll_imports()
+    assert agg._hll_slots == [] and agg._hll_rows == []
+    np.testing.assert_array_equal(np.asarray(agg.state.hll), ref)
+    # a second wave on top of the merged state: max accumulates
+    more = rng.integers(0, 30, size=n_regs).astype(np.uint8)
+    agg.import_metric("set", "hll.a", (), 0, 1, {"registers": more})
+    shard, local = agg._hll_slots[0]
+    ref[0, shard, local] = np.maximum(ref[0, shard, local], more)
+    agg._apply_hll_imports()
+    np.testing.assert_array_equal(np.asarray(agg.state.hll), ref)

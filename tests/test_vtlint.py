@@ -1,0 +1,393 @@
+"""vtlint: the unified static-analysis framework (veneur_tpu/analysis).
+
+Three layers:
+
+1. Per-pass positive/negative fixtures — every registered pass has a
+   minimal committed fixture it MUST flag and a minimal clean fixture it
+   must stay silent on, parameterized over the registry.
+2. Framework self-coverage — alias resolution, suppression comments
+   (including the mandatory `-- reason`), missing-registered-function
+   errors, the one-parse-per-file contract, JSON schema stability.
+3. The tier-1 gate — `python -m veneur_tpu.analysis --all --json` runs
+   every pass against this repo and must exit 0 (this replaces the six
+   per-script subprocess tests that used to live in other test files).
+"""
+
+import ast
+import json
+import pathlib
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from veneur_tpu.analysis import (PASSES, ambiguous_paths, accounting_flow,
+                                 bare_except, drop_accounting,
+                                 hot_path_alloc, jax_hot_path,
+                                 lock_discipline, metric_names,
+                                 run_passes, snapshot_schema)
+from veneur_tpu.analysis.core import (Project, filter_suppressed,
+                                      reasonless_suppressions)
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _project(root: pathlib.Path, files: dict) -> Project:
+    for rel, src in files.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return Project(root)
+
+
+# -- 1. per-pass positive/negative fixtures ---------------------------------
+
+# (pass name, runner(project), files that MUST flag, files that must not)
+CASES = [
+    (
+        "hot-path-alloc",
+        lambda p: hot_path_alloc.run(p, hot_funcs={"pkg/mod.py": ["pump"]}),
+        {"pkg/mod.py": """
+            import numpy as np
+            def pump(buf):
+                out = np.empty(4)
+                return out
+        """},
+        {"pkg/mod.py": """
+            import numpy as np
+            def pump(buf):
+                out = np.zeros(4)
+                return out
+        """},
+    ),
+    (
+        "bare-except",
+        lambda p: bare_except.run(p, egress=["pkg"]),
+        {"pkg/sink.py": """
+            def flush(batch):
+                try:
+                    batch.send()
+                except Exception:
+                    pass
+        """},
+        {"pkg/sink.py": """
+            import logging
+            def flush(batch):
+                try:
+                    batch.send()
+                except Exception:
+                    logging.exception("flush failed")
+        """},
+    ),
+    (
+        "drop-accounting",
+        lambda p: drop_accounting.run(p, targets=["pkg"],
+                                      required_counters=[]),
+        {"pkg/ingest.py": """
+            import queue
+            def enqueue(q, item):
+                try:
+                    q.put_nowait(item)
+                except queue.Full:
+                    pass
+        """},
+        {"pkg/ingest.py": """
+            import queue
+            def enqueue(q, item, stats):
+                try:
+                    q.put_nowait(item)
+                except queue.Full:
+                    stats.dropped += 1
+        """},
+    ),
+    (
+        "ambiguous-paths",
+        lambda p: ambiguous_paths.run(p, targets={"pkg/mod.py": {"send"}}),
+        {"pkg/mod.py": """
+            def send(win, batch):
+                try:
+                    win.post(batch)
+                except OSError:
+                    win.clear()
+                    raise
+        """},
+        {"pkg/mod.py": """
+            def send(win, batch):
+                try:
+                    win.post(batch)
+                except OSError:
+                    win.failed.inc()
+                    raise
+        """},
+    ),
+    (
+        "metric-names",
+        lambda p: metric_names.run(p, pkg="pkg", readme="README.md"),
+        {
+            "pkg/a.py": """
+                def setup(reg):
+                    reg.counter("veneur.dup.total")
+            """,
+            "pkg/b.py": """
+                def setup_again(reg):
+                    reg.counter("veneur.dup.total")
+            """,
+            "README.md": """
+                <!-- metric-inventory:begin -->
+                | `veneur.dup.total` | c | dup |
+                <!-- metric-inventory:end -->
+            """,
+        },
+        {
+            "pkg/a.py": """
+                def setup(reg):
+                    reg.counter("veneur.dup.total")
+            """,
+            "README.md": """
+                <!-- metric-inventory:begin -->
+                | `veneur.dup.total` | c | dup |
+                <!-- metric-inventory:end -->
+            """,
+        },
+    ),
+    (
+        "jax-hot-path",
+        lambda p: jax_hot_path.run(p, hot_funcs={"pkg/mod.py": ["hot"]},
+                                   donating_jits={}, sync_scan=[]),
+        {"pkg/mod.py": """
+            import numpy as np
+            def hot(state):
+                x = np.asarray(state)
+                return x
+        """},
+        {"pkg/mod.py": """
+            import numpy as np
+            def hot(state):
+                return state
+        """},
+    ),
+    (
+        "lock-discipline",
+        lambda p: lock_discipline.run(p, modules=["pkg/mod.py"]),
+        {"pkg/mod.py": """
+            import threading
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+                def locked_bump(self):
+                    with self._lock:
+                        self.n += 1
+                def racy_bump(self):
+                    self.n += 1
+        """},
+        {"pkg/mod.py": """
+            import threading
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+                def locked_bump(self):
+                    with self._lock:
+                        self.n += 1
+                def other_bump(self):
+                    with self._lock:
+                        self.n += 2
+        """},
+    ),
+    (
+        "accounting-flow",
+        lambda p: accounting_flow.run(p, targets=["pkg"], send_targets={}),
+        {"pkg/ingest.py": """
+            import queue
+            def enqueue(q, item, stats=None):
+                try:
+                    q.put_nowait(item)
+                except queue.Full:
+                    if stats is not None:
+                        stats.dropped += 1
+        """},
+        {"pkg/ingest.py": """
+            import queue
+            def enqueue(q, item, stats):
+                try:
+                    q.put_nowait(item)
+                except queue.Full:
+                    stats.dropped += 1
+        """},
+    ),
+]
+
+_IDS = [c[0] for c in CASES]
+
+
+@pytest.mark.parametrize("pass_name,runner,pos,neg", CASES, ids=_IDS)
+def test_pass_flags_positive_fixture(tmp_path, pass_name, runner, pos, neg):
+    found = runner(_project(tmp_path, pos))
+    assert found, f"{pass_name} missed its positive fixture"
+    assert all(f.pass_name == pass_name for f in found)
+    assert all(f.line or f.file == "" or True for f in found)
+
+
+@pytest.mark.parametrize("pass_name,runner,pos,neg", CASES, ids=_IDS)
+def test_pass_quiet_on_negative_fixture(tmp_path, pass_name, runner,
+                                        pos, neg):
+    assert runner(_project(tmp_path, neg)) == []
+
+
+def test_snapshot_schema_clean_and_drift(monkeypatch):
+    """The live-code pass: clean against this repo, and a bogus pin for
+    the current format version is reported as drift."""
+    assert snapshot_schema.run(Project(REPO)) == []
+    from veneur_tpu.persistence import codec
+    monkeypatch.setitem(codec._SCHEMA_PINS,
+                        codec.SNAPSHOT_FORMAT_VERSION, "bogus")
+    drifted = snapshot_schema.run(Project(REPO))
+    assert len(drifted) == 1 and "DRIFT" in drifted[0].message
+
+
+# -- 2. framework self-coverage ---------------------------------------------
+
+def test_alias_resolution(tmp_path):
+    proj = _project(tmp_path, {"m.py": """
+        import numpy as np
+        import jax.numpy as jnp
+        from os import path as p
+        from x import y as z
+    """})
+    ctx = proj.file("m.py")
+    assert ctx.aliases["np"] == "numpy"
+    assert ctx.aliases["jnp"] == "jax.numpy"
+    assert ctx.aliases["p"] == "os.path"
+    assert ctx.aliases["z"] == "x.y"
+    expr = lambda s: ast.parse(s).body[0].value
+    assert ctx.resolve(expr("np.empty")) == "numpy.empty"
+    assert ctx.resolve(expr("jnp.asarray")) == "jax.numpy.asarray"
+    assert ctx.resolve(expr("z")) == "x.y"
+    assert ctx.resolve(expr("unaliased.f")) == "unaliased.f"
+
+
+def test_suppression_same_line_and_line_above(tmp_path):
+    proj = _project(tmp_path, {"pkg/sink.py": """
+        def flush(batch):
+            try:
+                batch.send()
+            except Exception:  # vtlint: disable=bare-except -- fixture: testing suppression
+                pass
+        def flush2(batch):
+            try:
+                batch.send()
+            # vtlint: disable=bare-except -- covers the next line
+            except Exception:
+                pass
+    """})
+    found = filter_suppressed(proj, bare_except.run(proj, egress=["pkg"]))
+    assert found == []
+    assert reasonless_suppressions(proj) == []
+
+
+def test_suppression_without_reason_is_itself_reported(tmp_path):
+    proj = _project(tmp_path, {"pkg/sink.py": """
+        def flush(batch):
+            try:
+                batch.send()
+            except Exception:  # vtlint: disable=bare-except
+                pass
+    """})
+    assert filter_suppressed(
+        proj, bare_except.run(proj, egress=["pkg"])) == []
+    missing = reasonless_suppressions(proj)
+    assert len(missing) == 1 and missing[0].pass_name == "vtlint"
+
+
+def test_suppression_is_per_pass(tmp_path):
+    """Disabling one pass does not silence another on the same line."""
+    proj = _project(tmp_path, {"pkg/sink.py": """
+        def flush(batch):
+            try:
+                batch.send()
+            except Exception:  # vtlint: disable=jax-hot-path -- wrong pass name
+                pass
+    """})
+    found = filter_suppressed(proj, bare_except.run(proj, egress=["pkg"]))
+    assert len(found) == 1
+
+
+def test_registered_hot_function_missing_is_an_error(tmp_path):
+    """A renamed hot function must fail the lint, not shrink its
+    surface silently; same for a moved file."""
+    proj = _project(tmp_path, {"pkg/mod.py": "def other():\n    pass\n"})
+    found = hot_path_alloc.run(proj, hot_funcs={"pkg/mod.py": ["pump"]})
+    assert any("not found" in f.message for f in found)
+    found = hot_path_alloc.run(proj, hot_funcs={"pkg/gone.py": []})
+    assert any("file missing" in f.message for f in found)
+
+
+def test_one_parse_per_file(tmp_path):
+    """Multiple passes over the same file share one AST parse."""
+    proj = _project(tmp_path, {"pkg/ingest.py": """
+        import queue
+        def enqueue(q, item, stats):
+            try:
+                q.put_nowait(item)
+            except queue.Full:
+                stats.dropped += 1
+    """})
+    drop_accounting.run(proj, targets=["pkg"], required_counters=[])
+    accounting_flow.run(proj, targets=["pkg"], send_targets={})
+    bare_except.run(proj, egress=["pkg"])
+    assert proj.parse_count == 1
+
+
+def test_run_passes_json_schema_stability(tmp_path):
+    """bench.py and any CI consumer key off this exact shape."""
+    proj = _project(tmp_path, {"pkg/mod.py": "x = 1\n"})
+    result = run_passes(proj, ["bare-except"])
+    assert set(result) == {"version", "root", "passes", "findings",
+                           "files_parsed", "parse_count", "runtime_s",
+                           "ok"}
+    assert result["version"] == 1 and result["ok"] is True
+    assert [set(row) for row in result["passes"]] == [
+        {"name", "doc", "findings", "runtime_s"}]
+
+
+def test_registry_covers_all_nine_passes():
+    assert list(PASSES) == [
+        "hot-path-alloc", "drop-accounting", "ambiguous-paths",
+        "bare-except", "metric-names", "snapshot-schema",
+        "jax-hot-path", "lock-discipline", "accounting-flow"]
+    for name, mod in PASSES.items():
+        assert mod.NAME == name and mod.DOC
+
+
+def test_fixed_counter_races_stay_fixed():
+    """Pins for this PR's fixes, independent of the full gate: the UDP
+    reader and proxy counter read-modify-writes stay under their locks,
+    and the sharded HLL import merge stays device-side."""
+    proj = Project(REPO)
+    assert lock_discipline.run(proj, modules=[
+        "veneur_tpu/server/server.py",
+        "veneur_tpu/forward/proxysrv.py"]) == []
+    assert jax_hot_path.run(
+        proj,
+        hot_funcs={"veneur_tpu/server/sharded_aggregator.py":
+                   ["_apply_hll_imports"]},
+        donating_jits={}, sync_scan=[]) == []
+
+
+# -- 3. the tier-1 gate ------------------------------------------------------
+
+def test_vtlint_all_gate():
+    """`--all` runs every pass against this repo in one process and
+    exits 0: the single lint gate replacing six per-script subprocess
+    tests."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "veneur_tpu.analysis", "--all", "--json"],
+        capture_output=True, text=True, cwd=REPO, timeout=300,
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    data = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert data["ok"] is True and data["findings"] == []
+    assert len(data["passes"]) >= 9
+    assert data["files_parsed"] == data["parse_count"] > 0

@@ -1,0 +1,82 @@
+"""vtlint pass: the per-batch pump/emit hot path stays allocation-free.
+
+Port of scripts/check_hot_path_alloc.py. The zero-copy ingest contract:
+once the pipeline is warm, moving a batch from the wire to the device
+performs NO per-batch Python-side allocation — staged lanes land in
+pre-allocated double-buffered flat host buffers and every array the
+dispatch touches is a view or a reused buffer. `np.zeros` is allowed
+(the packed-layout contract requires zero-initialized buffers at
+allocation time, and none of the hot functions allocate at all).
+
+Now alias-aware: `import numpy as xp; xp.empty(...)` is caught too.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List
+
+from veneur_tpu.analysis.core import Finding, Project
+
+NAME = "hot-path-alloc"
+DOC = ("per-batch hot functions stay allocation-free "
+       "(no .copy()/np.empty/np.concatenate/np.stack)")
+
+# {file: functions that run once per batch (or per datagram) when warm}
+HOT_FUNCS: Dict[str, List[str]] = {
+    "veneur_tpu/server/native_aggregator.py": [
+        "_emit_native", "feed", "pump", "_split_shards"],
+    "veneur_tpu/aggregation/step.py": ["pack_batch"],
+    "veneur_tpu/server/aggregator.py": ["_on_batch"],
+    "veneur_tpu/server/sharded_aggregator.py": ["_dispatch_row"],
+}
+
+# numpy constructors that allocate a fresh array per call
+_NP_ALLOCS = ("empty", "concatenate", "stack")
+
+
+def _violations_in(ctx, fn: ast.AST) -> List[Finding]:
+    problems = []
+    for node in ast.walk(fn):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)):
+            continue
+        attr = node.func.attr
+        if attr == "copy":
+            problems.append(Finding(
+                NAME, ctx.rel, node.lineno,
+                f"`.copy()` in hot-path function {fn.name}() — use the "
+                "pre-allocated packed buffer"))
+        elif attr in _NP_ALLOCS:
+            if ctx.resolve(node.func) == f"numpy.{attr}":
+                problems.append(Finding(
+                    NAME, ctx.rel, node.lineno,
+                    f"`np.{attr}` in hot-path function {fn.name}() — "
+                    "per-batch allocation; move it to an _alloc_* init "
+                    "helper"))
+    return problems
+
+
+def run(project: Project, hot_funcs: Dict[str, List[str]] = None
+        ) -> List[Finding]:
+    findings: List[Finding] = []
+    for rel, funcs in (hot_funcs or HOT_FUNCS).items():
+        ctx = project.file(rel)
+        if ctx is None:
+            findings.append(Finding(
+                NAME, rel, 0, "file missing — update HOT_FUNCS"))
+            continue
+        seen = set()
+        for node in ast.walk(ctx.tree):
+            if (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and node.name in funcs):
+                seen.add(node.name)
+                findings.extend(_violations_in(ctx, node))
+        for name in funcs:
+            if name not in seen:
+                findings.append(Finding(
+                    NAME, rel, 0,
+                    f"hot-path function {name}() not found — renamed? "
+                    "update HOT_FUNCS in veneur_tpu/analysis/"
+                    "hot_path_alloc.py"))
+    return findings

@@ -1,0 +1,270 @@
+"""vtlint pass: no hidden host syncs or jit-boundary hazards in the
+warm per-batch/per-flush functions.
+
+The ingest arc's perf contract: once warm, a batch crosses the host ->
+device boundary exactly once (the packed h2d feed) and nothing on the
+pipeline thread ever waits on the device. Three regression classes this
+pass catches mechanically:
+
+1. **Implicit host syncs on device values** — `float()` / `int()` /
+   `bool()` / `np.asarray()` / `np.array()` / `.item()` / `.tolist()`
+   applied to a traced or device-derived value blocks the caller until
+   every queued device computation lands (the exact bug fixed in
+   sharded _apply_hll_imports: `np.array(self.state.hll)` stalled
+   swap() — and therefore ingest — behind the full step backlog).
+   Host-side numpy values are fine; a cheap taint walk tells them
+   apart: device roots are `self.state` / a `state` parameter, any
+   `jax.*`/`jax.numpy.*` call result, and locals assigned from either.
+2. **Python branching on traced values** — an `if`/`while` whose test
+   touches a device value is a host sync in disguise.
+3. **Jit-boundary hazards** — `jax.block_until_ready` in production
+   code (bench/deliberate drain points carry a reasoned suppression);
+   the donating jit wrappers losing their `donate_argnums`/
+   `donate_argnames` (the donation contract the double-buffered packed
+   feed depends on — without it every step copies DeviceState); and
+   call sites passing list/dict/set literals for the static `spec`/
+   `sizes` args of the jitted family (unhashable statics throw at
+   trace time; a fresh tuple per call recompiles).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from veneur_tpu.analysis.core import FileContext, Finding, Project
+
+NAME = "jax-hot-path"
+DOC = ("warm per-batch/per-flush functions contain no implicit host "
+       "syncs, traced-value branching, or jit-boundary hazards")
+
+# the hot-path-alloc set, extended with the per-flush warm paths that
+# run on (and block) the pipeline thread
+HOT_FUNCS: Dict[str, List[str]] = {
+    "veneur_tpu/server/native_aggregator.py": [
+        "_emit_native", "feed", "pump", "_split_shards"],
+    "veneur_tpu/aggregation/step.py": ["pack_batch"],
+    "veneur_tpu/server/aggregator.py": [
+        "_on_batch", "_flush_hll_imports", "swap"],
+    "veneur_tpu/server/sharded_aggregator.py": [
+        "_dispatch_row", "_on_shard_batch", "_emit_all",
+        "_apply_hll_imports", "swap"],
+}
+
+# named jit wrappers that MUST donate their state argument: dropping
+# donate_argnums/donate_argnames silently doubles per-step HBM traffic
+DONATING_JITS: Dict[str, List[str]] = {
+    "veneur_tpu/aggregation/step.py": [
+        "ingest_step", "ingest_step_packed", "compact"],
+}
+
+# static parameters of the jitted family: a list/dict/set literal here
+# is unhashable (TypeError at trace time)
+STATIC_ARG_NAMES = ("spec", "sizes")
+JITTED_CALLEES = ("ingest_step", "packed_step", "compact",
+                  "flush_compute", "quantile_compute")
+
+# files scanned for stray block_until_ready (bench code lives under
+# benchmarks/ and is out of scope by construction)
+SYNC_SCAN = ["veneur_tpu"]
+
+_HOST_CONVERTERS = ("float", "int", "bool")
+_NP_CONVERTERS = ("numpy.asarray", "numpy.array")
+_SYNC_METHODS = ("item", "tolist")
+
+
+def _is_tainted(node: ast.AST, ctx: FileContext,
+                tainted: Set[str]) -> bool:
+    """Does this expression derive from a device value?"""
+    if isinstance(node, ast.Name):
+        return node.id in tainted
+    if isinstance(node, ast.Attribute):
+        # self.state (and anything hanging off it) is the device root
+        if ctx.dotted(node) in ("self.state", "state"):
+            return True
+        return _is_tainted(node.value, ctx, tainted)
+    if isinstance(node, ast.Subscript):
+        return _is_tainted(node.value, ctx, tainted)
+    if isinstance(node, ast.Call):
+        fn = node.func
+        resolved = ctx.resolve(fn)
+        if resolved and (resolved.startswith("jax.numpy.")
+                         or resolved.startswith("jax.")):
+            return True
+        # method call on a tainted object stays tainted
+        # (state.hll.at[...].max(rows), self._ingest(self.state, ...))
+        if isinstance(fn, ast.Attribute) \
+                and _is_tainted(fn.value, ctx, tainted):
+            return True
+        return any(_is_tainted(a, ctx, tainted) for a in node.args)
+    if isinstance(node, ast.BinOp):
+        return (_is_tainted(node.left, ctx, tainted)
+                or _is_tainted(node.right, ctx, tainted))
+    if isinstance(node, (ast.Compare,)):
+        return (_is_tainted(node.left, ctx, tainted)
+                or any(_is_tainted(c, ctx, tainted)
+                       for c in node.comparators))
+    if isinstance(node, ast.UnaryOp):
+        return _is_tainted(node.operand, ctx, tainted)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return any(_is_tainted(e, ctx, tainted) for e in node.elts)
+    return False
+
+
+def _check_hot_fn(ctx: FileContext, fn) -> List[Finding]:
+    findings: List[Finding] = []
+    tainted: Set[str] = set()
+    # a parameter literally named `state` is device state by convention
+    for arg in fn.args.args:
+        if arg.arg == "state":
+            tainted.add("state")
+
+    for node in ast.walk(fn):
+        # grow the taint set: locals assigned from device expressions
+        if isinstance(node, ast.Assign) \
+                and _is_tainted(node.value, ctx, tainted):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    tainted.add(t.id)
+        elif isinstance(node, (ast.If, ast.While)) \
+                and _is_tainted(node.test, ctx, tainted):
+            findings.append(Finding(
+                NAME, ctx.rel, node.lineno,
+                f"Python branch on a traced/device value in hot "
+                f"function {fn.name}() — forces a blocking "
+                "device->host sync per batch; compute the predicate "
+                "on host state or inside the jitted step"))
+        elif isinstance(node, ast.Call):
+            fname = node.func
+            resolved = ctx.resolve(fname)
+            if resolved in _HOST_CONVERTERS and len(node.args) >= 1 \
+                    and _is_tainted(node.args[0], ctx, tainted):
+                findings.append(Finding(
+                    NAME, ctx.rel, node.lineno,
+                    f"`{resolved}()` on a device value in hot function "
+                    f"{fn.name}() — implicit blocking transfer"))
+            elif resolved in _NP_CONVERTERS and node.args \
+                    and _is_tainted(node.args[0], ctx, tainted):
+                findings.append(Finding(
+                    NAME, ctx.rel, node.lineno,
+                    f"`{resolved.replace('numpy', 'np')}` on a device "
+                    f"value in hot function {fn.name}() — full "
+                    "device->host materialization blocks on every "
+                    "queued step; keep the merge on device"))
+            elif isinstance(fname, ast.Attribute) \
+                    and fname.attr in _SYNC_METHODS \
+                    and _is_tainted(fname.value, ctx, tainted):
+                findings.append(Finding(
+                    NAME, ctx.rel, node.lineno,
+                    f"`.{fname.attr}()` on a device value in hot "
+                    f"function {fn.name}() — implicit blocking "
+                    "transfer"))
+    return findings
+
+
+def _check_jit_decls(project: Project,
+                     donating: Dict[str, List[str]]) -> List[Finding]:
+    findings: List[Finding] = []
+    for rel, names in donating.items():
+        ctx = project.file(rel)
+        if ctx is None:
+            findings.append(Finding(
+                NAME, rel, 0, "file missing — update DONATING_JITS"))
+            continue
+        seen = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id in names:
+                        seen[t.id] = node
+        for name in names:
+            node = seen.get(name)
+            if node is None:
+                findings.append(Finding(
+                    NAME, rel, 0,
+                    f"donating jit wrapper {name} not found — renamed? "
+                    "update DONATING_JITS in veneur_tpu/analysis/"
+                    "jax_hot_path.py"))
+                continue
+            donates = any(
+                kw.arg in ("donate_argnums", "donate_argnames")
+                for call in ast.walk(node.value)
+                if isinstance(call, ast.Call)
+                for kw in call.keywords)
+            if not donates:
+                findings.append(Finding(
+                    NAME, rel, node.lineno,
+                    f"{name} lost its donate_argnums/donate_argnames — "
+                    "the packed feed's in-place DeviceState update "
+                    "becomes a full copy per step"))
+    return findings
+
+
+def _check_static_args(ctx: FileContext) -> List[Finding]:
+    findings: List[Finding] = []
+    unhashable = (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                  ast.DictComp, ast.SetComp)
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        resolved = ctx.resolve(node.func)
+        leaf = (resolved or "").rsplit(".", 1)[-1]
+        if leaf not in JITTED_CALLEES:
+            continue
+        for kw in node.keywords:
+            if kw.arg in STATIC_ARG_NAMES \
+                    and isinstance(kw.value, unhashable):
+                findings.append(Finding(
+                    NAME, ctx.rel, node.lineno,
+                    f"{leaf}({kw.arg}=...) passes an unhashable "
+                    f"{type(kw.value).__name__.lower()} literal for a "
+                    "static jit arg — TypeError at trace time; pass a "
+                    "hashable (tuple/NamedTuple) spec"))
+    return findings
+
+
+def _check_block_until_ready(ctx: FileContext) -> List[Finding]:
+    findings = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "block_until_ready":
+            findings.append(Finding(
+                NAME, ctx.rel, node.lineno,
+                "block_until_ready outside bench code — a deliberate "
+                "full-device drain; if intended, suppress with a "
+                "reason"))
+    return findings
+
+
+def run(project: Project, hot_funcs: Dict[str, List[str]] = None,
+        donating_jits: Dict[str, List[str]] = None,
+        sync_scan: List[str] = None) -> List[Finding]:
+    findings: List[Finding] = []
+    for rel, funcs in (hot_funcs or HOT_FUNCS).items():
+        ctx = project.file(rel)
+        if ctx is None:
+            findings.append(Finding(
+                NAME, rel, 0, "file missing — update HOT_FUNCS"))
+            continue
+        seen = set()
+        for node in ast.walk(ctx.tree):
+            if (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and node.name in funcs):
+                seen.add(node.name)
+                findings.extend(_check_hot_fn(ctx, node))
+        for name in funcs:
+            if name not in seen:
+                findings.append(Finding(
+                    NAME, rel, 0,
+                    f"hot function {name}() not found — renamed? "
+                    "update HOT_FUNCS in veneur_tpu/analysis/"
+                    "jax_hot_path.py"))
+        findings.extend(_check_static_args(ctx))
+    findings.extend(_check_jit_decls(
+        project, donating_jits if donating_jits is not None
+        else DONATING_JITS))
+    for ctx in project.files(*(sync_scan if sync_scan is not None
+                               else SYNC_SCAN)):
+        findings.extend(_check_block_until_ready(ctx))
+    return findings

@@ -1,0 +1,53 @@
+"""vtlint pass: the checkpoint snapshot schema cannot drift silently.
+
+Port of scripts/check_snapshot_schema.py. Unlike the AST passes this one
+runs the live code: the on-disk checkpoint format
+(veneur_tpu/persistence/codec.py) pins a hash over the structures its
+meaning depends on — DeviceState's field list and TableSpec's field
+names — and this pass compares the live hash against the pin for the
+current SNAPSHOT_FORMAT_VERSION. A mismatch means old checkpoints would
+be misread: bump the version, pin the new hash, and decide whether
+read_manifest rejects or migrates the previous version.
+
+Runs only against the installed veneur_tpu package (a --root pointed at
+a fixture tree skips it: there is nothing to import there).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List
+
+from veneur_tpu.analysis.core import REPO, Finding, Project
+
+NAME = "snapshot-schema"
+DOC = ("live schema_hash() matches the pinned hash for "
+       "SNAPSHOT_FORMAT_VERSION")
+
+CODEC_REL = "veneur_tpu/persistence/codec.py"
+
+
+def run(project: Project) -> List[Finding]:
+    if project.root != REPO and not project.exists(CODEC_REL):
+        return []   # fixture tree: nothing to import
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from veneur_tpu.persistence.codec import (SNAPSHOT_FORMAT_VERSION,
+                                              _SCHEMA_PINS, schema_hash)
+    live = schema_hash()
+    pinned = _SCHEMA_PINS.get(SNAPSHOT_FORMAT_VERSION)
+    if pinned is None:
+        return [Finding(
+            NAME, CODEC_REL, 0,
+            f"SNAPSHOT_FORMAT_VERSION={SNAPSHOT_FORMAT_VERSION} has no "
+            f"pin in codec._SCHEMA_PINS — add one: "
+            f"{SNAPSHOT_FORMAT_VERSION}: \"{live}\"")]
+    if live != pinned:
+        return [Finding(
+            NAME, CODEC_REL, 0,
+            f"snapshot schema DRIFTED (pinned {pinned}, live {live}). "
+            "DeviceState._fields or TableSpec changed shape; old "
+            "checkpoints would be misread. Bump "
+            "SNAPSHOT_FORMAT_VERSION, pin the new hash in "
+            "_SCHEMA_PINS, and decide what read_manifest does with "
+            "the previous version: reject (default) or migrate")]
+    return []

@@ -129,6 +129,12 @@ class ProxyServer:
         self.http_port = None
         self.forwarded = 0
         self.errors = 0
+        # counter lock: handle()/_deliver_enveloped() run on gRPC/HTTP
+        # worker threads concurrently with each other and with the stats
+        # emitter; bare `+=` on these ints loses increments. _bump() is
+        # the single mutation path. Narrower than self._lock so counter
+        # bumps never contend with ring rebuilds or connection setup.
+        self._stats_lock = threading.Lock()
         # per-(destination, protocol) forwarded-metric counts — the
         # reference's metrics_by_destination self-metric
         # (proxysrv/server.go:299-301 grpc, proxy.go:651-653 http). The
@@ -256,24 +262,24 @@ class ProxyServer:
             key = f"{m.name}{m.type}{','.join(m.tags)}".encode()
             dest = ring.get(key)
             if dest is None:
-                self.errors += 1
+                self._bump("errors")
                 continue
             by_dest.setdefault(dest, []).append(m)
         for dest, batch in by_dest.items():
             breaker = self._breaker(dest)
             if breaker is not None and not breaker.allow():
-                self.errors += len(batch)
-                self.rejected_open += len(batch)
+                self._bump("errors", len(batch))
+                self._bump("rejected_open", len(batch))
                 continue
             try:
                 FAULTS.inject(PROXY_FORWARD, name=dest)
                 self._conn(dest).send_metrics(batch)
-                self.forwarded += len(batch)
+                self._bump("forwarded", len(batch))
                 self._count_dest(dest, "grpc", len(batch))
                 if breaker is not None:
                     breaker.record_success()
             except Exception as e:
-                self.errors += len(batch)
+                self._bump("errors", len(batch))
                 if breaker is not None:
                     breaker.record_failure()
                 log.warning("proxy forward to %s failed: %s", dest, e)
@@ -289,10 +295,10 @@ class ProxyServer:
         try:
             verdict = self._done.peek(envelope)
         except EnvelopeError:
-            self.envelope_rejected += 1
+            self._bump("envelope_rejected")
             raise
         if verdict != FRESH:
-            self.dup_suppressed += 1
+            self._bump("dup_suppressed")
             return True
         key = (protocol, envelope.source_id, envelope.epoch, envelope.seq)
         # _routing_ring acquires self._lock internally: call it before
@@ -305,7 +311,7 @@ class ProxyServer:
                 for it in items:
                     dest = ring.get(keyfn(it))
                     if dest is None:
-                        self.errors += 1
+                        self._bump("errors")
                         continue
                     stored.setdefault(dest, []).append(it)
                 self._inflight[key] = stored
@@ -319,14 +325,14 @@ class ProxyServer:
         for dest, batch in pending:
             breaker = self._breaker(dest)
             if breaker is not None and not breaker.allow():
-                self.errors += len(batch)
-                self.rejected_open += len(batch)
+                self._bump("errors", len(batch))
+                self._bump("rejected_open", len(batch))
                 failed += 1
                 continue
             try:
                 FAULTS.inject(PROXY_FORWARD, name=dest)
                 sendfn(dest, batch)
-                self.forwarded += len(batch)
+                self._bump("forwarded", len(batch))
                 self._count_dest(dest, protocol, len(batch))
                 if breaker is not None:
                     breaker.record_success()
@@ -334,7 +340,7 @@ class ProxyServer:
                     stored.pop(dest, None)
             except Exception as e:
                 failed += 1
-                self.errors += len(batch)
+                self._bump("errors", len(batch))
                 if breaker is not None:
                     breaker.record_failure()
                 log.warning("proxy forward to %s failed: %s", dest, e)
@@ -347,6 +353,13 @@ class ProxyServer:
         with self._inflight_lock:
             self._inflight.pop(key, None)
         return True
+
+    def _bump(self, attr: str, n: int = 1) -> None:
+        """Increment one of the plain-int stat counters under
+        _stats_lock — `self._bump("errors")` from two worker threads is a
+        read-modify-write that loses increments."""
+        with self._stats_lock:
+            setattr(self, attr, getattr(self, attr) + n)
 
     def _count_dest(self, dest: str, protocol: str, n: int) -> None:
         with self._lock:
@@ -366,7 +379,7 @@ class ProxyServer:
                    f"{jm.get('tagstring', '')}").encode()
             dest = ring.get(key)
             if dest is None:
-                self.errors += 1
+                self._bump("errors")
                 continue
             by_dest.setdefault(dest, []).append(jm)
         return by_dest
@@ -396,18 +409,18 @@ class ProxyServer:
         for dest, batch in self.handle_json(json_metrics).items():
             breaker = self._breaker(dest)
             if breaker is not None and not breaker.allow():
-                self.errors += len(batch)
-                self.rejected_open += len(batch)
+                self._bump("errors", len(batch))
+                self._bump("rejected_open", len(batch))
                 continue
             try:
                 FAULTS.inject(PROXY_FORWARD, name=dest)
                 self._post_import(dest, batch)
-                self.forwarded += len(batch)
+                self._bump("forwarded", len(batch))
                 self._count_dest(dest, "http", len(batch))
                 if breaker is not None:
                     breaker.record_success()
             except Exception as e:
-                self.errors += len(batch)
+                self._bump("errors", len(batch))
                 if breaker is not None:
                     breaker.record_failure()
                 log.warning("proxy POST to %s failed: %s", dest, e)
@@ -561,6 +574,7 @@ class ProxyServer:
                  for n, v, t in self.runtime_metrics()]
         with self._lock:
             counts = dict(self.metrics_by_destination)
+        with self._stats_lock:
             counts[("", "error")] = self.errors
             counts[("", "dup")] = self.dup_suppressed
             counts[("", "rej")] = self.envelope_rejected
@@ -590,7 +604,7 @@ class ProxyServer:
     # -- lifecycle ----------------------------------------------------------
     def start(self, address: str = "127.0.0.1:0"):
         def _count_reject():
-            self.envelope_rejected += 1
+            self._bump("envelope_rejected")
         self._grpc, self.port = serve(
             self.handle, address, with_metadata=self._done is not None,
             on_reject=_count_reject)

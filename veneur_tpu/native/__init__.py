@@ -32,10 +32,17 @@ def _build_and_load():
     try:
         with open(_SRC, "rb") as f:
             digest = hashlib.sha256(f.read()).hexdigest()[:16]
-        so_path = os.path.join(_DIR, f"_dogstatsd_{digest}.so")
+        # VENEUR_NATIVE_SANITIZE=1 builds with ASan+UBSan under a
+        # distinct cache name so sanitized and plain processes never
+        # race for the same .so. The loading process must arrange for
+        # libasan to be resolvable (LD_PRELOAD under a non-instrumented
+        # python) — see tests/test_native_sanitize.py.
+        sanitize = os.environ.get("VENEUR_NATIVE_SANITIZE") == "1"
+        prefix = "_dogstatsd_san_" if sanitize else "_dogstatsd_"
+        so_path = os.path.join(_DIR, f"{prefix}{digest}.so")
         if not os.path.exists(so_path):
             for stale in os.listdir(_DIR):
-                if (stale.startswith("_dogstatsd_")
+                if (stale.startswith(prefix)
                         and stale.endswith(".so")
                         and stale != os.path.basename(so_path)):
                     try:
@@ -45,10 +52,14 @@ def _build_and_load():
             # temp + rename so a concurrent process never dlopens a
             # half-written ELF
             tmp_path = f"{so_path}.{os.getpid()}.tmp"
-            subprocess.run(
-                ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
-                 "-o", tmp_path, _SRC],
-                check=True, capture_output=True, timeout=120)
+            cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                   "-pthread"]
+            if sanitize:
+                cmd += ["-g", "-fsanitize=address,undefined",
+                        "-fno-sanitize-recover=all",
+                        "-fno-omit-frame-pointer"]
+            subprocess.run(cmd + ["-o", tmp_path, _SRC],
+                           check=True, capture_output=True, timeout=120)
             os.replace(tmp_path, so_path)
         lib = ctypes.CDLL(so_path)
         lib.vt_new.restype = ctypes.c_void_p

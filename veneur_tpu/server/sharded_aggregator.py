@@ -293,19 +293,26 @@ class ShardedAggregator(Aggregator):
         self._dispatch_row([b.force_emit() for b in self.batchers])
 
     def _apply_hll_imports(self):
-        """Imported HLL rows merge host-side then re-place sharded (rare
-        path: only a global tier with sharded state receives these)."""
+        """Imported HLL rows merge on-device via scatter-max (rare path:
+        only a global tier with sharded state receives these). Runs on
+        the pipeline thread out of swap(), so it must not materialize
+        the [1, S, K, R] table on host — that blocks behind every queued
+        ingest step. Scatter-max handles duplicate (shard, local) slots
+        identically to a sequential merge: max is order-free."""
         if not self._hll_slots:
             return
         import jax
         import jax.numpy as jnp
         from veneur_tpu.parallel.sharded import state_sharding
 
-        hll = np.array(self.state.hll)   # [1, S, K, R] host copy
-        for (shard, local), regs in zip(self._hll_slots, self._hll_rows):
-            hll[0, shard, local] = np.maximum(hll[0, shard, local], regs)
-        self.state = self.state._replace(hll=jax.device_put(
-            jnp.asarray(hll), state_sharding(self.mesh)))
+        sh = jnp.asarray(np.array([s for s, _ in self._hll_slots],
+                                  np.int32))
+        loc = jnp.asarray(np.array([l for _, l in self._hll_slots],
+                                   np.int32))
+        rows = jnp.asarray(np.stack(self._hll_rows).astype(np.uint8))
+        hll = self.state.hll.at[0, sh, loc].max(rows, mode="drop")
+        self.state = self.state._replace(
+            hll=jax.device_put(hll, state_sharding(self.mesh)))
         self._hll_slots, self._hll_rows = [], []
 
     # -- flush ---------------------------------------------------------------
