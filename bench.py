@@ -460,6 +460,28 @@ def pallas_main():
         err = float(np.max(np.abs(got - ref) / scale))
         out["pallas_parity_max_rel_err"] = round(err, 6)
         out["pallas_parity_ok"] = err < 1e-3
+
+    # fused INGEST kernel (ops/pallas_ingest.py): rows/sec vs the XLA
+    # scatter chain, recorded into the same artifact stage. The ≥1.5x
+    # gate ARMS only on a real accelerator — on CPU the kernel runs in
+    # interpret mode (the parity oracle, not a production path), so the
+    # ratio is recorded but not judged; when the TPU tunnel returns the
+    # gate fires unattended on the next bench run (ROADMAP standing
+    # constraint).
+    phase("pallas_ingest")
+    from benchmarks.micro import bench_hll_hbm_bytes, bench_ingest_fused
+    from veneur_tpu.ops import pallas_ingest as pi
+    out["pallas_ingest_enabled"] = bool(pi.enabled())
+    ing = bench_ingest_fused(4.0)
+    for k in ("ingest_fused_rows_per_sec", "ingest_chain_rows_per_sec",
+              "fused_vs_chain", "interpret_mode"):
+        out[k] = ing[k]
+    out.update(bench_hll_hbm_bytes(0))
+    armed = dev.platform != "cpu"
+    out["ingest_gate_armed"] = armed
+    if armed:
+        out["ingest_gate_ok"] = ing["fused_vs_chain"] >= 1.5
+    out["hll_hbm_gate_ok"] = out["hll_hbm_bytes_ratio"] >= 4.0
     print(json.dumps(out))
 
 

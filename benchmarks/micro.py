@@ -478,6 +478,174 @@ def bench_tdigest_quantile(seconds):
     return _timeit(run, seconds)
 
 
+# -- fused device ingest (ops/pallas_ingest.py) ------------------------------
+
+def bench_ingest_fused(seconds):
+    """Fused Pallas ingest kernel vs the XLA scatter chain it replaces,
+    rows/sec over identical random batches. On CPU the kernel runs in
+    interpret mode — correct but slow (it exists there for parity, not
+    speed) — so the ≥1.5x gate in bench.py arms only on a real
+    accelerator; this micro always reports both columns so the artifact
+    carries the comparison either way."""
+    import jax
+    import jax.numpy as jnp
+    from functools import partial
+    from veneur_tpu.aggregation import step
+    from veneur_tpu.aggregation.state import TableSpec, empty_state
+    from veneur_tpu.ops import pallas_ingest
+
+    spec = TableSpec(counter_capacity=1 << 13, gauge_capacity=1 << 11,
+                     status_capacity=1 << 8, set_capacity=1 << 8,
+                     histo_capacity=1 << 11)
+    n = 4096
+    rng = np.random.default_rng(11)
+
+    def slots(cap):
+        return jnp.asarray(rng.integers(0, cap + 1, n).astype(np.int32))
+
+    batch = step.Batch(
+        counter_slot=slots(spec.counter_capacity),
+        counter_inc=jnp.asarray(rng.normal(size=n).astype(np.float32)),
+        gauge_slot=slots(spec.gauge_capacity),
+        gauge_val=jnp.asarray(rng.normal(size=n).astype(np.float32)),
+        status_slot=slots(spec.status_capacity),
+        status_val=jnp.asarray(rng.normal(size=n).astype(np.float32)),
+        set_slot=slots(spec.set_capacity),
+        set_reg=jnp.asarray(
+            rng.integers(0, spec.registers, n).astype(np.int32)),
+        set_rho=jnp.asarray(rng.integers(0, 50, n).astype(np.uint8)),
+        histo_slot=slots(spec.histo_capacity),
+        histo_val=jnp.asarray((rng.normal(size=n) * 3 + 8)
+                              .astype(np.float32)),
+        histo_wt=jnp.asarray(rng.uniform(0.5, 2.0, n).astype(np.float32)))
+    rows = 5 * n
+    interp = pallas_ingest.interpret_mode()
+
+    chain = jax.jit(partial(step.ingest_core, spec=spec,
+                            allow_pallas=False))
+
+    def fused_core(state, b):
+        return step._fold_core(pallas_ingest.fused_ingest_core(
+            state, b, spec=spec, interpret=interp))
+
+    fused = jax.jit(fused_core)
+    state = empty_state(spec)
+
+    def measure(f):
+        jax.block_until_ready(f(state, batch))
+        return _timeit(
+            lambda: jax.block_until_ready(f(state, batch)),
+            seconds / 2, batch=rows)
+
+    chain_iters, chain_ns = measure(chain)
+    fused_iters, fused_ns = measure(fused)
+    chain_rps = 1e9 / chain_ns
+    fused_rps = 1e9 / fused_ns
+    return {
+        "iters": fused_iters,
+        "ns_per_op": round(fused_ns, 1),
+        "ops_per_sec": round(fused_rps, 1),
+        "ingest_fused_rows_per_sec": round(fused_rps, 1),
+        "ingest_chain_rows_per_sec": round(chain_rps, 1),
+        "fused_vs_chain": round(fused_rps / chain_rps, 3),
+        "interpret_mode": interp,
+        "platform": jax.devices()[0].platform,
+    }
+
+
+def bench_hll_hbm_bytes(seconds):
+    """Per-set-key HLL footprint at the default precision: dense u8
+    registers, the 6-bit packed resident layout, and the i32-materialized
+    register array the XLA scatter chain streams as its operand (scatter
+    widens u8 to i32 — the number HBM traffic actually scaled with).
+    Footprint columns are arithmetic (recorded so the artifact pins
+    the ≥4x claim); the timed op is one packed-row host unpack."""
+    from veneur_tpu.ops import hll
+    p = hll.DEFAULT_PRECISION
+    m = hll.num_registers(p)
+    dense_u8 = m
+    packed = hll.packed_words(p) * 4
+    i32_scatter_operand = m * 4
+    rng = np.random.default_rng(3)
+    row = hll.pack_registers_np(
+        rng.integers(0, 60, size=m).astype(np.uint8), p)
+    iters, ns = _timeit(lambda: hll.unpack_registers_np(row, p),
+                        seconds / 4)
+    return {
+        "iters": iters,
+        "ns_per_op": round(ns, 1),
+        "ops_per_sec": round(1e9 / ns, 1),
+        "precision": p,
+        "hll_dense_u8_bytes": dense_u8,
+        "hll_packed_bytes": packed,
+        "hll_i32_scatter_operand_bytes": i32_scatter_operand,
+        "hll_hbm_bytes_ratio": round(i32_scatter_operand / packed, 3),
+        "packed_vs_dense_u8": round(dense_u8 / packed, 3),
+    }
+
+
+def bench_hll_codec_roundtrip(seconds):
+    """Wire codec round-trip after the vectorized _deserialize_axiomhq
+    (ops/hll.py): dense nibble form serialize+deserialize ops/sec, sparse
+    varint-list decode ops/sec, and the sparse decode's speedup over the
+    per-key Python loop it replaced (kept inline here as the reference)."""
+    from veneur_tpu.ops import hll
+
+    rng = np.random.default_rng(5)
+    p = hll.DEFAULT_PRECISION
+    regs = np.zeros(1 << p, np.uint8)
+    live = rng.choice(1 << p, 3000, replace=False)
+    regs[live] = rng.integers(1, 15, size=3000).astype(np.uint8)
+    wire = hll.serialize(regs, p)
+    dense_iters, dense_ns = _timeit(
+        lambda: hll.deserialize(wire), seconds / 3)
+
+    # sparse payload: tmpSet + delta-varint compressedList (axiomhq
+    # sparse.go layout, same construction as tests/test_hll.py)
+    keys = np.unique(rng.integers(0, 1 << 25, 4000)) << 1
+    keys |= (np.arange(keys.shape[0]) % 8 == 0)  # some rho-bearing keys
+    keys = np.sort(keys)
+    tmp, lst = keys[::2], keys[1::2]
+    payload = bytes([1, p, 0, 1]) + len(tmp).to_bytes(4, "big")
+    payload += b"".join(int(k).to_bytes(4, "big") for k in tmp)
+    body, last = b"", 0
+    for k in (int(x) for x in lst):
+        d = k - last
+        while d & ~0x7F:
+            body += bytes([(d & 0x7F) | 0x80])
+            d >>= 7
+        body += bytes([d & 0x7F])
+        last = k
+    payload += (len(lst).to_bytes(4, "big") + last.to_bytes(4, "big")
+                + len(body).to_bytes(4, "big") + body)
+    sparse_iters, sparse_ns = _timeit(
+        lambda: hll.deserialize(payload), seconds / 3)
+
+    def loop_decode():
+        # pre-vectorization shape: per-key python decode + register max
+        out = np.zeros(1 << p, np.uint8)
+        for k in keys:
+            reg, rho = hll._decode_sparse_hash(int(k), p)
+            if rho > out[reg]:
+                out[reg] = rho
+        return out
+
+    np.testing.assert_array_equal(loop_decode(),
+                                  hll.deserialize(payload)[1])
+    loop_iters, loop_ns = _timeit(loop_decode, seconds / 3)
+    return {
+        "iters": sparse_iters,
+        "ns_per_op": round(sparse_ns, 1),
+        "ops_per_sec": round(1e9 / sparse_ns, 1),
+        "dense_roundtrip_ns_per_op": round(dense_ns, 1),
+        "dense_roundtrip_ops_per_sec": round(1e9 / dense_ns, 1),
+        "sparse_decode_ns_per_op": round(sparse_ns, 1),
+        "sparse_decode_ops_per_sec": round(1e9 / sparse_ns, 1),
+        "sparse_keys": int(keys.shape[0]),
+        "speedup_vs_python_loop": round(loop_ns / sparse_ns, 2),
+    }
+
+
 # -- metric extraction (sinks/ssfmetrics/metrics_test.go:92) -----------------
 
 def bench_metric_extraction(seconds):
@@ -579,6 +747,9 @@ MICROS = {
     "import_metrics_native": bench_import_metrics_native,
     "import_decode_native": bench_import_decode_native,
     "proxy_route": bench_proxy_route,
+    "ingest_fused": bench_ingest_fused,
+    "hll_hbm_bytes": bench_hll_hbm_bytes,
+    "hll_codec_roundtrip": bench_hll_codec_roundtrip,
     "tdigest_add": bench_tdigest_add,
     "tdigest_quantile": bench_tdigest_quantile,
     "metric_extraction": bench_metric_extraction,

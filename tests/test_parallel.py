@@ -98,8 +98,13 @@ def test_merged_flush_replica_collectives():
         np.testing.assert_allclose(out["counter"][si], np.sum(per_rep, axis=0),
                                    rtol=1e-5, atol=1e-5)
         # HLL: union = register max, estimate must match single-table flush
-        # of the max-merged registers
-        hll_merged = np.maximum(*[np.asarray(t.hll) for t in tiles])
+        # of the max-merged registers. State rows are 6-bit packed words;
+        # register max happens in the dense domain (word-wise max of
+        # packed words is NOT register max).
+        from veneur_tpu.ops.hll import pack_registers_np, unpack_registers_np
+        p = SPEC.hll_precision
+        hll_merged = pack_registers_np(np.maximum(
+            *[unpack_registers_np(np.asarray(t.hll), p) for t in tiles]), p)
         ref_state = empty_state(SPEC)._replace(hll=jnp.asarray(hll_merged))
         ref_state = fold_scalars(ref_state)
         ref = _flush_full(compact(ref_state, spec=SPEC), qs, spec=SPEC)

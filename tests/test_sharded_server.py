@@ -216,16 +216,23 @@ def test_hll_import_merge_on_device_matches_host_reference():
     staged = list(zip(agg._hll_slots, agg._hll_rows))
     assert len(staged) == 4
     assert staged[0][0] == staged[1][0]
-    ref = np.asarray(agg.state.hll).copy()
+    # host reference merges in the dense register domain, then repacks:
+    # state rows are 6-bit packed words now, and register max must
+    # commute with the packing exactly
+    from veneur_tpu.ops.hll import pack_registers_np, unpack_registers_np
+    p = agg.pspec.hll_precision
+    ref = unpack_registers_np(np.asarray(agg.state.hll), p).copy()
     for (shard, local), regs in staged:
         ref[0, shard, local] = np.maximum(ref[0, shard, local], regs)
     agg._apply_hll_imports()
     assert agg._hll_slots == [] and agg._hll_rows == []
-    np.testing.assert_array_equal(np.asarray(agg.state.hll), ref)
+    np.testing.assert_array_equal(np.asarray(agg.state.hll),
+                                  pack_registers_np(ref, p))
     # a second wave on top of the merged state: max accumulates
     more = rng.integers(0, 30, size=n_regs).astype(np.uint8)
     agg.import_metric("set", "hll.a", (), 0, 1, {"registers": more})
     shard, local = agg._hll_slots[0]
     ref[0, shard, local] = np.maximum(ref[0, shard, local], more)
     agg._apply_hll_imports()
-    np.testing.assert_array_equal(np.asarray(agg.state.hll), ref)
+    np.testing.assert_array_equal(np.asarray(agg.state.hll),
+                                  pack_registers_np(ref, p))

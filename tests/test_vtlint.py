@@ -168,6 +168,50 @@ CASES = [
         """},
     ),
     (
+        # same pass, Pallas-kernel surface: pallas_call bodies are device
+        # code — Python branching on a Ref and float() host conversion
+        # must flag; @pl.when / fori_loop / keyword-only statics must not
+        "jax-hot-path",
+        lambda p: jax_hot_path.run(p, hot_funcs={}, donating_jits={},
+                                   sync_scan=[], pallas_scan=["pkg"]),
+        {"pkg/kern.py": """
+            import functools
+            from jax.experimental import pallas as pl
+
+            def _kern(x_ref, o_ref, *, n):
+                v = x_ref[0]
+                if v > 0:
+                    o_ref[0] = v
+                o_ref[1] = float(x_ref[1])
+
+            def launch(x):
+                return pl.pallas_call(
+                    functools.partial(_kern, n=4))(x)
+        """},
+        {"pkg/kern.py": """
+            import functools
+            import jax
+            from jax.experimental import pallas as pl
+
+            def _kern(x_ref, o_ref, *, n):
+                if n > 2:  # keyword-only param: a host static, fine
+                    pass
+
+                @pl.when(x_ref[0] > 0)
+                def _():
+                    o_ref[0] = x_ref[0]
+
+                def body(i, _):
+                    o_ref[i] = x_ref[i] * 2
+                    return 0
+                jax.lax.fori_loop(0, n, body, 0)
+
+            def launch(x):
+                return pl.pallas_call(
+                    functools.partial(_kern, n=4))(x)
+        """},
+    ),
+    (
         "lock-discipline",
         lambda p: lock_discipline.run(p, modules=["pkg/mod.py"]),
         {"pkg/mod.py": """

@@ -78,6 +78,14 @@ class TableSpec:
     def registers(self) -> int:
         return hll.num_registers(self.hll_precision)
 
+    @property
+    def hll_words(self) -> int:
+        """int32 words per set row in the resident 6-bit packed HLL layout
+        (ops/hll.py §packed); 3/8 of the register count — 12288 B/key vs
+        16384 B dense u8 (and vs 65536 B for the i32-materialized registers
+        an XLA scatter chain works over) at p=14."""
+        return hll.packed_words(self.hll_precision)
+
 
 class DeviceState(NamedTuple):
     """One flush interval's aggregation state. All arrays are per-slot;
@@ -95,8 +103,10 @@ class DeviceState(NamedTuple):
     gauge_stamp: jax.Array   # u8[Kg] 1 if written this interval
     status: jax.Array        # f32[Kst]
     status_stamp: jax.Array  # u8[Kst]
-    # sets
-    hll: jax.Array           # u8[Ks, R]
+    # sets: 6-bit packed registers, register r at bit 6r little-endian
+    # (ops/hll.py pack_registers; dense u8 exists only transiently in the
+    # XLA fallback insert and at host boundaries)
+    hll: jax.Array           # i32[Ks, W] where W = ceil(R*6/32)
     # histograms / timers: digest as (wm, w) + exact scalar aggregates.
     # Columns [0, C) are canonical k-cells; columns [C, C+T) are raw temp
     # cells holding individual samples since the last compaction.
@@ -135,7 +145,7 @@ def empty_state(spec: TableSpec) -> DeviceState:
         counter_acc=z((kc,), f), counter_hi=z((kc,), f), counter_lo=z((kc,), f),
         gauge=z((kg,), f), gauge_stamp=z((kg,), jnp.uint8),
         status=z((kst,), f), status_stamp=z((kst,), jnp.uint8),
-        hll=jnp.zeros((ks, spec.registers), jnp.uint8),
+        hll=jnp.zeros((ks, spec.hll_words), jnp.int32),
         h_wm=z((kh, c), f), h_w=z((kh, c), f),
         h_temp_n=z((kh,), jnp.int32),
         h_min=jnp.full((kh,), jnp.inf, f),

@@ -73,7 +73,14 @@ def _last_per_slot_set(target, stamp, slot, val, capacity):
             stamp.at[tgt].set(jnp.uint8(1), mode="drop"))
 
 
-def _histo_update(state: DeviceState, slot, val, wt, spec: TableSpec):
+def _histo_plan(state: DeviceState, slot, val, wt, spec: TableSpec):
+    """The estimate/temp cell-assignment math of `_histo_update`, factored
+    out so the fused Pallas ingest kernel (ops/pallas_ingest.py) consumes
+    the EXACT same sorted streams the scatter chain does — byte parity by
+    construction. Returns (s, cell, v, w, tadd): batch sorted by
+    (slot, value) with invalid rows mapped to slot==histo_capacity, the
+    target cell column per row, the value/weight streams, and the
+    temp-slot consumption (0/1) per row."""
     c = spec.centroids
     t = spec.temp_cells
     kh = spec.histo_capacity
@@ -148,14 +155,17 @@ def _histo_update(state: DeviceState, slot, val, wt, spec: TableSpec):
     # by compaction, which owns rank order (ops/tdigest.py compress_rows)
     cell = spec.exact_extremes + jnp.clip(cell, 0, spec.interior_cells - 1)
     cell = jnp.where(use_temp, c + jnp.minimum(temp_idx, t - 1), cell)
+    return s, cell, v, w, jnp.where(use_temp, 1, 0).astype(jnp.int32)
 
+
+def _histo_update(state: DeviceState, slot, val, wt, spec: TableSpec):
+    s, cell, v, w, tadd = _histo_plan(state, slot, val, wt, spec)
     h_w = state.h_w.at[s, cell].add(w, mode="drop")
     h_wm = state.h_wm.at[s, cell].add(w * v, mode="drop")
     # count USED temp slots (samples that overflowed to estimate cells
     # don't consume budget — their slots stay available to later batches
     # in the cycle)
-    h_temp_n = state.h_temp_n.at[s].add(
-        jnp.where(use_temp, 1, 0).astype(jnp.int32), mode="drop")
+    h_temp_n = state.h_temp_n.at[s].add(tadd, mode="drop")
     h_min = state.h_min.at[s].min(jnp.where(w > 0, v, jnp.inf), mode="drop")
     h_max = state.h_max.at[s].max(jnp.where(w > 0, v, -jnp.inf), mode="drop")
     h_count = state.h_count_acc.at[s].add(w, mode="drop")
@@ -170,28 +180,44 @@ def _histo_update(state: DeviceState, slot, val, wt, spec: TableSpec):
                           h_recip_acc=h_recip)
 
 
-def ingest_core(state: DeviceState, batch: Batch, *, spec: TableSpec) -> DeviceState:
+def ingest_core(state: DeviceState, batch: Batch, *, spec: TableSpec,
+                allow_pallas: bool = True) -> DeviceState:
     """Apply one padded batch to the table. The whole reference hot loop
     below the worker channel (reference server.go:984 -> worker.go:344 ->
     samplers Sample) becomes this one compiled program. Pure function —
     `ingest_step` is the donating jit wrapper; parallel/sharded.py wraps it
-    in shard_map/vmap instead."""
-    counter_acc = state.counter_acc.at[batch.counter_slot].add(
-        batch.counter_inc, mode="drop")
-    gauge, gauge_stamp = _last_per_slot_set(
-        state.gauge, state.gauge_stamp, batch.gauge_slot, batch.gauge_val,
-        spec.gauge_capacity)
-    status, status_stamp = _last_per_slot_set(
-        state.status, state.status_stamp, batch.status_slot,
-        batch.status_val, spec.status_capacity)
-    hll = hll_ops.insert_batch(state.hll, batch.set_slot, batch.set_reg,
-                               batch.set_rho, precision=spec.hll_precision)
-    state = state._replace(counter_acc=counter_acc,
-                           gauge=gauge, gauge_stamp=gauge_stamp,
-                           status=status, status_stamp=status_stamp,
-                           hll=hll)
-    state = _histo_update(state, batch.histo_slot, batch.histo_val,
-                          batch.histo_wt, spec)
+    in shard_map/vmap instead (with allow_pallas=False: the per-tile body
+    runs under vmap, where the fused kernel's scalar-prefetch grid does
+    not apply).
+
+    When the fused Pallas ingest kernel is active (ops/pallas_ingest.py:
+    probe-gated on TPU, `pallas_ingest_enabled` config / env force, byte
+    parity pinned by tests/test_pallas_ingest.py), the scatter chain below
+    is replaced by ONE kernel over VMEM-tiled state blocks; the XLA chain
+    remains the portable fallback and the parity oracle."""
+    from veneur_tpu.ops import pallas_ingest
+    if allow_pallas and pallas_ingest.active():
+        state = pallas_ingest.fused_ingest_core(
+            state, batch, spec=spec,
+            interpret=pallas_ingest.interpret_mode())
+    else:
+        counter_acc = state.counter_acc.at[batch.counter_slot].add(
+            batch.counter_inc, mode="drop")
+        gauge, gauge_stamp = _last_per_slot_set(
+            state.gauge, state.gauge_stamp, batch.gauge_slot,
+            batch.gauge_val, spec.gauge_capacity)
+        status, status_stamp = _last_per_slot_set(
+            state.status, state.status_stamp, batch.status_slot,
+            batch.status_val, spec.status_capacity)
+        hll = hll_ops.insert_batch_packed(
+            state.hll, batch.set_slot, batch.set_reg, batch.set_rho,
+            precision=spec.hll_precision)
+        state = state._replace(counter_acc=counter_acc,
+                               gauge=gauge, gauge_stamp=gauge_stamp,
+                               status=status, status_stamp=status_stamp,
+                               hll=hll)
+        state = _histo_update(state, batch.histo_slot, batch.histo_val,
+                              batch.histo_wt, spec)
     if batch.histo_stat_slot is not None:
         s = batch.histo_stat_slot
         state = state._replace(
@@ -209,7 +235,7 @@ def ingest_core(state: DeviceState, batch: Batch, *, spec: TableSpec) -> DeviceS
     return _fold_core(state)
 
 
-ingest_step = partial(jax.jit, static_argnames=("spec",),
+ingest_step = partial(jax.jit, static_argnames=("spec", "allow_pallas"),
                       donate_argnames=("state",))(ingest_core)
 
 
@@ -482,6 +508,14 @@ def _pack_outputs(out: dict):
         if a.dtype == jnp.uint8:
             a = jax.lax.bitcast_convert_type(a.reshape((-1, 4)),
                                              jnp.float32)
+        elif a.dtype == jnp.int32:
+            # packed HLL rows (raw_hll) ride the f32 carrier bit-cast.
+            # Safe: a 6-bit register never exceeds 64-p+1 <= 61, so the
+            # longest run of set bits across packed field boundaries is 5
+            # — an f32 NaN/Inf needs 8 consecutive exponent ones, which
+            # the carrier therefore can never form (no canonicalization
+            # hazard on the way back to the host).
+            a = jax.lax.bitcast_convert_type(a, jnp.float32)
         parts.append(a.reshape(-1).astype(jnp.float32))
     return jnp.concatenate(parts)
 
@@ -527,6 +561,10 @@ def unpack_flush(packed, shapes: dict) -> dict:
             out[k] = np.frombuffer(
                 packed[off:off + words].tobytes(), np.uint8).reshape(shape)
             off += words
+        elif np.dtype(dtype) == np.int32:
+            out[k] = np.frombuffer(
+                packed[off:off + n].tobytes(), np.int32).reshape(shape)
+            off += n
         else:
             out[k] = packed[off:off + n].reshape(shape)
             off += n
@@ -550,7 +588,7 @@ def flush_live_shapes(spec, n_c, n_g, n_st, n_set, n_h, n_q,
     }
     if want_raw:
         cells = spec.centroids + spec.temp_cells
-        shapes["raw_hll"] = ((n_set, spec.registers), "uint8")
+        shapes["raw_hll"] = ((n_set, spec.hll_words), "int32")
         shapes["raw_h_mean"] = ((n_h, cells), f32)
         shapes["raw_h_weight"] = ((n_h, cells), f32)
     return shapes

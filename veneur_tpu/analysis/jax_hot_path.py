@@ -25,6 +25,17 @@ pass catches mechanically:
    call sites passing list/dict/set literals for the static `spec`/
    `sizes` args of the jitted family (unhashable statics throw at
    trace time; a fresh tuple per call recompiles).
+4. **Host code inside Pallas kernels** — the body of any function
+   handed to `pl.pallas_call` is device code: every parameter is a Ref
+   (or a value loaded from one), so a Python `if`/`while` on one, or a
+   `float()`/`np.asarray()`/`.item()` host conversion, either fails at
+   trace time on TPU or — worse — silently "works" in interpret mode
+   and then diverges on hardware. Structured control flow belongs in
+   `@pl.when` / `lax.cond` / `lax.fori_loop`. Kernels are resolved
+   from the call site (a bare name or `functools.partial(name, ...)`)
+   so nested closure kernels are scanned too; keyword-only kernel
+   params are treated as host statics (the `functools.partial`
+   convention) and stay untainted.
 """
 
 from __future__ import annotations
@@ -65,7 +76,8 @@ JITTED_CALLEES = ("ingest_step", "packed_step", "compact",
                   "flush_compute", "quantile_compute")
 
 # files scanned for stray block_until_ready (bench code lives under
-# benchmarks/ and is out of scope by construction)
+# benchmarks/ and is out of scope by construction); the Pallas-kernel
+# scan follows the same list unless overridden
 SYNC_SCAN = ["veneur_tpu"]
 
 _HOST_CONVERTERS = ("float", "int", "bool")
@@ -237,11 +249,110 @@ def _check_block_until_ready(ctx: FileContext) -> List[Finding]:
     return findings
 
 
+def _kernel_def(ctx: FileContext, call: ast.Call):
+    """Resolve `pl.pallas_call(<kernel>, ...)`'s first positional arg
+    to a FunctionDef in this file. Handles a bare name and the
+    `functools.partial(name, ...)` static-binding idiom; anything else
+    (lambda, attribute on another module) is skipped — kernels in this
+    codebase are always file-local by construction."""
+    if not call.args:
+        return None
+    target = call.args[0]
+    if isinstance(target, ast.Call):
+        resolved = ctx.resolve(target.func)
+        if (resolved or "").rsplit(".", 1)[-1] == "partial" \
+                and target.args:
+            target = target.args[0]
+    if not isinstance(target, ast.Name):
+        return None
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.FunctionDef) and node.name == target.id:
+            return node
+    return None
+
+
+def _check_kernel_body(ctx: FileContext, fn) -> List[Finding]:
+    """Treat a pallas_call body as device code: every positional param
+    is a Ref, so the _is_tainted walk starts fully tainted. Keyword-only
+    params are host statics bound via functools.partial (Python `for`
+    over them unrolls at trace time and is fine; only `if`/`while` on
+    Ref-derived values are syncs-in-disguise)."""
+    findings: List[Finding] = []
+    tainted: Set[str] = set()
+    for arg in list(fn.args.posonlyargs) + list(fn.args.args):
+        tainted.add(arg.arg)
+    if fn.args.vararg is not None:
+        tainted.add(fn.args.vararg.arg)
+
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) \
+                and _is_tainted(node.value, ctx, tainted):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    tainted.add(t.id)
+        elif isinstance(node, (ast.If, ast.While)) \
+                and _is_tainted(node.test, ctx, tainted):
+            kind = "if" if isinstance(node, ast.If) else "while"
+            findings.append(Finding(
+                NAME, ctx.rel, node.lineno,
+                f"Python `{kind}` on a Ref-derived value inside Pallas "
+                f"kernel {fn.name}() — kernels trace once; use "
+                "@pl.when / lax.cond / lax.fori_loop for data-dependent "
+                "control flow"))
+        elif isinstance(node, ast.Call):
+            fname = node.func
+            resolved = ctx.resolve(fname)
+            if resolved in _HOST_CONVERTERS and len(node.args) >= 1 \
+                    and _is_tainted(node.args[0], ctx, tainted):
+                findings.append(Finding(
+                    NAME, ctx.rel, node.lineno,
+                    f"`{resolved}()` on a Ref-derived value inside "
+                    f"Pallas kernel {fn.name}() — host conversion in "
+                    "device code fails on TPU (and silently diverges "
+                    "in interpret mode)"))
+            elif resolved in _NP_CONVERTERS and node.args \
+                    and _is_tainted(node.args[0], ctx, tainted):
+                findings.append(Finding(
+                    NAME, ctx.rel, node.lineno,
+                    f"`{resolved.replace('numpy', 'np')}` on a "
+                    f"Ref-derived value inside Pallas kernel "
+                    f"{fn.name}() — host materialization in device "
+                    "code; keep the computation in jnp"))
+            elif isinstance(fname, ast.Attribute) \
+                    and fname.attr in _SYNC_METHODS \
+                    and _is_tainted(fname.value, ctx, tainted):
+                findings.append(Finding(
+                    NAME, ctx.rel, node.lineno,
+                    f"`.{fname.attr}()` on a Ref-derived value inside "
+                    f"Pallas kernel {fn.name}() — host sync in device "
+                    "code"))
+    return findings
+
+
+def _check_pallas_kernels(ctx: FileContext) -> List[Finding]:
+    findings: List[Finding] = []
+    checked = set()
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        resolved = ctx.resolve(node.func)
+        if (resolved or "").rsplit(".", 1)[-1] != "pallas_call":
+            continue
+        kernel = _kernel_def(ctx, node)
+        if kernel is None or id(kernel) in checked:
+            continue
+        checked.add(id(kernel))
+        findings.extend(_check_kernel_body(ctx, kernel))
+    return findings
+
+
 def run(project: Project, hot_funcs: Dict[str, List[str]] = None,
         donating_jits: Dict[str, List[str]] = None,
-        sync_scan: List[str] = None) -> List[Finding]:
+        sync_scan: List[str] = None,
+        pallas_scan: List[str] = None) -> List[Finding]:
     findings: List[Finding] = []
-    for rel, funcs in (hot_funcs or HOT_FUNCS).items():
+    for rel, funcs in (hot_funcs if hot_funcs is not None
+                       else HOT_FUNCS).items():
         ctx = project.file(rel)
         if ctx is None:
             findings.append(Finding(
@@ -264,7 +375,10 @@ def run(project: Project, hot_funcs: Dict[str, List[str]] = None,
     findings.extend(_check_jit_decls(
         project, donating_jits if donating_jits is not None
         else DONATING_JITS))
-    for ctx in project.files(*(sync_scan if sync_scan is not None
-                               else SYNC_SCAN)):
+    scan = sync_scan if sync_scan is not None else SYNC_SCAN
+    for ctx in project.files(*scan):
         findings.extend(_check_block_until_ready(ctx))
+    for ctx in project.files(*(pallas_scan if pallas_scan is not None
+                               else scan)):
+        findings.extend(_check_pallas_kernels(ctx))
     return findings

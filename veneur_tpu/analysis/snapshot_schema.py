@@ -32,22 +32,46 @@ def run(project: Project) -> List[Finding]:
         return []   # fixture tree: nothing to import
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     from veneur_tpu.persistence.codec import (SNAPSHOT_FORMAT_VERSION,
+                                              _SCHEMA_MIGRATIONS,
                                               _SCHEMA_PINS, schema_hash)
+    findings = []
     live = schema_hash()
     pinned = _SCHEMA_PINS.get(SNAPSHOT_FORMAT_VERSION)
     if pinned is None:
-        return [Finding(
+        findings.append(Finding(
             NAME, CODEC_REL, 0,
             f"SNAPSHOT_FORMAT_VERSION={SNAPSHOT_FORMAT_VERSION} has no "
             f"pin in codec._SCHEMA_PINS — add one: "
-            f"{SNAPSHOT_FORMAT_VERSION}: \"{live}\"")]
-    if live != pinned:
-        return [Finding(
+            f"{SNAPSHOT_FORMAT_VERSION}: \"{live}\""))
+    elif live != pinned:
+        findings.append(Finding(
             NAME, CODEC_REL, 0,
             f"snapshot schema DRIFTED (pinned {pinned}, live {live}). "
             "DeviceState._fields or TableSpec changed shape; old "
             "checkpoints would be misread. Bump "
             "SNAPSHOT_FORMAT_VERSION, pin the new hash in "
             "_SCHEMA_PINS, and decide what read_manifest does with "
-            "the previous version: reject (default) or migrate")]
-    return []
+            "the previous version: reject (default) or migrate"))
+    # every superseded pin must carry an explicit migration entry: a
+    # version bump without one silently ORPHANS the old checkpoints
+    # (read_manifest would reject them), and a migration entry without a
+    # frozen pin cannot be hash-verified at read time
+    for old in _SCHEMA_PINS:
+        if old == SNAPSHOT_FORMAT_VERSION:
+            continue
+        if old not in _SCHEMA_MIGRATIONS:
+            findings.append(Finding(
+                NAME, CODEC_REL, 0,
+                f"superseded format v{old} has a pin but no "
+                "_SCHEMA_MIGRATIONS entry — add one describing the "
+                "layout change (read_manifest only accepts migratable "
+                "versions), or drop the pin if v%d checkpoints are "
+                "intentionally orphaned" % old))
+    for old in _SCHEMA_MIGRATIONS:
+        if old not in _SCHEMA_PINS:
+            findings.append(Finding(
+                NAME, CODEC_REL, 0,
+                f"_SCHEMA_MIGRATIONS lists v{old} but _SCHEMA_PINS has "
+                "no frozen hash for it — read_manifest cannot verify "
+                f"v{old} snapshots"))
+    return findings

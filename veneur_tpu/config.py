@@ -139,6 +139,13 @@ class Config:
     restore_on_start: bool = False     # fold the newest valid snapshot
     checkpoint_on_shutdown: bool = True    # final snapshot of the tail
 
+    # device kernels (veneur_tpu/ops/pallas_ingest.py; README §Device
+    # kernels). True = probe-gated: the fused ingest kernel runs where
+    # the backend compiles it (TPU), the XLA scatter chain everywhere
+    # else (CPU tier-1 parity keeps the chain as the oracle). False
+    # forces the chain even on TPU.
+    pallas_ingest_enabled: bool = True
+
     # observability (veneur_tpu/observability/). Both switches default
     # OFF with zero hot-path overhead (a single attribute check / a 404):
     # the telemetry registry itself always runs — it IS the counter store.

@@ -18,6 +18,20 @@ one uint8 array [..., R]:
 
 Precision p=14 (R=16384) matches the reference's default
 (samplers/samplers.go:383).
+
+Round 8 adds a 6-bit *packed* register layout (FPGA HLL pipelines,
+PAPERS.md arxiv 2005.13332): register values never exceed 64-p+1 = 51
+at p=14, so 6 bits suffice and the resident table shrinks from
+``uint8[K, 2^p]`` to ``int32[K, ceil(2^p*6/32)]`` words — register r
+lives at bit offset 6·r little-endian within the word stream. Because
+2^p is a multiple of 16 the pattern repeats exactly every 16 registers
+/ 3 words (96 bits), which is what `pack_registers`/`unpack_registers`
+exploit and what guarantees a straddling register's second word always
+exists (the last register of each 16-group starts at in-word bit 26).
+The packed table is what the device holds and what the fused Pallas
+ingest kernel updates in place; `estimate`/`serialize` accept either
+layout, and wire bytes are unchanged — packing is an at-rest layout,
+not a wire format.
 """
 
 from __future__ import annotations
@@ -118,6 +132,160 @@ def merge_rows(registers, slot, rows):
     return registers.at[slot].max(rows, mode="drop")
 
 
+# ---------------------------------------------------------------------------
+# 6-bit packed register layout
+# ---------------------------------------------------------------------------
+
+REGISTER_BITS = 6        # max rho = 64-4+1 = 61 < 64 fits any p >= 4
+
+
+def packed_words(precision: int = DEFAULT_PRECISION) -> int:
+    """int32 words per key for the 6-bit packed layout."""
+    return (num_registers(precision) * REGISTER_BITS + 31) // 32
+
+
+def empty_registers_packed(key_shape,
+                           precision: int = DEFAULT_PRECISION) -> jax.Array:
+    key_shape = (key_shape,) if isinstance(key_shape, int) else tuple(key_shape)
+    return jnp.zeros(key_shape + (packed_words(precision),), jnp.int32)
+
+
+def _group16(x, last):
+    """Reshape the trailing axis into (groups, last) 16-register groups.
+    The group count is computed explicitly (not -1): a zero-row input —
+    e.g. restoring a snapshot with no live sets — makes -1 unresolvable."""
+    return x.reshape(x.shape[:-1] + (x.shape[-1] // last, last))
+
+
+def pack_registers(regs, *, precision: int = DEFAULT_PRECISION) -> jax.Array:
+    """u8[..., R] dense registers -> i32[..., W] 6-bit packed words.
+
+    16 registers pack into exactly 3 words (96 bits), so the whole
+    transform is shifts and ORs over a [..., R/16, 16] view — no scatter.
+    Left shifts that cross bit 31 wrap (defined for lax shifts); the bit
+    pattern is what matters.
+    """
+    r = num_registers(precision)
+    assert r % 16 == 0 and regs.shape[-1] == r
+    v = _group16(regs, 16).astype(jnp.int32) & 0x3F
+    g = [v[..., i] for i in range(16)]
+    w0 = (g[0] | (g[1] << 6) | (g[2] << 12) | (g[3] << 18) | (g[4] << 24)
+          | ((g[5] & 0x3) << 30))
+    w1 = ((g[5] >> 2) | (g[6] << 4) | (g[7] << 10) | (g[8] << 16)
+          | (g[9] << 22) | ((g[10] & 0xF) << 28))
+    w2 = ((g[10] >> 4) | (g[11] << 2) | (g[12] << 8) | (g[13] << 14)
+          | (g[14] << 20) | (g[15] << 26))
+    words = jnp.stack([w0, w1, w2], axis=-1)
+    return words.reshape(regs.shape[:-1] + (packed_words(precision),))
+
+
+def unpack_registers(words, *, precision: int = DEFAULT_PRECISION) -> jax.Array:
+    """i32[..., W] packed words -> u8[..., R] dense registers.
+
+    Right shifts on int32 are arithmetic (sign-extending); every lane is
+    masked after the shift, so the sign bit never leaks into a register.
+    """
+    w = packed_words(precision)
+    assert words.shape[-1] == w
+    g = _group16(words, 3)
+    w0, w1, w2 = g[..., 0], g[..., 1], g[..., 2]
+    regs = [
+        w0 & 0x3F, (w0 >> 6) & 0x3F, (w0 >> 12) & 0x3F, (w0 >> 18) & 0x3F,
+        (w0 >> 24) & 0x3F,
+        ((w0 >> 30) & 0x3) | ((w1 & 0xF) << 2),
+        (w1 >> 4) & 0x3F, (w1 >> 10) & 0x3F, (w1 >> 16) & 0x3F,
+        (w1 >> 22) & 0x3F,
+        ((w1 >> 28) & 0xF) | ((w2 & 0x3) << 4),
+        (w2 >> 2) & 0x3F, (w2 >> 8) & 0x3F, (w2 >> 14) & 0x3F,
+        (w2 >> 20) & 0x3F, (w2 >> 26) & 0x3F,
+    ]
+    out = jnp.stack(regs, axis=-1)
+    return out.reshape(words.shape[:-1]
+                       + (num_registers(precision),)).astype(jnp.uint8)
+
+
+def pack_registers_np(regs, precision: int = DEFAULT_PRECISION):
+    """Host numpy twin of pack_registers (persistence / import staging)."""
+    import numpy as np
+    regs = np.asarray(regs, np.uint8)
+    r = num_registers(precision)
+    assert r % 16 == 0 and regs.shape[-1] == r
+    v = _group16(regs, 16).astype(np.int64) & 0x3F
+    g = [v[..., i] for i in range(16)]
+    w0 = (g[0] | (g[1] << 6) | (g[2] << 12) | (g[3] << 18) | (g[4] << 24)
+          | ((g[5] & 0x3) << 30))
+    w1 = ((g[5] >> 2) | (g[6] << 4) | (g[7] << 10) | (g[8] << 16)
+          | (g[9] << 22) | ((g[10] & 0xF) << 28))
+    w2 = ((g[10] >> 4) | (g[11] << 2) | (g[12] << 8) | (g[13] << 14)
+          | (g[14] << 20) | (g[15] << 26))
+    words = np.stack([w0, w1, w2], axis=-1) & 0xFFFFFFFF
+    return (words.reshape(regs.shape[:-1] + (packed_words(precision),))
+            .astype(np.uint32).view(np.int32))
+
+
+def unpack_registers_np(words, precision: int = DEFAULT_PRECISION):
+    """Host numpy twin of unpack_registers."""
+    import numpy as np
+    words = np.asarray(words)
+    w = packed_words(precision)
+    assert words.shape[-1] == w
+    u = (words.astype(np.int64) & 0xFFFFFFFF)
+    g = _group16(u, 3)
+    w0, w1, w2 = g[..., 0], g[..., 1], g[..., 2]
+    regs = [
+        w0 & 0x3F, (w0 >> 6) & 0x3F, (w0 >> 12) & 0x3F, (w0 >> 18) & 0x3F,
+        (w0 >> 24) & 0x3F,
+        ((w0 >> 30) & 0x3) | ((w1 & 0xF) << 2),
+        (w1 >> 4) & 0x3F, (w1 >> 10) & 0x3F, (w1 >> 16) & 0x3F,
+        (w1 >> 22) & 0x3F,
+        ((w1 >> 28) & 0xF) | ((w2 & 0x3) << 4),
+        (w2 >> 2) & 0x3F, (w2 >> 8) & 0x3F, (w2 >> 14) & 0x3F,
+        (w2 >> 20) & 0x3F, (w2 >> 26) & 0x3F,
+    ]
+    out = np.stack(regs, axis=-1)
+    return out.reshape(words.shape[:-1]
+                       + (num_registers(precision),)).astype(np.uint8)
+
+
+@partial(jax.jit, static_argnames=("precision",))
+def insert_batch_packed(words, slot, reg, rho, *,
+                        precision: int = DEFAULT_PRECISION):
+    """`insert_batch` over the packed table: unpack -> dense scatter-max ->
+    repack. The XLA fallback path when the fused Pallas kernel is off; the
+    round trip through the dense layout makes parity with `insert_batch`
+    true by construction (register max commutes with packing)."""
+    dense = unpack_registers(words, precision=precision)
+    dense = insert_batch(dense, slot, reg, rho, precision=precision)
+    return pack_registers(dense, precision=precision)
+
+
+@partial(jax.jit, static_argnames=("precision",))
+def merge_rows_packed(words, slot, rows, *,
+                      precision: int = DEFAULT_PRECISION):
+    """`merge_rows` over the packed table: union dense u8 import rows into
+    i32 packed words. Touches only the B addressed rows (gather -> unpack
+    -> max -> pack -> unique-index set), not the whole table. Duplicate
+    slots are combined host-order-free by a segment-max before the set,
+    so the final `.set` has unique indices. Out-of-range slots —
+    including negative ones — are dropped."""
+    k = words.shape[0]
+    slot = jnp.where((slot >= 0) & (slot < k), slot, k)
+    order = jnp.argsort(slot)
+    ss = slot[order]
+    rs = rows[order].astype(jnp.int32)
+    seg_start = jnp.concatenate([jnp.ones((1,), bool), ss[1:] != ss[:-1]])
+    seg_id = jnp.cumsum(seg_start.astype(jnp.int32)) - 1
+    combined = jax.ops.segment_max(rs, seg_id, num_segments=slot.shape[0],
+                                   indices_are_sorted=True)
+    upd = combined[seg_id].astype(jnp.uint8)       # per-position segment max
+    tgt = jnp.where(seg_start, ss, k)              # unique: segment heads only
+    cur = words[jnp.minimum(tgt, k - 1)]           # dropped rows gather junk,
+    #                                                never written back
+    merged = jnp.maximum(unpack_registers(cur, precision=precision), upd)
+    packed = pack_registers(merged, precision=precision)
+    return words.at[tgt].set(packed, mode="drop")
+
+
 MAGIC = b"VHLL"          # legacy round-1 wire format (still decodable)
 _SPARSE_PP = 25          # axiomhq sparse precision (hyperloglog.go pp)
 
@@ -136,7 +304,9 @@ def serialize(registers, precision: int = DEFAULT_PRECISION) -> bytes:
     reference's own insert applies (hyperloglog.go:169-180).
     """
     import numpy as np
-    regs = np.asarray(registers, np.uint8)
+    regs = np.asarray(registers)
+    if regs.dtype != np.uint8:           # 6-bit packed i32 row
+        regs = unpack_registers_np(regs, precision)
     m = regs.shape[0]
     mn, mx = int(regs.min()), int(regs.max())
     b = 0
@@ -162,6 +332,63 @@ def _decode_sparse_hash(k: int, p: int):
     return idx, r
 
 
+def _bitlen32(x):
+    """Vectorized int.bit_length for non-negative int64 arrays < 2^32.
+    Binary-search halving — no float log2 (exact at every power of two)."""
+    import numpy as np
+    x = x.astype(np.int64)
+    n = np.zeros_like(x)
+    for s in (16, 8, 4, 2, 1):
+        big = x >= (np.int64(1) << s)
+        n = np.where(big, n + s, n)
+        x = np.where(big, x >> s, x)
+    return n + (x > 0)
+
+
+def _decode_sparse_hashes_np(keys, p: int):
+    """Vectorized `_decode_sparse_hash` over an int64 key array — returns
+    (idx, r) int64 arrays. Same field math as the scalar version (the
+    sparse-form oracle test in tests/test_hll.py pins both)."""
+    import numpy as np
+    pp = _SPARSE_PP
+    k = keys.astype(np.int64) & 0xFFFFFFFF
+    m = 1 << p
+    odd = (k & 1) == 1
+    r_odd = ((k >> 1) & 0x3F) + pp - p
+    idx_odd = (k >> (32 - p)) & (m - 1)
+    shifted = (k << (32 - pp + p - 1)) & 0xFFFFFFFF
+    r_even = np.where(shifted == 0, 32, 33 - _bitlen32(shifted))
+    idx_even = (k >> (pp - p + 1)) & (m - 1)
+    return (np.where(odd, idx_odd, idx_even),
+            np.where(odd, r_odd, r_even))
+
+
+def _decode_varint_deltas(buf: bytes):
+    """Vectorized LEB128 varint decode of axiomhq's compressedList delta
+    stream -> int64 delta array. Replaces the per-byte Python while loop
+    (round-8 satellite; ~40x on a 16k-key sparse payload — see
+    benchmarks/micro.py hll_codec_roundtrip).
+
+    Grouping trick: a varint ends at each byte with the continuation bit
+    clear; `np.add.reduceat` over per-byte `7*pos`-shifted payloads at the
+    group starts reassembles every value in one pass."""
+    import numpy as np
+    if not buf:
+        return np.zeros(0, np.int64)
+    b = np.frombuffer(buf, np.uint8).astype(np.int64)
+    is_end = (b & 0x80) == 0
+    if not is_end[-1]:
+        raise ValueError("truncated HLL sparse varint")
+    ends = np.nonzero(is_end)[0]
+    starts = np.concatenate([[0], ends[:-1] + 1])
+    gid = np.cumsum(np.concatenate([[False], is_end[:-1]]).astype(np.int64))
+    pos = np.arange(b.shape[0]) - starts[gid]
+    if pos.max() * 7 >= 63:
+        raise ValueError("HLL sparse varint too long")
+    vals = (b & 0x7F) << (7 * pos)
+    return np.add.reduceat(vals, starts)
+
+
 def _deserialize_axiomhq(data: bytes):
     import numpy as np
     p = data[1]
@@ -176,32 +403,21 @@ def _deserialize_axiomhq(data: bytes):
         if 8 + 4 * tssz + 12 > len(data):
             raise ValueError("truncated HLL sparse payload (tmpSet)")
         off = 8
-        keys = []
-        for _ in range(tssz):
-            keys.append(int.from_bytes(data[off:off + 4], "big"))
-            off += 4
+        ts_keys = np.frombuffer(data[off:off + 4 * tssz], ">u4") \
+            .astype(np.int64)
+        off += 4 * tssz
         off += 8  # compressedList count + last (we re-derive from deltas)
         (sz,) = _be32(data, off)
         off += 4
         if off + sz > len(data):
             raise ValueError("truncated HLL sparse payload (list)")
-        buf = data[off:off + sz]
-        i, last = 0, 0
-        while i < len(buf):
-            x, j = 0, i
-            while buf[j] & 0x80:
-                x |= (buf[j] & 0x7F) << ((j - i) * 7)
-                j += 1
-                if j >= len(buf):
-                    raise ValueError("truncated HLL sparse varint")
-            x |= buf[j] << ((j - i) * 7)
-            last += x
-            keys.append(last)
-            i = j + 1
-        for k in keys:
-            idx, r = _decode_sparse_hash(k, p)
-            if r > regs[idx]:
-                regs[idx] = r
+        deltas = _decode_varint_deltas(data[off:off + sz])
+        keys = np.concatenate([ts_keys, np.cumsum(deltas)])
+        if keys.shape[0]:
+            idx, r = _decode_sparse_hashes_np(keys, p)
+            acc = np.zeros(m, np.int64)
+            np.maximum.at(acc, idx, r)
+            regs = acc.astype(np.uint8)
         return p, regs
     (sz,) = _be32(data, 4)
     packed = np.frombuffer(data[8:8 + sz], np.uint8)
@@ -246,6 +462,8 @@ def estimate(registers, *, precision: int = DEFAULT_PRECISION):
     standard error at p=14, which is what the tests assert.
     """
     m = num_registers(precision)
+    if registers.dtype != jnp.uint8:     # 6-bit packed i32 table
+        registers = unpack_registers(registers, precision=precision)
     regs = registers.astype(jnp.float32)
     inv = jnp.sum(jnp.exp2(-regs), axis=-1)
     raw = _alpha(m) * m * m / inv

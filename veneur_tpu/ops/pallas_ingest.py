@@ -1,0 +1,458 @@
+"""Fused Pallas ingest kernel: the whole scatter chain in one pass.
+
+`aggregation/step.py ingest_core` is a chain of separate XLA scatters —
+counter add, gauge/status last-write-wins, HLL register max, digest
+cell insert — each of which re-streams its state operand through HBM.
+This module fuses them into ONE `pl.pallas_call` over VMEM-tiled state
+blocks: every state leaf is read into VMEM once, takes all of its
+batch's updates in place, and is written back once.
+
+Shape of the kernel:
+
+- The host-side prologue sorts each kind's batch lane by (slot, batch
+  index) — reusing `_histo_plan` verbatim for the digest lane so cell
+  assignment math is shared, not duplicated — maps invalid slots to a
+  2^30 sentinel, and computes per-grid-step window offsets with one
+  searchsorted per kind. The offsets ride as a scalar-prefetch operand
+  (`pltpu.PrefetchScalarGridSpec`), so block index maps and loop bounds
+  know them before the body runs.
+- A 1-D grid walks each kind's blocks in slot order; a kind with fewer
+  blocks than the grid clamps its index map (`min(g, blocks-1)`), which
+  under Pallas revisit semantics keeps its last block resident in VMEM
+  with no extra HBM traffic. Out blocks are copy-initialized from the
+  aliased inputs on first visit only (`@pl.when(g < blocks)` — the
+  first visit of block b is exactly grid step b), then mutated by
+  sequential scalar read-modify-writes driven by
+  `fori_loop(offs[k, g], offs[k, g + 1])`.
+- Update order inside a window is ascending (slot, batch index), so per
+  slot the adds/sets land in batch order — exactly the order XLA
+  applies duplicate scatter updates — which is what makes the kernel
+  BYTE-identical to the scatter chain on every state leaf
+  (tests/test_pallas_ingest.py pins this in interpret mode).
+- HLL registers update directly in the 6-bit packed words
+  (ops/hll.py §packed): a register's field is read with a
+  shift/mask, maxed with rho, and written back; a field straddling a
+  word boundary (in-word bit 28 or 30) patches the second word under
+  `@pl.when(straddle)`. Since 2^p % 16 == 0 a straddle never occurs at
+  a row's final word, so the second word always exists.
+
+Gating mirrors ops/pallas_digest.py: `enabled()` probes the backend in
+a bounded subprocess (any Mosaic lowering gap → XLA fallback, never a
+crash), `VENEUR_TPU_PALLAS_INGEST=1/0` force-overrides, and the
+`pallas_ingest_enabled` config key feeds `set_enabled` at server
+construction. On CPU the kernel runs in interpret mode (traced JAX
+ops) — correct everywhere, used by the parity suite; the production
+CPU path stays the XLA chain.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from veneur_tpu.aggregation.state import DeviceState, TableSpec
+
+log = logging.getLogger(__name__)
+
+_BIG = 1 << 30   # sentinel slot for invalid rows: beyond every window
+
+
+def _tiles(spec: TableSpec):
+    """Per-kind VMEM tile rows (counter, gauge, status, set, histo).
+    Budgeted so in+out blocks of every kind fit ~6MB total at the
+    default spec — half a core's VMEM, leaving room for the streams."""
+    tc = min(spec.counter_capacity, 1 << 15)
+    tg = min(spec.gauge_capacity, 1 << 15)
+    tst = min(spec.status_capacity, 1 << 15)
+    ts = max(1, min(spec.set_capacity, (1 << 18) // spec.hll_words))
+    th = max(1, min(spec.histo_capacity, (1 << 17) // spec.total_cells))
+    return tc, tg, tst, ts, th
+
+
+def _layout(spec: TableSpec):
+    tiles = _tiles(spec)
+    caps = (spec.counter_capacity, spec.gauge_capacity,
+            spec.status_capacity, spec.set_capacity, spec.histo_capacity)
+    nblocks = tuple(-(-c // t) for c, t in zip(caps, tiles))
+    return tiles, caps, nblocks, max(nblocks)
+
+
+def _pad1(a):
+    """A zero-length lane still needs a nonempty VMEM block; one sentinel
+    row (slot == _BIG lands outside every window) keeps the BlockSpec
+    legal without a second compiled variant."""
+    if a.shape[0] > 0:
+        return a
+    return jnp.zeros((1,) + a.shape[1:], a.dtype)
+
+
+def _stream(slot, cap, *vals, extra_valid=None):
+    """Sort one lane by (slot, batch index); invalid rows — negative or
+    past-capacity slots — keep their relative order at the tail under the
+    _BIG sentinel, outside every window. (The XLA chain's mode="drop"
+    scatters WRAP negative slots, NumPy-style; production never emits
+    them — padding rows carry slot == capacity — so dropping here is the
+    saner twin behavior, same call as hll.merge_rows_packed.)"""
+    valid = (slot >= 0) & (slot < cap)
+    if extra_valid is not None:
+        valid = valid & extra_valid
+    skey = jnp.where(valid, slot, _BIG)
+    idx = jnp.arange(slot.shape[0], dtype=jnp.int32)
+    order = jnp.lexsort((idx, skey))
+    return (_pad1(skey[order].astype(jnp.int32)),
+            tuple(_pad1(v[order]) for v in vals))
+
+
+def _offsets(skeys, tiles, g_total):
+    """i32[5, G+1] window offsets: row k, step g covers sorted positions
+    [offs[k, g], offs[k, g+1]) — the slots in [g*tile_k, (g+1)*tile_k).
+    Steps past a kind's last block get empty windows (every valid slot
+    is below blocks_k * tile_k); sentinel rows sit past offs[k, G]."""
+    rows = []
+    for sk, t in zip(skeys, tiles):
+        bounds = jnp.arange(g_total + 1, dtype=jnp.int32) * t
+        rows.append(jnp.searchsorted(sk, bounds, side="left")
+                    .astype(jnp.int32))
+    return jnp.stack(rows)
+
+
+def fused_ingest_core(state: DeviceState, batch, *, spec: TableSpec,
+                      interpret: bool = False) -> DeviceState:
+    """Drop-in replacement for ingest_core's scatter chain (everything
+    except the optional histo_stat_* import lanes and the two-float
+    fold, which stay in XLA around the kernel). Pure; safe under jit
+    and donation — state leaves alias the kernel outputs."""
+    from veneur_tpu.aggregation.step import _histo_plan
+
+    tiles, _caps, nblocks, g_total = _layout(spec)
+    tc, tg, tst, ts, th = tiles
+    ncb, ngb, nstb, nsb, nhb = nblocks
+    w_words = spec.hll_words
+    cells = spec.total_cells
+
+    c_sk, (c_inc,) = _stream(batch.counter_slot, spec.counter_capacity,
+                             batch.counter_inc)
+    g_sk, (g_val,) = _stream(batch.gauge_slot, spec.gauge_capacity,
+                             batch.gauge_val)
+    st_sk, (st_val,) = _stream(batch.status_slot, spec.status_capacity,
+                               batch.status_val)
+    # the dense scatter drops out-of-range register indices too (2-D
+    # scatter, mode="drop") — mirror that in the stream validity
+    reg_ok = (batch.set_reg >= 0) & (batch.set_reg < spec.registers)
+    s_sk, (s_reg, s_rho) = _stream(
+        batch.set_slot, spec.set_capacity, batch.set_reg,
+        batch.set_rho.astype(jnp.int32), extra_valid=reg_ok)
+    hs, h_cell, h_v, h_w, h_tadd = _histo_plan(
+        state, batch.histo_slot, batch.histo_val, batch.histo_wt, spec)
+    # _histo_plan already sorted by (slot, value) with invalid rows at
+    # slot == histo_capacity; only the sentinel remap is needed, and the
+    # kernel consumes the EXACT arrays the scatter chain would.
+    h_sk = _pad1(jnp.where(hs < spec.histo_capacity, hs,
+                           jnp.int32(_BIG)).astype(jnp.int32))
+    h_cell, h_v, h_w, h_tadd = (_pad1(h_cell), _pad1(h_v),
+                                _pad1(h_w), _pad1(h_tadd))
+    h_wv = h_w * h_v
+    h_rcp = jnp.where(h_w > 0, h_w / h_v, 0.0)
+
+    offs = _offsets([c_sk, g_sk, st_sk, s_sk, h_sk], tiles, g_total)
+
+    def kernel(offs_ref,
+               counter_in, gauge_in, gstamp_in, status_in, ststamp_in,
+               hll_in, hw_in, hwm_in, htn_in, hmin_in, hmax_in,
+               hcnt_in, hsum_in, hrcp_in,
+               c_slot_s, c_inc_s, g_slot_s, g_val_s, st_slot_s, st_val_s,
+               s_slot_s, s_reg_s, s_rho_s,
+               h_slot_s, h_cell_s, h_v_s, h_w_s, h_wv_s, h_rcp_s, h_tadd_s,
+               counter_out, gauge_out, gstamp_out, status_out, ststamp_out,
+               hll_out, hw_out, hwm_out, htn_out, hmin_out, hmax_out,
+               hcnt_out, hsum_out, hrcp_out):
+        g = pl.program_id(0)
+
+        # copy-initialize out blocks from the aliased inputs on FIRST
+        # visit only: the clamped index maps revisit each kind's last
+        # block, and re-copying would erase the resident RMW results
+        for dst, src, nb in ((counter_out, counter_in, ncb),
+                             (gauge_out, gauge_in, ngb),
+                             (gstamp_out, gstamp_in, ngb),
+                             (status_out, status_in, nstb),
+                             (ststamp_out, ststamp_in, nstb),
+                             (hll_out, hll_in, nsb),
+                             (hw_out, hw_in, nhb),
+                             (hwm_out, hwm_in, nhb),
+                             (htn_out, htn_in, nhb),
+                             (hmin_out, hmin_in, nhb),
+                             (hmax_out, hmax_in, nhb),
+                             (hcnt_out, hcnt_in, nhb),
+                             (hsum_out, hsum_in, nhb),
+                             (hrcp_out, hrcp_in, nhb)):
+            @pl.when(g < nb)
+            def _(dst=dst, src=src):
+                dst[...] = src[...]
+
+        cbase = jnp.minimum(g, ncb - 1) * tc
+
+        def c_body(i, _):
+            counter_out[c_slot_s[i] - cbase] += c_inc_s[i]
+            return 0
+
+        jax.lax.fori_loop(offs_ref[0, g], offs_ref[0, g + 1], c_body, 0)
+
+        gbase = jnp.minimum(g, ngb - 1) * tg
+
+        def g_body(i, _):
+            l = g_slot_s[i] - gbase
+            gauge_out[l] = g_val_s[i]
+            gstamp_out[l] = jnp.uint8(1)
+            return 0
+
+        jax.lax.fori_loop(offs_ref[1, g], offs_ref[1, g + 1], g_body, 0)
+
+        stbase = jnp.minimum(g, nstb - 1) * tst
+
+        def st_body(i, _):
+            l = st_slot_s[i] - stbase
+            status_out[l] = st_val_s[i]
+            ststamp_out[l] = jnp.uint8(1)
+            return 0
+
+        jax.lax.fori_loop(offs_ref[2, g], offs_ref[2, g + 1], st_body, 0)
+
+        sbase = jnp.minimum(g, nsb - 1) * ts
+
+        def s_body(i, _):
+            l = s_slot_s[i] - sbase
+            bit = 6 * s_reg_s[i]
+            w0 = bit >> 5
+            sh = bit & 31
+            straddle = sh > 26
+            nlo = jnp.where(straddle, 32 - sh, 6)
+            nhi = 6 - nlo                     # 0 when the field fits
+            mask_lo = (1 << nlo) - 1
+            lo = hll_out[l, w0]
+            w1 = jnp.where(straddle, w0 + 1, w0)  # guard: no OOB read
+            hi = hll_out[l, w1]
+            cur = ((lo >> sh) & mask_lo) | ((hi & ((1 << nhi) - 1)) << nlo)
+            new = jnp.maximum(cur, s_rho_s[i])
+            hll_out[l, w0] = ((lo & ~(mask_lo << sh))
+                              | ((new & mask_lo) << sh))
+
+            @pl.when(straddle)
+            def _():
+                hll_out[l, w1] = (hi & ~((1 << nhi) - 1)) | (new >> nlo)
+            return 0
+
+        jax.lax.fori_loop(offs_ref[3, g], offs_ref[3, g + 1], s_body, 0)
+
+        hbase = jnp.minimum(g, nhb - 1) * th
+
+        def h_body(i, _):
+            l = h_slot_s[i] - hbase
+            cell = h_cell_s[i]
+            v = h_v_s[i]
+            w = h_w_s[i]
+            wv = h_wv_s[i]
+            hw_out[l, cell] += w
+            hwm_out[l, cell] += wv
+            htn_out[l] += h_tadd_s[i]
+            hmin_out[l] = jnp.minimum(hmin_out[l],
+                                      jnp.where(w > 0, v, jnp.inf))
+            hmax_out[l] = jnp.maximum(hmax_out[l],
+                                      jnp.where(w > 0, v, -jnp.inf))
+            hcnt_out[l] += w
+            hsum_out[l] += wv
+            hrcp_out[l] += h_rcp_s[i]
+            return 0
+
+        jax.lax.fori_loop(offs_ref[4, g], offs_ref[4, g + 1], h_body, 0)
+
+    state_ins = (state.counter_acc, state.gauge, state.gauge_stamp,
+                 state.status, state.status_stamp, state.hll,
+                 state.h_w, state.h_wm, state.h_temp_n,
+                 state.h_min, state.h_max,
+                 state.h_count_acc, state.h_sum_acc, state.h_recip_acc)
+    streams = (c_sk, c_inc, g_sk, g_val, st_sk, st_val,
+               s_sk, s_reg, s_rho,
+               h_sk, h_cell, h_v, h_w, h_wv, h_rcp, h_tadd)
+
+    def spec1(tile, nb):
+        return pl.BlockSpec((tile,), lambda g, o, nb=nb: (jnp.minimum(g, nb - 1),))
+
+    def spec2(tile, ncols, nb):
+        return pl.BlockSpec((tile, ncols),
+                            lambda g, o, nb=nb: (jnp.minimum(g, nb - 1), 0))
+
+    def whole(n):
+        return pl.BlockSpec((n,), lambda g, o: (0,))
+
+    state_specs = [
+        spec1(tc, ncb), spec1(tg, ngb), spec1(tg, ngb),
+        spec1(tst, nstb), spec1(tst, nstb),
+        spec2(ts, w_words, nsb),
+        spec2(th, cells, nhb), spec2(th, cells, nhb),
+        spec1(th, nhb), spec1(th, nhb), spec1(th, nhb),
+        spec1(th, nhb), spec1(th, nhb), spec1(th, nhb),
+    ]
+    stream_specs = [whole(a.shape[0]) for a in streams]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(g_total,),
+        in_specs=state_specs + stream_specs,
+        out_specs=state_specs,
+    )
+    outs = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct(a.shape, a.dtype)
+                   for a in state_ins],
+        # operand 0 is the scalar-prefetch offsets; state input i is
+        # operand i+1, aliased in place onto output i
+        input_output_aliases={i + 1: i for i in range(len(state_ins))},
+        interpret=interpret,
+    )(offs, *state_ins, *streams)
+    return state._replace(
+        counter_acc=outs[0], gauge=outs[1], gauge_stamp=outs[2],
+        status=outs[3], status_stamp=outs[4], hll=outs[5],
+        h_w=outs[6], h_wm=outs[7], h_temp_n=outs[8],
+        h_min=outs[9], h_max=outs[10],
+        h_count_acc=outs[11], h_sum_acc=outs[12], h_recip_acc=outs[13])
+
+
+# -- gating ------------------------------------------------------------------
+
+_PROBE_RESULT = None
+_OVERRIDE = None
+
+
+def set_enabled(value) -> None:
+    """Config-level override wired from `pallas_ingest_enabled` at server
+    construction: False forces the XLA chain, True forces the kernel
+    (interpret mode on CPU), None restores probe gating."""
+    global _OVERRIDE
+    _OVERRIDE = value
+
+
+def interpret_mode() -> bool:
+    """Run the kernel as traced JAX ops (bit-identical semantics, no
+    Mosaic) — the portable mode tier-1 parity uses on CPU."""
+    return jax.default_backend() == "cpu"
+
+
+def active() -> bool:
+    """Should ingest_core take the fused path right now?"""
+    if _OVERRIDE is not None:
+        return bool(_OVERRIDE)
+    return enabled()
+
+
+def enabled() -> bool:
+    """Probe-gated availability, mirroring pallas_digest.enabled():
+    VENEUR_TPU_PALLAS_INGEST=1/0 forces; CPU backend → False (the XLA
+    chain is faster than interpret mode); otherwise a bounded-subprocess
+    parity probe decides once per process."""
+    env = os.environ.get("VENEUR_TPU_PALLAS_INGEST", "")
+    if env == "1":
+        return True
+    if env == "0":
+        return False
+    if jax.default_backend() == "cpu":
+        return False
+    global _PROBE_RESULT
+    if _PROBE_RESULT is None:
+        try:
+            _PROBE_RESULT = _run_probe_bounded()
+        except Exception as exc:  # noqa: BLE001 - any probe failure = no
+            log.warning("pallas ingest probe failed; using XLA chain: %s",
+                        exc)
+            _PROBE_RESULT = False
+        if not _PROBE_RESULT:
+            log.warning("pallas ingest kernel unavailable on %s; "
+                        "falling back to the XLA scatter chain",
+                        jax.default_backend())
+    return _PROBE_RESULT
+
+
+def _probe_spec() -> TableSpec:
+    return TableSpec(counter_capacity=64, gauge_capacity=64,
+                     status_capacity=32, set_capacity=8,
+                     histo_capacity=32, hll_precision=6, temp_cells=16)
+
+
+def _probe_batch(spec: TableSpec):
+    import numpy as np
+    from veneur_tpu.aggregation.step import Batch
+    rng = np.random.default_rng(7)
+    n = 32
+
+    def slots(cap):
+        return jnp.asarray(rng.integers(0, cap + 2, n).astype(np.int32))
+
+    return Batch(
+        counter_slot=slots(spec.counter_capacity),
+        counter_inc=jnp.asarray(rng.normal(size=n).astype(np.float32)),
+        gauge_slot=slots(spec.gauge_capacity),
+        gauge_val=jnp.asarray(rng.normal(size=n).astype(np.float32)),
+        status_slot=slots(spec.status_capacity),
+        status_val=jnp.asarray(rng.normal(size=n).astype(np.float32)),
+        set_slot=slots(spec.set_capacity),
+        set_reg=jnp.asarray(
+            rng.integers(0, spec.registers, n).astype(np.int32)),
+        set_rho=jnp.asarray(rng.integers(0, 50, n).astype(np.uint8)),
+        histo_slot=slots(spec.histo_capacity),
+        histo_val=jnp.asarray(
+            rng.normal(size=n).astype(np.float32) + 2.0),
+        histo_wt=jnp.asarray(
+            rng.uniform(0.5, 2.0, n).astype(np.float32)),
+    )
+
+
+def _probe() -> bool:
+    """Compiled fused kernel vs the XLA chain on the live backend —
+    exact equality on every state leaf, in the production calling
+    context (inside jit)."""
+    import numpy as np
+    from functools import partial
+    from veneur_tpu.aggregation import step
+    from veneur_tpu.aggregation.state import empty_state
+
+    spec = _probe_spec()
+    batch = _probe_batch(spec)
+    ref = jax.jit(partial(step.ingest_core, spec=spec,
+                          allow_pallas=False))(empty_state(spec), batch)
+
+    def fused_core(state, batch):
+        state = fused_ingest_core(state, batch, spec=spec, interpret=False)
+        return step._fold_core(state)
+
+    fused = jax.jit(fused_core)(empty_state(spec), batch)
+    for a, b in zip(ref, fused):
+        if not np.array_equal(np.asarray(a), np.asarray(b), equal_nan=True):
+            return False
+    return True
+
+
+def _run_probe_bounded(budget_s: float = 60.0) -> bool:
+    """Run _probe in a subprocess with a hard wall-clock budget: a Mosaic
+    lowering bug or a wedged backend must degrade to the XLA chain, not
+    hang or kill the server (same containment as pallas_digest)."""
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    code = ("import sys; sys.path.insert(0, %r); "
+            "from veneur_tpu.ops.pallas_ingest import _probe; "
+            "print('PALLAS_INGEST_OK' if _probe() else 'PALLAS_INGEST_NO')"
+            % root)
+    try:
+        res = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, text=True,
+                             timeout=budget_s)
+    except subprocess.TimeoutExpired:
+        log.warning("pallas ingest probe exceeded %.0fs budget", budget_s)
+        return False
+    return "PALLAS_INGEST_OK" in res.stdout

@@ -33,6 +33,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from veneur_tpu.aggregation.state import DeviceState, TableSpec, empty_state
 from veneur_tpu.aggregation.step import Batch, ingest_core, flush_core
+from veneur_tpu.ops import hll as hll_ops
 from veneur_tpu.ops import tdigest as td
 
 REPLICA_AXIS = "replica"
@@ -113,7 +114,7 @@ def make_sharded_ingest(mesh: Mesh, spec: TableSpec):
     """Jitted (state, batch) -> state over the mesh. Batch arrays must carry
     the same leading [R, S] dims as the state; each (replica, shard) tile's
     scatters stay on its own device — zero communication."""
-    core = partial(ingest_core, spec=spec)
+    core = partial(ingest_core, spec=spec, allow_pallas=False)
     vv = jax.vmap(jax.vmap(core))
     fn = _shard_map(
         vv, mesh=mesh,
@@ -138,7 +139,10 @@ def make_sharded_ingest_packed(mesh: Mesh, spec: TableSpec, sizes: tuple):
         compact_core, ingest_core, unpack_batch)
 
     def tile_ingest(state, flat):
-        return ingest_core(state, unpack_batch(flat[1:], sizes), spec=spec)
+        # allow_pallas=False: the tile body runs under two vmaps, where
+        # the fused kernel's scalar-prefetch grid does not apply
+        return ingest_core(state, unpack_batch(flat[1:], sizes),
+                           spec=spec, allow_pallas=False)
 
     vv_ingest = jax.vmap(jax.vmap(tile_ingest))
     vv_compact = jax.vmap(jax.vmap(partial(compact_core, spec=spec)))
@@ -190,8 +194,15 @@ def _merge_replica_block(state: DeviceState, spec: TableSpec):
                          state.h_recip_acc)
 
     # HLL: register-wise max (reference Set.Merge = HLL union,
-    # samplers/samplers.go:461)
-    hll = jax.lax.pmax(state.hll.max(axis=0), ax)
+    # samplers/samplers.go:461). The resident layout is 6-bit packed i32
+    # words; componentwise max of packed WORDS is not register max (a high
+    # register field dominates the word compare regardless of the low
+    # fields), so unpack to dense u8 registers, max locally and across the
+    # collective, repack. The dense form is transient — it never lands in
+    # state or HBM-resident buffers.
+    dense = hll_ops.unpack_registers(state.hll, precision=spec.hll_precision)
+    dense = jax.lax.pmax(dense.max(axis=0), ax)
+    hll = hll_ops.pack_registers(dense, precision=spec.hll_precision)
 
     # gauges/status: last-write-wins with canonical order = highest global
     # replica index that wrote (reference Gauge.Merge overwrites, :297)

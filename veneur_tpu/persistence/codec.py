@@ -37,12 +37,26 @@ from veneur_tpu.utils.atomicio import atomic_write_bytes, fsync_dir
 
 log = logging.getLogger("veneur_tpu.persistence.codec")
 
-SNAPSHOT_FORMAT_VERSION = 1
+SNAPSHOT_FORMAT_VERSION = 2
 
 # schema_hash() pinned per format version; check_snapshot_schema.py fails
 # when the live structures drift from the current version's pin
 _SCHEMA_PINS = {
     1: "f2901f08f86fee1c56067eb6c0668195cac0ad5cd042ea50ecad364d6baab4a2",
+    2: "fc98f22981986f4c0706c52de3c9a659d66d29e7f943267b51adaa18d8fac7c5",
+}
+
+# Older format versions this build still READS, with the layout change
+# each bump made. read_manifest accepts a listed version iff the
+# snapshot's hash matches that version's frozen pin, and restore.py owns
+# the forward conversion; an unlisted old version stays CorruptSnapshot.
+# check_snapshot_schema.py requires every superseded pin to appear here —
+# a silent layout drift can't pose as an intentional bump.
+_SCHEMA_MIGRATIONS = {
+    1: "HLL array chunk was dense uint8[rows, 2^p] registers; v2 stores "
+       "6-bit packed int32[rows, ceil(2^p*6/32)] words. Dense rows fold "
+       "through the normal restore merge path (ops/hll.py merge_rows_"
+       "packed), so v1 restores remain byte-exact.",
 }
 
 MANIFEST_NAME = "MANIFEST.json"
@@ -161,15 +175,26 @@ def read_manifest(dirpath: str) -> dict:
     if not isinstance(manifest, dict) or "chunks" not in manifest:
         raise CorruptSnapshot(f"{dirpath}: manifest missing chunk index")
     version = manifest.get("format_version")
-    if version != SNAPSHOT_FORMAT_VERSION:
+    if version == SNAPSHOT_FORMAT_VERSION:
+        if manifest.get("schema_hash") != schema_hash():
+            raise CorruptSnapshot(
+                f"{dirpath}: schema hash {manifest.get('schema_hash')!r} "
+                f"does not match this build's {schema_hash()!r} — "
+                "DeviceState or TableSpec changed shape since the "
+                "snapshot was written")
+    elif version in _SCHEMA_MIGRATIONS:
+        # a migratable older format: the hash must match that version's
+        # FROZEN pin (same drift protection the current version gets)
+        if manifest.get("schema_hash") != _SCHEMA_PINS.get(version):
+            raise CorruptSnapshot(
+                f"{dirpath}: v{version} snapshot with schema hash "
+                f"{manifest.get('schema_hash')!r}, expected the frozen "
+                f"v{version} pin {_SCHEMA_PINS.get(version)!r}")
+    else:
         raise CorruptSnapshot(
             f"{dirpath}: format version {version!r}, this build reads "
-            f"{SNAPSHOT_FORMAT_VERSION}")
-    if manifest.get("schema_hash") != schema_hash():
-        raise CorruptSnapshot(
-            f"{dirpath}: schema hash {manifest.get('schema_hash')!r} does "
-            f"not match this build's {schema_hash()!r} — DeviceState or "
-            "TableSpec changed shape since the snapshot was written")
+            f"{SNAPSHOT_FORMAT_VERSION} (+ migratable "
+            f"{sorted(_SCHEMA_MIGRATIONS)})")
     return manifest
 
 

@@ -120,11 +120,21 @@ def fold_snapshot(aggregator, snap: dict, skip_forwarded: bool = False) -> int:
             hostname=host, message=msg, imported_only=imp,
             joined_tags=joined)
         n += 1
+    # v2 snapshots hold 6-bit packed i32 word rows, v1 dense u8 register
+    # rows; either way the aggregator's restore interface takes dense u8
+    # "registers" (they fold through the normal merge path, so a v1
+    # snapshot restores byte-exact into the packed table)
+    hll_rows = np.asarray(arrays["hll"])
+    if hll_rows.dtype != np.uint8:
+        from veneur_tpu.ops.hll import unpack_registers_np
+        hll_rows = unpack_registers_np(
+            hll_rows.astype(np.int32),
+            precision=int(snap["spec"]["hll_precision"]))
     for i, kind, name, tags, scope, host, _msg, imp, joined in \
             rows("set"):
         aggregator.restore_metric(
             kind, name, tags, scope, _digest(kind, name, joined),
-            {"registers": np.asarray(arrays["hll"][i], np.uint8)},
+            {"registers": np.asarray(hll_rows[i], np.uint8)},
             hostname=host, imported_only=imp, joined_tags=joined)
         n += 1
     for i, kind, name, tags, scope, host, _msg, imp, joined in \

@@ -69,7 +69,9 @@ def build_snapshot(spec, table: KeyTable, result: Dict[str, np.ndarray],
         # raw has no status lane (status never forwards); the compact
         # flush result carries the same per-live-row values
         "status": np.asarray(result["status"], np.float32),
-        "hll": np.asarray(raw["hll"], np.uint8),
+        # v2: 6-bit packed i32 words straight off the flush raw gather
+        # (restore folds dense-u8 v1 rows through the same merge path)
+        "hll": np.asarray(raw["hll"], np.int32),
         "h_mean": np.asarray(raw["h_mean"], np.float32),
         "h_weight": np.asarray(raw["h_weight"], np.float32),
         "h_min": np.asarray(raw["h_min"], np.float32),

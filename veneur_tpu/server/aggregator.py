@@ -354,7 +354,7 @@ class Aggregator:
     def _flush_hll_imports(self):
         if not self._hll_slots:
             return
-        from veneur_tpu.ops.hll import merge_rows
+        from veneur_tpu.ops.hll import merge_rows_packed
         import jax.numpy as jnp
         b = 128
         slots = np.full(b, self.spec.set_capacity, np.int32)
@@ -363,8 +363,9 @@ class Aggregator:
         slots[:n] = self._hll_slots[:n]
         rows[:n] = np.stack(self._hll_rows[:n])
         self.state = self.state._replace(
-            hll=merge_rows(self.state.hll, jnp.asarray(slots),
-                           jnp.asarray(rows)))
+            hll=merge_rows_packed(self.state.hll, jnp.asarray(slots),
+                                  jnp.asarray(rows),
+                                  precision=self.spec.hll_precision))
         self._hll_slots, self._hll_rows = (self._hll_slots[b:],
                                            self._hll_rows[b:])
 

@@ -148,6 +148,12 @@ class Server:
         self.interval = cfg.parse_interval()
         self.hostname = cfg.hostname
         self.tags = list(cfg.tags)
+        # fused ingest kernel gate (ops/pallas_ingest.py): None restores
+        # probe gating (kernel on TPU, XLA chain on CPU), False forces
+        # the chain everywhere. Set before any aggregator compiles.
+        from veneur_tpu.ops import pallas_ingest
+        pallas_ingest.set_enabled(
+            None if cfg.pallas_ingest_enabled else False)
         agg_args = dict(
             spec=spec_from_config(cfg),
             bspec=BatchSpec(counter=cfg.tpu_batch_counter,

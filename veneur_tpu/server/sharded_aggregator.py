@@ -65,7 +65,8 @@ def _gather_sharded_impl(out, cidx, gidx, stidx, setidx, hidx):
 
 def _gather_sharded_raw_impl(st, setidx, hidx):
     """Raw sketch state of live rows, packed like the flush gather (one
-    transfer; uint8 HLL rows ride as bitcast f32 words)."""
+    transfer; 6-bit packed i32 HLL rows ride as bitcast f32 words — safe
+    for the same run-of-set-bits reason as step._pack_outputs)."""
     import jax
     import jax.numpy as jnp
 
@@ -89,6 +90,8 @@ def _gather_sharded_raw_impl(st, setidx, hidx):
         if a.dtype == jnp.uint8:
             a = jax.lax.bitcast_convert_type(a.reshape((-1, 4)),
                                              jnp.float32)
+        elif a.dtype == jnp.int32:
+            a = jax.lax.bitcast_convert_type(a, jnp.float32)
         parts.append(a.reshape(-1).astype(jnp.float32))
     return jnp.concatenate(parts)
 
@@ -96,7 +99,7 @@ def _gather_sharded_raw_impl(st, setidx, hidx):
 def _sharded_raw_shapes(pspec, n_set, n_h):
     cells = pspec.centroids + pspec.temp_cells
     f32 = "float32"
-    return {"hll": ((n_set, pspec.registers), "uint8"),
+    return {"hll": ((n_set, pspec.hll_words), "int32"),
             "h_weight": ((n_h, cells), f32), "h_mean": ((n_h, cells), f32),
             "h_min": ((n_h,), f32), "h_max": ((n_h,), f32),
             "recip_hi": ((n_h,), f32), "recip_lo": ((n_h,), f32)}
@@ -293,24 +296,39 @@ class ShardedAggregator(Aggregator):
         self._dispatch_row([b.force_emit() for b in self.batchers])
 
     def _apply_hll_imports(self):
-        """Imported HLL rows merge on-device via scatter-max (rare path:
-        only a global tier with sharded state receives these). Runs on
-        the pipeline thread out of swap(), so it must not materialize
-        the [1, S, K, R] table on host — that blocks behind every queued
-        ingest step. Scatter-max handles duplicate (shard, local) slots
-        identically to a sequential merge: max is order-free."""
+        """Imported HLL rows merge on-device (rare path: only a global
+        tier with sharded state receives these). Runs on the pipeline
+        thread out of swap(), so it must not materialize the
+        [1, S, K, W] table on host — that blocks behind every queued
+        ingest step. With the 6-bit packed resident layout the update is
+        gather packed words -> unpack -> register max -> repack ->
+        scatter-set; duplicate (shard, local) targets are folded on the
+        host first (np.maximum.at — register max is order-free) because
+        a scatter-SET with duplicate targets is ill-defined, unlike the
+        old dense register scatter-max."""
         if not self._hll_slots:
             return
         import jax
         import jax.numpy as jnp
+        from veneur_tpu.ops.hll import pack_registers, unpack_registers
         from veneur_tpu.parallel.sharded import state_sharding
 
-        sh = jnp.asarray(np.array([s for s, _ in self._hll_slots],
-                                  np.int32))
-        loc = jnp.asarray(np.array([l for _, l in self._hll_slots],
-                                   np.int32))
-        rows = jnp.asarray(np.stack(self._hll_rows).astype(np.uint8))
-        hll = self.state.hll.at[0, sh, loc].max(rows, mode="drop")
+        sh = np.array([s for s, _ in self._hll_slots], np.int64)
+        loc = np.array([l for _, l in self._hll_slots], np.int64)
+        rows = np.stack(self._hll_rows).astype(np.uint8)
+        key = sh * (self.pspec.set_capacity + 1) + loc
+        uniq, inv = np.unique(key, return_inverse=True)
+        folded = np.zeros((len(uniq), rows.shape[1]), np.uint8)
+        np.maximum.at(folded, inv, rows)
+        sh_u = jnp.asarray((uniq // (self.pspec.set_capacity + 1))
+                           .astype(np.int32))
+        loc_u = jnp.asarray((uniq % (self.pspec.set_capacity + 1))
+                            .astype(np.int32))
+        p = self.pspec.hll_precision
+        cur = unpack_registers(self.state.hll[0, sh_u, loc_u], precision=p)
+        merged = pack_registers(jnp.maximum(cur, jnp.asarray(folded)),
+                                precision=p)
+        hll = self.state.hll.at[0, sh_u, loc_u].set(merged, mode="drop")
         self.state = self.state._replace(
             hll=jax.device_put(hll, state_sharding(self.mesh)))
         self._hll_slots, self._hll_rows = [], []
