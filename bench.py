@@ -219,7 +219,26 @@ def main():
         # benchmarks/results/ (builder-side, clearly labeled historical)
         # next to the live smoke numbers.
         try:
+            import calendar
             import glob
+            import re
+            cap_date = re.compile(r"_tpu_capture_(\d{4}-\d{2}-\d{2})\.json$")
+
+            def capture_stamp(path, cap):
+                """Epoch stamp for newest-capture selection: the in-JSON
+                captured_at when present, else the filename date —
+                format-asserted so a rename can't silently demote the
+                real newest capture via string comparison."""
+                ts = cap.get("captured_at")
+                if ts is not None:
+                    return float(ts)
+                m = cap_date.search(os.path.basename(path))
+                assert m, (f"capture {os.path.basename(path)!r} has no "
+                           "captured_at field and no _tpu_capture_"
+                           "YYYY-MM-DD.json date to order by")
+                return float(calendar.timegm(
+                    time.strptime(m.group(1), "%Y-%m-%d")))
+
             caps = []
             for path in glob.glob(os.path.join(
                     here, "benchmarks", "results", "*_tpu_capture_*.json")):
@@ -229,9 +248,11 @@ def main():
                 except (OSError, ValueError):
                     continue   # one truncated file must not hide the rest
                 if cap.get("platform") == "tpu" and cap.get("value"):
-                    caps.append((os.path.basename(path), cap))
+                    caps.append((capture_stamp(path, cap),
+                                 os.path.basename(path), cap))
             if caps:
-                name, cap = max(caps)   # filenames carry the date
+                stamp, name, cap = max(
+                    caps, key=lambda item: (item[0], item[1]))
                 out["last_known_tpu"] = {
                     "value": cap["value"],
                     "vs_baseline": cap.get("vs_baseline"),
@@ -347,7 +368,7 @@ def main():
             # ingest, 4: global merge, 9: exactly-once under ack loss):
             # under the wall-clock guard the TAIL gets truncated, never
             # the head
-            out["e2e"] = e2e.main(configs=[2, 1, 4, 9, 10, 3, 5, 6, 7, 8],
+            out["e2e"] = e2e.main(configs=[2, 1, 4, 9, 10, 11, 3, 5, 6, 7, 8],
                                   scale=scale,
                                   force_cpu=on_cpu, on_result=on_result,
                                   deadline=T0 + guard - 45.0)
@@ -366,6 +387,18 @@ def main():
                     - cfg4["merged_p99_err_mean"]
                 cfg9["p99_err_delta_vs_config4"] = round(delta, 5)
                 cfg9["p99_unchanged_vs_config4"] = abs(delta) <= 2e-3
+            # config 11 gate "p99 within config4's bound": same seed and
+            # load merged on the collective mesh instead of over gRPC —
+            # the routed device fold is byte-compatible with the wire
+            # fold, so the digest error must not move either
+            cfg11 = next((r for r in out["e2e"] if r.get("config") == 11),
+                         None)
+            if cfg4 and cfg11 and "merged_p99_err_max" in cfg4 \
+                    and "merged_p99_err_max" in cfg11:
+                delta = cfg11["merged_p99_err_max"] \
+                    - cfg4["merged_p99_err_max"]
+                cfg11["p99_err_delta_vs_config4"] = round(delta, 5)
+                cfg11["p99_within_config4_bound"] = delta <= 2e-3
         except Exception as e:  # bench must still print its line
             out["e2e_error"] = f"{type(e).__name__}: {e}"
 

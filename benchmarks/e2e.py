@@ -1372,11 +1372,138 @@ def config10_wire_to_flush_firehose(scale=1.0):
         srv.shutdown()
 
 
+# -- config 11: collective 64→8-device merge — zero-serialization -------------
+
+def config11_collective_merge(scale=1.0):
+    """Config4's 64→1 merge rerun over the collective mesh tier: the 64
+    locals hand their raw device batches straight to a co-located
+    CollectiveGlobalTier (collective/tier.py) — hash-routed all_to_all
+    placement, replica merge on device — instead of serializing
+    MetricLists over loopback gRPC. Same rng seed and load shape as
+    config4 so the rows are directly comparable: counters must stay
+    exact, merged p99 must sit at config4's digest error (bench.py
+    cross-checks the two rows), and the wire path must carry ZERO bytes
+    (the global has no gRPC listener; imported_total must not move).
+    The linear-scaling gate — absorb+merge rate holds a per-device floor
+    as the mesh grows — arms on TPU only: forced host 'devices' on the
+    CPU smoke share one socket, so CPU checks routing + accuracy."""
+    import jax
+
+    from veneur_tpu.aggregation.host import BatchSpec
+    from veneur_tpu.aggregation.state import TableSpec
+    from veneur_tpu.collective import tier as collective_tier
+    from veneur_tpu.samplers.parser import parse_metric
+    from veneur_tpu.server.aggregator import Aggregator
+    from veneur_tpu.sinks.debug import DebugMetricSink
+
+    n_locals = 64
+    counters = max(8, int(200 * scale))
+    histos = max(4, int(50 * scale))
+    histo_samples = 20
+    rng = np.random.default_rng(4)      # config4's seed: same oracle
+
+    n_dev = len(jax.devices())
+    n_replicas = 2 if n_dev >= 2 else 1
+    n_shards = max(1, n_dev // n_replicas)
+
+    spec = TableSpec(counter_capacity=1 << 10, gauge_capacity=64,
+                     status_capacity=16, set_capacity=16,
+                     histo_capacity=1 << 8)
+    bspec = BatchSpec(counter=2048, gauge=64, status=16, set=64, histo=2048)
+
+    all_histo_vals = {h: [] for h in range(histos)}
+    raws = []
+    for li in range(n_locals):
+        agg = Aggregator(spec, bspec)
+        for c in range(counters):
+            m = parse_metric(
+                b"merged.counter.%d:%d|c|#veneurglobalonly" % (c, li + c))
+            agg.process_metric(m)
+        for h in range(histos):
+            vals = rng.lognormal(2.0, 0.8, histo_samples)
+            all_histo_vals[h].extend(vals.tolist())
+            for v in vals:
+                agg.process_metric(
+                    parse_metric(b"merged.timer.%d:%.4f|ms" % (h, v)))
+        # keep the RAW flush (device batches + key table), never
+        # export_metrics: the absorb below is the zero-serialization path
+        _, table, raw = agg.flush([0.5], want_raw=True)
+        raws.append((raw, table))
+
+    sink = DebugMetricSink()
+    glob = _mk_server([sink], collective_enabled=True,
+                      collective_group="bench11",
+                      tpu_n_replicas=n_replicas, tpu_n_shards=n_shards,
+                      tpu_counter_capacity=1 << 12,
+                      tpu_histo_capacity=1 << 9)
+    try:
+        _warm(glob, [b"warm.c:1|c", b"warm.t:1.0|ms"], sinks=[sink])
+        tier = collective_tier.lookup("bench11")
+        if tier is None:
+            raise RuntimeError("collective group 'bench11' not registered")
+        # one participant id per local, held across cycles — exactly what
+        # a co-located Server._absorb_colocated does on its first absorb
+        parts = [tier.assign_participant() for _ in range(n_locals)]
+        for cycle in range(2):   # first cycle compiles the size bucket
+            phase(f"cycle{cycle}")
+            sink.flushed.clear()
+            t0 = time.perf_counter()
+            absorbed = 0
+            for p, (raw, table) in zip(parts, raws):
+                absorbed += tier.absorb_raw(raw, table, participant=p)
+            absorb_dt = time.perf_counter() - t0
+            _flush_checked(glob, timeout=WARM_TIMEOUT if cycle == 0
+                           else FLUSH_WAIT)
+            dt = time.perf_counter() - t0
+
+        flushed = {m.name: m.value for m in sink.flushed}
+        counter_exact = all(
+            flushed.get(f"merged.counter.{c}") ==
+            sum(li + c for li in range(n_locals))
+            for c in range(counters))
+        p99_errs = []
+        for h in range(histos):
+            got = flushed.get(f"merged.timer.{h}.99percentile")
+            exact = midpoint_quantile(all_histo_vals[h], 0.99)
+            if got is not None and exact > 0:
+                p99_errs.append(abs(got - exact) / exact)
+        rate = absorbed / absorb_dt if absorb_dt > 0 else 0.0
+        on_tpu = jax.default_backend() == "tpu"
+        # linear scaling ⇔ aggregate absorb+route rate holds a per-device
+        # floor as devices grow; 100k merged rows/s/device is config4's
+        # single-global sustained-absorb bar with decode removed, split
+        # across the mesh with headroom for the all_to_all hop
+        per_dev_floor = 100_000.0
+        return {
+            "config": 11, "name": "collective_merge_64to8dev",
+            "devices": n_dev,
+            "mesh_replicas": n_replicas, "mesh_shards": n_shards,
+            "n_locals": n_locals,
+            "metrics_forwarded": int(absorbed),   # rows, config4's unit
+            "absorbed_rows": int(absorbed),
+            "absorbed_rows_per_sec": round(rate, 1),
+            "serialized_forward_bytes": 0,
+            "wire_imports": int(glob.imported_total),
+            "zero_serialization": glob.imported_total == 0,
+            "counters_exact": bool(counter_exact),
+            "merged_p99_err_mean": round(float(np.mean(_acc(
+                p99_errs, "merged p99", flushed_keys=len(flushed)))), 5),
+            "merged_p99_err_max": round(float(np.max(p99_errs)), 5),
+            "on_chip_gate_linear_scaling_armed": on_tpu,
+            "rows_per_sec_per_device_ge_floor":
+                (rate / n_dev >= per_dev_floor) if on_tpu else None,
+            "wall_seconds": round(dt, 3),
+        }
+    finally:
+        glob.shutdown()
+
+
 CONFIGS = {1: config1_counter_replay, 2: config2_zipf_timers,
            3: config3_set_cardinality, 4: config4_global_merge,
            5: config5_span_firehose, 6: config6_cardinality_stress,
            7: config7_checkpoint_restore, 8: config8_overload_storm,
-           9: config9_duplicate_storm, 10: config10_wire_to_flush_firehose}
+           9: config9_duplicate_storm, 10: config10_wire_to_flush_firehose,
+           11: config11_collective_merge}
 
 # Per-config subprocess budget: backend init + first XLA compiles of the
 # config's size buckets (~tens of seconds each on the tunneled chip) +
@@ -1518,6 +1645,14 @@ def _run_config_subprocess(n, scale, force_cpu=False, budget_cap=None):
     # resolving it here would initialize the backend in the parent and
     # block every child from acquiring the single tunneled chip
     env = cache_env(force_cpu=force_cpu)
+    if n == 11:
+        # the collective config needs a multi-device mesh; on a CPU-only
+        # host, force 8 host devices (the flag is a no-op for real
+        # accelerator platforms, so it is safe to add unconditionally)
+        flags = env.get("XLA_FLAGS", "")
+        if "--xla_force_host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
     budget = _config_budget(n)
     if budget_cap is not None:
         # the orchestrator's wall-clock guard wins over per-config

@@ -1,0 +1,349 @@
+"""Collective global tier (veneur_tpu/collective/): byte-exactness of
+the zero-serialization absorb path vs the serialized gRPC forward path
+on all five metric types, hash-routing determinism across process
+restarts, the in-server co-located short-circuit, and multi-host
+snapshot assembly round-trips.
+
+The parity tests use INTEGER sample values: both paths round through
+f32 staging identically, so every comparison below is byte-equality —
+including the raw 6-bit packed HLL register words and the raw t-digest
+centroid sets — except the R>1 harmonic-mean scalar (see the test)."""
+
+import os
+import subprocess
+import sys
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from veneur_tpu.aggregation.host import (BatchSpec, SCOPE_GLOBAL,
+                                         SCOPE_MIXED)
+from veneur_tpu.aggregation.state import TableSpec
+from veneur_tpu.collective.keytable import (CollectiveKeyTable,
+                                            route_digest, route_shard)
+from veneur_tpu.collective.tier import CollectiveGlobalTier
+from veneur_tpu.forward.convert import export_metrics, import_into
+from veneur_tpu.server.aggregator import Aggregator
+from veneur_tpu.server.sharded_aggregator import ShardedAggregator
+from veneur_tpu.utils.hashing import fnv1a_32
+
+SPEC = TableSpec(counter_capacity=64, gauge_capacity=32,
+                 status_capacity=8, set_capacity=16, histo_capacity=32)
+BS = BatchSpec(counter=256, gauge=32, status=8, set=64, histo=512,
+               histo_stat=32)
+PCTS = [0.5, 0.99]
+
+
+def pm(agg, kind, name, value, scope=SCOPE_GLOBAL, tags=(), rate=1.0):
+    m = SimpleNamespace(type=kind, name=name, tags=tuple(tags),
+                        scope=scope, digest=fnv1a_32(name.encode()),
+                        value=value, sample_rate=rate, hostname="",
+                        message="", joined_tags=",".join(tags))
+    agg.process_metric(m)
+
+
+def make_local(seed, pidx):
+    """One local tier's interval: counters/gauge/timer/histogram/set,
+    integer sample values (f32-exact on both paths)."""
+    agg = Aggregator(SPEC, BS)
+    rng = np.random.default_rng(seed)
+    for i in range(5):
+        for _ in range(3):
+            pm(agg, "counter", f"c.{i}", int(rng.integers(1, 100)))
+    pm(agg, "gauge", f"g.{pidx}", float(pidx) + 0.5)
+    for v in rng.integers(1, 1000, 20):
+        pm(agg, "timer", "t.shared", float(v))
+    for v in rng.integers(1, 500, 10):
+        pm(agg, "histogram", "h.shared", float(v), scope=SCOPE_MIXED)
+    for j in range(30):
+        pm(agg, "set", "s.shared", f"member-{seed}-{j}")
+    return agg
+
+
+def flush(agg):
+    st, tb = agg.swap()
+    return agg.compute_flush(st, tb, PCTS, want_raw=True)
+
+
+def collect(res, tb):
+    """{(kind-or-histo-key, name): value} using the row-i ↔ get_meta[i]
+    pairing (flush result arrays are full-capacity padded)."""
+    d = {}
+    for i, (_s, meta) in enumerate(tb.get_meta("counter")):
+        d[("counter", meta.name)] = float(res["counter"][i])
+    for i, (_s, meta) in enumerate(tb.get_meta("gauge")):
+        d[("gauge", meta.name)] = float(res["gauge"][i])
+    for i, (_s, meta) in enumerate(tb.get_meta("set")):
+        d[("set", meta.name)] = float(res["set_estimate"][i])
+    for i, (_s, meta) in enumerate(tb.get_meta("histogram")):
+        for k in res:
+            if k.startswith("histo_"):
+                d[(k, meta.name)] = np.asarray(res[k][i])
+    return d
+
+
+def hll_by_name(raw, tb):
+    return {meta.name: np.asarray(raw["hll"][i])
+            for i, (_s, meta) in enumerate(tb.get_meta("set"))}
+
+
+def centroids_by_name(raw, tb):
+    """Live (mean, weight) cells, lexsorted — cell ORDER may differ
+    between staging layouts; the multiset must not."""
+    out = {}
+    for i, (_s, meta) in enumerate(tb.get_meta("histogram")):
+        w = np.asarray(raw["h_weight"][i])
+        m = np.asarray(raw["h_mean"][i])
+        live = w > 0
+        order = np.lexsort((w[live], m[live]))
+        out[meta.name] = (m[live][order], w[live][order])
+    return out
+
+
+def _absorb_and_import(n_replicas, n_participants=4):
+    """Drive IDENTICAL local intervals through both global paths:
+    absorb_raw into a collective tier, export→wire→import_into a
+    ShardedAggregator. Returns both (result, table, raw) triples."""
+    tier = CollectiveGlobalTier(SPEC, BS, n_shards=2,
+                                n_replicas=n_replicas)
+    sh = ShardedAggregator(SPEC, BS, n_shards=2)
+    for p in range(n_participants):
+        a = make_local(100 + p, p)
+        b = make_local(100 + p, p)
+        st, tb = a.swap()
+        _res, tb, raw = a.compute_flush(st, tb, PCTS, want_raw=True)
+        n = tier.absorb_raw(raw, tb)
+        st2, tb2 = b.swap()
+        _r2, tb2, raw2 = b.compute_flush(st2, tb2, PCTS, want_raw=True)
+        wire = export_metrics(raw2, tb2, SPEC.compression,
+                              SPEC.hll_precision)
+        assert n == len(wire)  # one absorbed row per wire metric
+        for m in wire:
+            import_into(sh, m)
+    return flush(tier), flush(sh)
+
+
+def test_absorb_byte_exact_vs_grpc_path_r1():
+    """R=1: every flush entry of all five metric types, the raw packed
+    HLL words, and the raw digest centroid sets are byte-identical
+    between the zero-serialization absorb and the wire path."""
+    (rt, tt, rawt), (rs, ts, raws) = _absorb_and_import(n_replicas=1)
+    ct, cs = collect(rt, tt), collect(rs, ts)
+    assert set(ct) == set(cs)
+    for k in ct:
+        assert np.array_equal(np.asarray(ct[k]), np.asarray(cs[k])), k
+    ht, hs = hll_by_name(rawt, tt), hll_by_name(raws, ts)
+    assert set(ht) == set(hs)
+    for k in ht:
+        assert np.array_equal(ht[k], hs[k]), f"hll {k}"
+    dt, ds = centroids_by_name(rawt, tt), centroids_by_name(raws, ts)
+    for k in dt:
+        assert np.array_equal(dt[k][0], ds[k][0]), f"centroid means {k}"
+        assert np.array_equal(dt[k][1], ds[k][1]), f"centroid weights {k}"
+
+
+def test_absorb_parity_r2_replica_merge():
+    """R=2: participants spread over replica rows and merge through the
+    ICI collectives. Everything stays byte-exact EXCEPT histo_hmean:
+    the harmonic mean folds f32 reciprocal terms in a replica-dependent
+    grouping, an inherent ~1e-7 rounding difference (neither grouping
+    is canonical)."""
+    (rt, tt, rawt), (rs, ts, raws) = _absorb_and_import(n_replicas=2)
+    ct, cs = collect(rt, tt), collect(rs, ts)
+    assert set(ct) == set(cs)
+    for k in ct:
+        a, b = np.asarray(ct[k]), np.asarray(cs[k])
+        if k[0] == "histo_hmean":
+            assert np.allclose(a, b, rtol=1e-5), k
+        else:
+            assert np.array_equal(a, b), k
+    ht, hs = hll_by_name(rawt, tt), hll_by_name(raws, ts)
+    for k in ht:
+        assert np.array_equal(ht[k], hs[k]), f"hll {k}"
+    dt, ds = centroids_by_name(rawt, tt), centroids_by_name(raws, ts)
+    for k in dt:
+        assert np.array_equal(dt[k][0], ds[k][0]), f"centroid means {k}"
+        assert np.array_equal(dt[k][1], ds[k][1]), f"centroid weights {k}"
+
+
+# -- hash-routing determinism ------------------------------------------------
+
+_KEYS = [("counter", f"det.c.{i}", "env:prod,zone:a") for i in range(40)] \
+    + [("timer", f"det.t.{i}", "") for i in range(40)] \
+    + [("set", f"det.s.{i}", "svc:x") for i in range(20)]
+
+
+# roomy enough that no per-shard bucket can overflow: admission under
+# overflow is arrival-ordered BY DESIGN (first keys to a full shard
+# win), and this test is about routing, not capacity
+_ROUTE_SPEC = TableSpec(counter_capacity=512, gauge_capacity=64,
+                        status_capacity=8, set_capacity=256,
+                        histo_capacity=512)
+
+
+def _routing_table_signature(order_seed):
+    """Build a CollectiveKeyTable with keys inserted in a shuffled
+    order; the (key -> owner shard) signature must not budge."""
+    keys = list(_KEYS)
+    np.random.default_rng(order_seed).shuffle(keys)
+    table = CollectiveKeyTable(_ROUTE_SPEC, n_shards=4)
+    for kind, name, joined in keys:
+        tags = tuple(joined.split(",")) if joined else ()
+        table.slot_for_routed(kind, name, tags, SCOPE_GLOBAL,
+                              joined_tags=joined)
+    return table.routing_signature()
+
+
+def test_routing_ignores_arrival_order():
+    assert _routing_table_signature(1) == _routing_table_signature(2)
+
+
+def test_routing_determinism_across_process_restarts():
+    """route_shard and the full table signature are pure functions of
+    key identity: two fresh interpreters (different PYTHONHASHSEED, so
+    dict/set iteration differs) must agree with this process."""
+    prog = (
+        "import numpy as np\n"
+        "from tests.test_collective import (_routing_table_signature,"
+        " _KEYS)\n"
+        "from veneur_tpu.collective.keytable import route_shard\n"
+        "sig = _routing_table_signature(3)\n"
+        "shards = [route_shard(k, n, j, 4) for k, n, j in _KEYS]\n"
+        "print(sig, ','.join(map(str, shards)))\n")
+    expected_sig = _routing_table_signature(3)
+    expected_shards = [route_shard(k, n, j, 4) for k, n, j in _KEYS]
+    for hashseed in ("1", "2"):
+        env = {**os.environ, "PYTHONHASHSEED": hashseed,
+               "JAX_PLATFORMS": "cpu"}
+        proc = subprocess.run(
+            [sys.executable, "-c", prog], capture_output=True, text=True,
+            cwd=os.path.dirname(os.path.dirname(__file__)), env=env,
+            timeout=180)
+        assert proc.returncode == 0, proc.stderr
+        sig, shards = proc.stdout.split()
+        assert int(sig) == expected_sig
+        assert [int(s) for s in shards.split(",")] == expected_shards
+
+
+def test_route_digest_matches_restore_recipe():
+    """Restored rows must land on the shard the live tier routed them
+    to: the routing digest IS the restore digest."""
+    from veneur_tpu.persistence.restore import _digest
+    for kind, name, joined in _KEYS:
+        assert route_digest(kind, name, joined) == _digest(
+            kind, name, joined)
+
+
+# -- multi-host snapshot assembly --------------------------------------------
+
+def _snapshot_of(agg, hostname):
+    from veneur_tpu.persistence import build_snapshot
+    st, tb = agg.swap()
+    res, tb, raw = agg.compute_flush(st, tb, PCTS, want_raw=True)
+    return build_snapshot(agg.spec, tb, res, raw, agg_kind="sharded",
+                          n_shards=getattr(agg, "n_shards", 1),
+                          interval_ts=1722470400, hostname=hostname)
+
+
+def test_assembly_round_trip(tmp_path):
+    """N per-process parts under one manifest restore byte-exactly onto
+    BOTH a collective tier (same-mesh restart) and a single-process
+    sharded backend — and restore_latest picks the assembly up."""
+    from veneur_tpu.persistence import (finalize_assembly, fold_snapshot,
+                                        restore_latest, write_part)
+    # simulate 3 processes each persisting its own keys (hash routing
+    # keeps the part key sets disjoint in a real mesh; any disjoint
+    # partition exercises the same union)
+    parts = []
+    for rank in range(3):
+        agg = Aggregator(SPEC, BS)
+        rng = np.random.default_rng(900 + rank)
+        for i in range(4):
+            pm(agg, "counter", f"asm.c.{rank}.{i}",
+               int(rng.integers(1, 50)))
+        pm(agg, "gauge", f"asm.g.{rank}", float(rank) * 2.0)
+        for v in rng.integers(1, 300, 12):
+            pm(agg, "timer", f"asm.t.{rank}", float(v))
+        for j in range(15):
+            pm(agg, "set", f"asm.s.{rank}", f"m-{rank}-{j}")
+        parts.append(_snapshot_of(agg, f"proc-{rank}"))
+
+    root = str(tmp_path)
+    for rank, snap in enumerate(parts):
+        write_part(root, 7, rank, snap)
+    # un-finalized: restore must NOT see it yet
+    assert restore_latest(root) is None
+    finalize_assembly(root, 7, n_parts=3)
+    got = restore_latest(root)
+    assert got is not None
+    snap, path = got
+    assert path.endswith("ckpt-00000007-assembly")
+    assert snap["agg_kind"] == "assembly"
+    n_rows = sum(len(snap["tables"][k]) for k in snap["tables"])
+    assert n_rows == sum(
+        len(p["tables"][k]) for p in parts for k in p["tables"])
+
+    tier = CollectiveGlobalTier(SPEC, BS, n_shards=2, n_replicas=2)
+    sh = ShardedAggregator(SPEC, BS, n_shards=2)
+    assert fold_snapshot(tier, snap) == n_rows
+    assert fold_snapshot(sh, snap) == n_rows
+    rt, tt, _rawt = flush(tier)
+    rs, ts, _raws = flush(sh)
+    ct, cs = collect(rt, tt), collect(rs, ts)
+    assert set(ct) == set(cs) and len(ct) > 0
+    for k in ct:
+        assert np.array_equal(np.asarray(ct[k]), np.asarray(cs[k])), k
+
+
+def test_assembly_rejects_missing_part(tmp_path):
+    from veneur_tpu.persistence import finalize_assembly, write_part
+    from veneur_tpu.persistence.codec import CorruptSnapshot
+    agg = Aggregator(SPEC, BS)
+    pm(agg, "counter", "one.c", 3)
+    write_part(str(tmp_path), 9, 0, _snapshot_of(agg, "p0"))
+    with pytest.raises(CorruptSnapshot):
+        finalize_assembly(str(tmp_path), 9, n_parts=2)
+
+
+# -- in-server co-located short-circuit --------------------------------------
+
+def test_server_colocated_absorb_skips_wire():
+    """A local server attached to a co-located collective tier forwards
+    its interval as device arrays: the tier aggregates correctly and no
+    forward client is ever dialed (serialized forward bytes == 0 by
+    construction)."""
+    from veneur_tpu.server.server import Server
+    from veneur_tpu.sinks.debug import DebugMetricSink
+    from tests.test_server import (_send_udp, _wait_processed, by_name,
+                                   small_config)
+
+    gsink = DebugMetricSink()
+    gsrv = Server(small_config(collective_enabled=True,
+                               collective_group="t1",
+                               tpu_n_shards=4, tpu_n_replicas=2),
+                  metric_sinks=[gsink])
+    assert isinstance(gsrv.aggregator, CollectiveGlobalTier)
+    gsrv.start()
+    lsink = DebugMetricSink()
+    lsrv = Server(small_config(collective_attach="t1"),
+                  metric_sinks=[lsink])
+    try:
+        assert lsrv.cfg.is_local and lsrv._forward_client is None
+        lsrv.start()
+        lines = ([b"colo.count:3|c|#veneurglobalonly"] * 5
+                 + [b"colo.timer:%d|ms" % v for v in (10, 20, 30, 40)]
+                 + [b"colo.set:u%d|s" % i for i in range(8)])
+        _send_udp(lsrv.local_addr(), lines)
+        _wait_processed(lsrv, len(lines))
+        lsrv.trigger_flush()
+        assert gsrv.aggregator.absorbed_rows > 0
+        gsink.flushed.clear()
+        gsrv.trigger_flush()
+        m = by_name(gsink.flushed)
+        assert m["colo.count"].value == 15.0
+        assert m["colo.timer.50percentile"].value == 25.0
+        assert round(m["colo.set"].value) == 8
+    finally:
+        lsrv.shutdown()
+        gsrv.shutdown()

@@ -230,10 +230,15 @@ def test_signalfx_status_gauge_and_sinkonly_dim_stripped():
 def fake_tokens_api():
     """Paginated SignalFx tokens API (reference signalfx.go:280-344):
     GET /v2/token?limit=200&offset=N with {"results": [{name, secret}]}
-    pages; an empty page ends pagination."""
+    pages; a short (< limit) page ends pagination."""
     class Handler(http.server.BaseHTTPRequestHandler):
-        pages = {0: [{"name": "acme", "secret": "tok-acme-2"},
-                     {"name": "newco", "secret": "tok-newco"}]}
+        # page 0 is FULL (200 entries) so the fetcher must turn the
+        # page; the short page at offset=200 ends pagination
+        pages = {0: [{"name": "fill-%d" % i, "secret": "tok-fill-%d" % i}
+                     for i in range(198)]
+                 + [{"name": "acme", "secret": "tok-acme-2"},
+                    {"name": "newco", "secret": "tok-newco"}],
+                 200: [{"name": "late", "secret": "tok-late"}]}
         requests = []
 
         def log_message(self, *a):
@@ -278,7 +283,9 @@ def test_signalfx_dynamic_token_refresh(fake_tokens_api):
     assert sink._token_for(["customer:newco"]) == "tok-newco"
     assert sink._token_for(["customer:legacy"]) == "tok-legacy"
     assert sink._token_for(["customer:unknown"]) == "default"
-    # pagination: page 0 then the empty page at offset=limit
+    assert sink._token_for(["customer:late"]) == "tok-late"
+    # pagination: full page 0 forces a second fetch; the SHORT page at
+    # offset=200 ends pagination with no trailing empty-page probe
     offsets = [int(q["offset"][0]) for _, _, q in handler.requests]
     assert offsets == [0, 200]
     # auth rides the default token header

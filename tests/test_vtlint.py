@@ -212,6 +212,50 @@ CASES = [
         """},
     ),
     (
+        # same pass, shard_map surface: bodies are per-tile device code —
+        # Python branching on a tile, np host sync, and collectives with a
+        # missing or numeric axis must flag; named mesh axes (literal or
+        # module constant) must not
+        "jax-hot-path",
+        lambda p: jax_hot_path.run(p, hot_funcs={}, donating_jits={},
+                                   sync_scan=[], pallas_scan=[],
+                                   shard_map_scan=["pkg"]),
+        {"pkg/mesh.py": """
+            import jax
+            import numpy as np
+            from jax.experimental.shard_map import shard_map
+
+            def _block(state, batch):
+                if batch.sum() > 0:
+                    state = state + batch
+                total = jax.lax.psum(state)
+                wide = jax.lax.all_gather(batch, 0)
+                host = np.asarray(wide)
+                return total
+
+            def make(mesh, specs):
+                return shard_map(_block, mesh=mesh, in_specs=specs,
+                                 out_specs=specs)
+        """},
+        {"pkg/mesh.py": """
+            import functools
+            import jax
+            from jax.experimental.shard_map import shard_map
+
+            REPLICA_AXIS = "replica"
+
+            def _block(state, batch):
+                total = jax.lax.psum(state + batch, REPLICA_AXIS)
+                wide = jax.lax.all_gather(batch, "shard")
+                row = jax.lax.axis_index(REPLICA_AXIS)
+                return total + wide.sum() + row
+
+            def make(mesh, specs):
+                return shard_map(functools.partial(_block), mesh=mesh,
+                                 in_specs=specs, out_specs=specs)
+        """},
+    ),
+    (
         "lock-discipline",
         lambda p: lock_discipline.run(p, modules=["pkg/mod.py"]),
         {"pkg/mod.py": """

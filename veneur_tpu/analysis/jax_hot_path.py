@@ -36,6 +36,17 @@ pass catches mechanically:
    so nested closure kernels are scanned too; keyword-only kernel
    params are treated as host statics (the `functools.partial`
    convention) and stay untainted.
+5. **Host code inside shard_map bodies** — a function handed to
+   `shard_map` traces per-device-tile exactly like a kernel: every
+   positional parameter is a device shard, so the same host-sync and
+   traced-branching rules apply. Additionally, every collective in a
+   body must name its mesh axis: `lax.psum(x, ...)`-family calls with
+   the axis argument MISSING rely on implicit axis context that does
+   not exist under shard_map (trace-time error at best), and a bare
+   NUMERIC axis silently means a positional array axis on several of
+   these APIs — the reduce happens inside one shard instead of across
+   the mesh. A string literal or a named constant (REPLICA_AXIS /
+   SHARD_AXIS) passes.
 """
 
 from __future__ import annotations
@@ -60,6 +71,9 @@ HOT_FUNCS: Dict[str, List[str]] = {
     "veneur_tpu/server/sharded_aggregator.py": [
         "_dispatch_row", "_on_shard_batch", "_emit_all",
         "_apply_hll_imports", "swap"],
+    "veneur_tpu/collective/tier.py": [
+        "_dispatch_row", "_dispatch_routed", "_on_stage_batch",
+        "absorb_raw", "swap"],
 }
 
 # named jit wrappers that MUST donate their state argument: dropping
@@ -346,10 +360,141 @@ def _check_pallas_kernels(ctx: FileContext) -> List[Finding]:
     return findings
 
 
+# lax collectives and the positional index of their axis-name argument;
+# axis_index takes it first, the reducers/permuters take it second
+_COLLECTIVE_AXIS_ARG = {
+    "psum": 1, "pmax": 1, "pmin": 1, "pmean": 1, "all_gather": 1,
+    "all_to_all": 1, "psum_scatter": 1, "ppermute": 1, "axis_index": 0,
+}
+
+
+def _axis_arg_ok(axis: ast.AST) -> bool:
+    """A collective axis must be NAMED: a string literal, a variable /
+    attribute holding one (REPLICA_AXIS), or a tuple of those. A
+    numeric literal is a positional-array-axis footgun."""
+    if isinstance(axis, ast.Constant):
+        return isinstance(axis.value, str)
+    if isinstance(axis, (ast.Name, ast.Attribute)):
+        return True
+    if isinstance(axis, (ast.Tuple, ast.List)):
+        return bool(axis.elts) and all(_axis_arg_ok(e) for e in axis.elts)
+    return False
+
+
+def _check_collective_axes(ctx: FileContext, fn) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        resolved = ctx.resolve(node.func)
+        leaf = (resolved or "").rsplit(".", 1)[-1]
+        idx = _COLLECTIVE_AXIS_ARG.get(leaf)
+        if idx is None:
+            continue
+        axis = None
+        if len(node.args) > idx:
+            axis = node.args[idx]
+        else:
+            for kw in node.keywords:
+                if kw.arg in ("axis_name", "axis"):
+                    axis = kw.value
+                    break
+        if axis is None:
+            findings.append(Finding(
+                NAME, ctx.rel, node.lineno,
+                f"`{leaf}` inside shard_map body {fn.name}() names no "
+                "mesh axis — shard_map bodies have no implicit axis "
+                "context; pass the axis name (REPLICA_AXIS/SHARD_AXIS)"))
+        elif not _axis_arg_ok(axis):
+            findings.append(Finding(
+                NAME, ctx.rel, node.lineno,
+                f"`{leaf}` inside shard_map body {fn.name}() passes a "
+                "non-name axis argument — a numeric axis means a "
+                "positional array axis, reducing WITHIN one shard "
+                "instead of across the mesh; use the mesh axis name"))
+    return findings
+
+
+def _check_shard_map_body(ctx: FileContext, fn) -> List[Finding]:
+    """A shard_map body is device code: every positional param is a
+    per-tile shard, so the kernel taint walk applies verbatim — plus
+    the named-collective-axis rule."""
+    findings: List[Finding] = []
+    tainted: Set[str] = set()
+    for arg in list(fn.args.posonlyargs) + list(fn.args.args):
+        tainted.add(arg.arg)
+    if fn.args.vararg is not None:
+        tainted.add(fn.args.vararg.arg)
+
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) \
+                and _is_tainted(node.value, ctx, tainted):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    tainted.add(t.id)
+        elif isinstance(node, (ast.If, ast.While)) \
+                and _is_tainted(node.test, ctx, tainted):
+            kind = "if" if isinstance(node, ast.If) else "while"
+            findings.append(Finding(
+                NAME, ctx.rel, node.lineno,
+                f"Python `{kind}` on a device value inside shard_map "
+                f"body {fn.name}() — the body traces once per tile; "
+                "use lax.cond / lax.fori_loop for data-dependent "
+                "control flow"))
+        elif isinstance(node, ast.Call):
+            fname = node.func
+            resolved = ctx.resolve(fname)
+            if resolved in _HOST_CONVERTERS and len(node.args) >= 1 \
+                    and _is_tainted(node.args[0], ctx, tainted):
+                findings.append(Finding(
+                    NAME, ctx.rel, node.lineno,
+                    f"`{resolved}()` on a device value inside shard_map "
+                    f"body {fn.name}() — host conversion in device "
+                    "code fails at trace time"))
+            elif resolved in _NP_CONVERTERS and node.args \
+                    and _is_tainted(node.args[0], ctx, tainted):
+                findings.append(Finding(
+                    NAME, ctx.rel, node.lineno,
+                    f"`{resolved.replace('numpy', 'np')}` on a device "
+                    f"value inside shard_map body {fn.name}() — host "
+                    "materialization in device code; keep the merge "
+                    "in jnp"))
+            elif isinstance(fname, ast.Attribute) \
+                    and fname.attr in _SYNC_METHODS \
+                    and _is_tainted(fname.value, ctx, tainted):
+                findings.append(Finding(
+                    NAME, ctx.rel, node.lineno,
+                    f"`.{fname.attr}()` on a device value inside "
+                    f"shard_map body {fn.name}() — host sync in device "
+                    "code"))
+    findings.extend(_check_collective_axes(ctx, fn))
+    return findings
+
+
+def _check_shard_maps(ctx: FileContext) -> List[Finding]:
+    findings: List[Finding] = []
+    checked = set()
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        resolved = ctx.resolve(node.func)
+        if (resolved or "").rsplit(".", 1)[-1] != "shard_map":
+            continue
+        # same call-site resolution as kernels: a bare name or
+        # functools.partial(name, ...) defined anywhere in this file
+        body = _kernel_def(ctx, node)
+        if body is None or id(body) in checked:
+            continue
+        checked.add(id(body))
+        findings.extend(_check_shard_map_body(ctx, body))
+    return findings
+
+
 def run(project: Project, hot_funcs: Dict[str, List[str]] = None,
         donating_jits: Dict[str, List[str]] = None,
         sync_scan: List[str] = None,
-        pallas_scan: List[str] = None) -> List[Finding]:
+        pallas_scan: List[str] = None,
+        shard_map_scan: List[str] = None) -> List[Finding]:
     findings: List[Finding] = []
     for rel, funcs in (hot_funcs if hot_funcs is not None
                        else HOT_FUNCS).items():
@@ -381,4 +526,7 @@ def run(project: Project, hot_funcs: Dict[str, List[str]] = None,
     for ctx in project.files(*(pallas_scan if pallas_scan is not None
                                else scan)):
         findings.extend(_check_pallas_kernels(ctx))
+    for ctx in project.files(*(shard_map_scan if shard_map_scan is not None
+                               else scan)):
+        findings.extend(_check_shard_maps(ctx))
     return findings

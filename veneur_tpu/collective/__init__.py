@@ -1,0 +1,24 @@
+"""Collective global tier: hash-routed mesh key table + ICI sketch
+merge, zero-serialization co-located forward.
+
+- ops.py      named-axis sketch-merge collectives (generalized out of
+              parallel/sharded.py)
+- keytable.py deterministic hash-routed key table (route by key
+              identity, owner assigns slots)
+- router.py   all_to_all routed ingest + replica-merged-state programs
+- tier.py     CollectiveGlobalTier server backend + process-local
+              tier registry
+"""
+
+from veneur_tpu.collective.keytable import (
+    CollectiveKeyTable, route_digest, route_shard)
+from veneur_tpu.collective.ops import (
+    REPLICA_AXIS, SHARD_AXIS, digest_axis_merge, extremes_axis_merge,
+    hll_axis_max, lww_axis_merge, merge_replica_block, twofloat_axis_sum)
+
+__all__ = [
+    "REPLICA_AXIS", "SHARD_AXIS", "CollectiveKeyTable", "route_digest",
+    "route_shard", "merge_replica_block", "twofloat_axis_sum",
+    "hll_axis_max", "lww_axis_merge", "digest_axis_merge",
+    "extremes_axis_merge",
+]

@@ -1,0 +1,328 @@
+"""CollectiveGlobalTier: the global aggregation tier as a mesh resident.
+
+A ShardedAggregator whose mesh carries a real replica axis: co-located
+local tiers hand their flush's raw sketch arrays straight to
+`absorb_raw` (zero serialization — no protobuf, no gRPC, no wire
+bytes), rows are staged into per-(replica row, source column, OWNER
+shard) buckets using the hash-routed CollectiveKeyTable, and one
+on-device `all_to_all` inside shard_map delivers every bucket to its
+owner tile where the ordinary ingest scatter applies it
+(collective/router.py). Flush time replica-merges the mesh with the
+same named-axis sketch collectives the sharded backend uses
+(collective/ops.py) — the 64-process gRPC merge becomes one collective
+program over ICI.
+
+The envelope/gRPC forward path stays authoritative for cross-host (DCN)
+peers: a local tier with a dialed forward client keeps using it;
+`collective_attach` only short-circuits the co-located case.
+
+Participant rows spread over replica rows round-robin (participant p ->
+replica p % R, staging column (p // R) % S), so N locals' absorbs
+parallelize over the replica axis instead of serializing into row 0.
+Absorb payloads are EXACTLY what the wire path would deliver —
+iter_forwardable (forward/convert.py) is shared with export_metrics —
+with one documented exception: HLL rows skip the axiomhq nibble
+serialization, so where that format's tailcut would saturate a register
+spread > 15 the absorbed union is lossless (strictly more accurate, and
+byte-identical whenever the spread fits, i.e. in practice).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from functools import partial
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from veneur_tpu.aggregation.host import Batcher, BatchSpec
+from veneur_tpu.aggregation.state import TableSpec
+from veneur_tpu.aggregation.step import Batch
+from veneur_tpu.collective.keytable import CollectiveKeyTable
+from veneur_tpu.server.sharded_aggregator import (
+    ShardedAggregator, per_shard_spec)
+
+# -- process-local tier registry -------------------------------------------
+# Co-located servers living in one process (the deployment shape the
+# collective tier exists for) find each other here; lookup by group name
+# at flush time so start order does not matter.
+_REGISTRY: Dict[str, "CollectiveGlobalTier"] = {}
+_REGISTRY_LOCK = threading.Lock()
+
+
+def register(group: str, tier: "CollectiveGlobalTier") -> None:
+    with _REGISTRY_LOCK:
+        _REGISTRY[group] = tier
+
+
+def lookup(group: str) -> Optional["CollectiveGlobalTier"]:
+    with _REGISTRY_LOCK:
+        return _REGISTRY.get(group)
+
+
+def unregister(group: str, tier: "CollectiveGlobalTier") -> None:
+    with _REGISTRY_LOCK:
+        if _REGISTRY.get(group) is tier:
+            del _REGISTRY[group]
+
+
+class CollectiveGlobalTier(ShardedAggregator):
+    def __init__(self, spec: TableSpec, bspec: BatchSpec = BatchSpec(),
+                 n_shards: int = 2, n_replicas: int = 1,
+                 compact_every: int = 8):
+        import jax  # noqa: F401  (device availability surfaces early)
+        from veneur_tpu.aggregation.step import batch_sizes
+        from veneur_tpu.collective.router import (
+            make_merged_state, make_routed_ingest, shard_axis_is_physical)
+        from veneur_tpu.parallel import (
+            make_mesh, make_merged_flush, make_sharded_ingest_packed,
+            sharded_empty_state)
+
+        self.spec = spec
+        self.pspec = per_shard_spec(spec, n_shards)
+        self.bspec = bspec
+        self.n_shards = n_shards
+        self.n_replicas = max(1, int(n_replicas))
+        self.compact_every = compact_every
+
+        self.mesh = make_mesh(self.n_replicas, n_shards)
+        self._sizes = batch_sizes(Batcher(self.pspec, bspec).force_emit())
+        self._ingest = make_sharded_ingest_packed(self.mesh, self.pspec,
+                                                  self._sizes)
+        self._flush = make_merged_flush(self.mesh, self.pspec)
+        self._merge = make_merged_state(self.mesh, self.pspec)
+        self._empty = partial(sharded_empty_state, self.pspec,
+                              self.n_replicas, n_shards, self.mesh)
+        self.state = self._empty()
+        self.table = CollectiveKeyTable(spec, n_shards)
+        # direct traffic (process_metric / import_metric / restore)
+        # stages into replica row 0 through the inherited batchers
+        self.batchers = self._make_batchers()
+        # absorb staging: one Batcher per (replica row, source column,
+        # owner shard); the routed all_to_all delivers buckets to owners
+        self._route_device = shard_axis_is_physical(self.mesh, n_shards)
+        self._routed = (make_routed_ingest(self.mesh, self.pspec)
+                        if self._route_device else None)
+        self._stage_grid = self._make_stage_grid()
+        self._absorb_lock = threading.Lock()
+        self._next_participant = 0
+        self._routed_steps = 0
+        self.absorbed_rows = 0
+        self._hll_slots: List[Tuple[int, int]] = []
+        self._hll_rows: List[np.ndarray] = []
+        self._restore_residuals: list = []
+        self._steps = 0
+        self.processed = 0
+        self.dropped_capacity = 0
+        self.h2d_bytes = 0
+        self.step_ns = 0
+        self.steps_total = 0
+        self._init_degrade()
+
+    # -- absorb staging ------------------------------------------------------
+    def _make_stage_grid(self):
+        if not self._route_device:
+            return None
+        grid = []
+        for r in range(self.n_replicas):
+            row = []
+            for j in range(self.n_shards):
+                row.append([Batcher(self.pspec, self.bspec,
+                                    on_batch=partial(self._on_stage_batch,
+                                                     r, j, d))
+                            for d in range(self.n_shards)])
+            grid.append(row)
+        return grid
+
+    def _on_stage_batch(self, r: int, j: int, d: int, batch: Batch):
+        """A stage bucket filled mid-absorb: emit the whole grid (the
+        routed program is rectangular) with the filled bucket's batch in
+        place, everyone else force-emitted — the _on_shard_batch pattern
+        one level up."""
+        self._dispatch_routed(
+            lambda rr, jj, dd: batch if (rr, jj, dd) == (r, j, d)
+            else self._stage_grid[rr][jj][dd].force_emit())
+
+    def _dispatch_routed(self, get):
+        nested = []
+        for r in range(self.n_replicas):
+            row = []
+            for j in range(self.n_shards):
+                dest = [get(r, j, d) for d in range(self.n_shards)]
+                cols = list(zip(*dest))
+                row.append(Batch(*[None if all(x is None for x in col)
+                                   else np.stack(col) for col in cols]))
+            nested.append(row)
+        from veneur_tpu.parallel import stack_batches
+        batch = stack_batches(nested, self.n_replicas, self.n_shards)
+        self.h2d_bytes += sum(a.nbytes for a in batch if a is not None)
+        t0 = time.perf_counter_ns()
+        self.state = self._routed(self.state, batch)
+        self.step_ns += time.perf_counter_ns() - t0
+        self.steps_total += 1
+        # absorbed digest rows land in temp cells like any other ingest;
+        # ride the packed program's in-band compact word at the same
+        # cadence as direct traffic so they recompress
+        self._routed_steps += 1
+        if self._routed_steps % self.compact_every == 0:
+            self._dispatch_row([b.force_emit() for b in self.batchers],
+                               force_compact=True)
+
+    def _emit_absorbed(self):
+        if self._stage_grid is None:
+            return
+        if not any(b.pending() for row in self._stage_grid
+                   for cell in row for b in cell):
+            return
+        self._dispatch_routed(
+            lambda r, j, d: self._stage_grid[r][j][d].force_emit())
+
+    # -- direct dispatch over the [R, S] mesh --------------------------------
+    def _dispatch_row(self, row, force_compact: bool = False):
+        """Direct-traffic twin of ShardedAggregator._dispatch_row for an
+        R-row mesh: row 0 carries the packed shard batches, rows 1..R-1
+        carry a constant all-padding packed row (absorbed traffic reaches
+        them through the routed path instead)."""
+        from veneur_tpu.aggregation.step import pack_batch, packed_layout
+        self._steps += 1
+        self.steps_total += 1
+        dc = force_compact or (self._steps % self.compact_every == 0)
+        bufs = getattr(self, "_row_bufs", None)
+        if bufs is None:
+            words = packed_layout(self._sizes)[1]
+            pad = np.zeros(words, np.int32)
+            pack_batch(Batcher(self.pspec, self.bspec).force_emit(),
+                       False, out=pad)
+            base = np.broadcast_to(
+                pad, (self.n_replicas, self.n_shards, words)).copy()
+            bufs = self._row_bufs = [base, base.copy(), 0]
+        flat = bufs[bufs[2]]
+        bufs[2] ^= 1
+        for i, b in enumerate(row):
+            pack_batch(b, dc, out=flat[0, i])
+        self.h2d_bytes += flat.nbytes
+        t0 = time.perf_counter_ns()
+        self.state = self._ingest(self.state, flat)
+        self.step_ns += time.perf_counter_ns() - t0
+
+    # -- zero-serialization absorb -------------------------------------------
+    def assign_participant(self) -> int:
+        """Claim a stable participant id (-> replica row / staging
+        column) for a co-located local tier."""
+        with self._absorb_lock:
+            p = self._next_participant
+            self._next_participant += 1
+            return p
+
+    def absorb_raw(self, raw, table, participant: Optional[int] = None
+                   ) -> int:
+        """Fold a co-located local tier's flush output (raw arrays + its
+        detached KeyTable) into the collective state. Returns the number
+        of rows absorbed. Thread-safe against concurrent absorbs and the
+        tier's own swap."""
+        from veneur_tpu.forward.convert import iter_forwardable
+        with self._absorb_lock:
+            if participant is None:
+                participant = self._next_participant
+                self._next_participant += 1
+            r = participant % self.n_replicas
+            j = (participant // self.n_replicas) % self.n_shards
+            n = 0
+            for kind, meta, scope, payload in iter_forwardable(
+                    raw, table, self.spec.hll_precision):
+                self._absorb_one(r, j, kind, meta, scope, payload)
+                n += 1
+            self.absorbed_rows += n
+            return n
+
+    def _absorb_one(self, r: int, j: int, kind: str, meta, scope: int,
+                    payload: dict) -> None:
+        slot = self.table.slot_for_routed(
+            kind, meta.name, meta.tags, scope, hostname=meta.hostname,
+            imported=True, joined_tags=meta.joined_tags)
+        if slot is None:
+            self.dropped_capacity += 1
+            return
+        shard, local = self._local(kind, slot)
+        if self._stage_grid is not None:
+            b = self._stage_grid[r][j][shard]
+        else:
+            # collapsed fallback mesh: owner-bucket on the host straight
+            # into the direct batchers (semantically identical delivery)
+            b = self.batchers[shard]
+        if kind == "counter":
+            b.add_counter(local, float(payload["value"]), 1.0)
+        elif kind == "gauge":
+            b.add_gauge(local, float(payload["value"]))
+        elif kind == "set":
+            # imported register rows can't ride the Batch member lanes;
+            # they merge through the established (shard, local) host
+            # fold -> on-device register max (order-free), replica row 0
+            regs = payload["registers"]
+            if regs.shape[0] != self.pspec.registers:
+                raise ValueError("absorbed HLL register-count mismatch")
+            self._hll_slots.append((shard, local))
+            self._hll_rows.append(regs)
+        elif kind in ("histogram", "timer"):
+            means = np.asarray(payload["means"], np.float32)
+            weights = np.asarray(payload["weights"], np.float32)
+            live = weights > 0
+            means, weights = means[live], weights[live]
+            b.add_histos_bulk(np.full(len(means), local, np.int32),
+                              means, weights)
+            recip = payload.get("recip")
+            recip_corr = 0.0
+            if recip is not None and np.all(means != 0.0):
+                recip_corr = float(recip) - float(np.sum(weights / means))
+            b.add_histo_stats(local, float(payload.get("min", np.inf)),
+                              float(payload.get("max", -np.inf)),
+                              recip_corr)
+        self.processed += 1
+
+    # -- flush ---------------------------------------------------------------
+    def swap(self):
+        with self._absorb_lock:
+            self._emit_absorbed()
+            state, table = super().swap()
+            # super() installed a plain KeyTable; the collective tier
+            # routes by key identity
+            self.table = CollectiveKeyTable(self.spec, self.n_shards)
+            self._stage_grid = self._make_stage_grid()
+            self._routed_steps = 0
+            return state, table
+
+    def compute_flush(self, state, table, percentiles,
+                      want_raw: bool = False):
+        if not want_raw or self.n_replicas == 1:
+            # R == 1: the inherited raw gather reads the state verbatim,
+            # byte-identical to the sharded backend by construction
+            return super().compute_flush(state, table, percentiles,
+                                         want_raw)
+        import jax
+        import jax.numpy as jnp
+        from veneur_tpu.aggregation.step import live_indices, unpack_flush
+        from veneur_tpu.server.sharded_aggregator import (
+            _gather_sharded_raw, _sharded_raw_shapes)
+        # R > 1: replica-merge the mesh first (same collectives as the
+        # flush), then reuse the [1, S] raw gather on the merged state
+        result, table = super().compute_flush(state, table, percentiles)
+        setidx = jnp.asarray(
+            live_indices(table, "set", self.spec.set_capacity))
+        hidx = jnp.asarray(
+            live_indices(table, "histogram", self.spec.histo_capacity))
+        merged = jax.tree.map(lambda x: x[None], self._merge(state))
+        r = unpack_flush(
+            np.asarray(_gather_sharded_raw(merged, setidx, hidx)),
+            _sharded_raw_shapes(self.pspec, len(setidx), len(hidx)))
+        raw = {
+            "counter": result["counter"],
+            "gauge": result["gauge"],
+            "hll": r["hll"],
+            "h_mean": r["h_mean"],
+            "h_weight": r["h_weight"],
+            "h_min": r["h_min"],
+            "h_max": r["h_max"],
+            "h_recip": r["recip_hi"].astype(np.float64) + r["recip_lo"],
+        }
+        return result, table, raw

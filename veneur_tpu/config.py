@@ -316,14 +316,25 @@ class Config:
     # bottom/top centroids kept exact through compression (per-key p99
     # tail accuracy; ops/tdigest.py DEFAULT_EXACT_EXTREMES)
     tpu_digest_exact_extremes: int = 64
+    # collective global tier (veneur_tpu/collective/): the global tier as
+    # a mesh resident over (tpu_n_replicas, shards). collective_enabled
+    # makes THIS server the tier and registers it under collective_group;
+    # collective_attach makes THIS (local) server hand its forwardable
+    # flush rows to the co-located tier of that group as device arrays —
+    # zero serialization — instead of gRPC. forward_address stays
+    # authoritative for cross-host (DCN) peers.
+    collective_enabled: bool = False
+    collective_group: str = "default"
+    collective_attach: str = ""
 
     def parse_interval(self) -> float:
         return parse_duration(self.interval)
 
     @property
     def is_local(self) -> bool:
-        """Local ⇔ forwards to a global tier (reference server.go:1434)."""
-        return self.forward_address != ""
+        """Local ⇔ forwards to a global tier (reference server.go:1434),
+        whether over the wire or into a co-located collective tier."""
+        return self.forward_address != "" or self.collective_attach != ""
 
 
 _DEFAULTS = {

@@ -63,42 +63,45 @@ def _wire_str(s: str) -> str:
                                                            "replace")
 
 
-def export_metrics(raw: Dict[str, np.ndarray], table: KeyTable,
-                   compression: float, hll_precision: int
-                   ) -> List[mpb.Metric]:
-    """Build the forwardable MetricList from a flush's raw state."""
-    out: List[mpb.Metric] = []
+def iter_forwardable(raw: Dict[str, np.ndarray], table: KeyTable,
+                     hll_precision: int):
+    """Yield (kind, meta, scope, payload) for every forward-eligible
+    live row of a flush — the scope filters of worker.go:181
+    ForwardableMetrics with payloads in the exact form
+    Aggregator.import_metric receives after an export -> wire -> import
+    round-trip (scope already coerced per worker.go:442-447). BOTH
+    forward paths consume this one generator: export_metrics builds
+    protobuf from it for the DCN/gRPC path, and the collective tier's
+    absorb_raw feeds the payloads straight into device staging (zero
+    serialization), so the two paths cannot drift.
 
-    # raw arrays are COMPACT: row i pairs with get_meta(kind)[i]
-    # (aggregator.compute_flush want_raw gathers live rows on device)
+    raw arrays are COMPACT: row i pairs with get_meta(kind)[i]
+    (aggregator.compute_flush want_raw gathers live rows on device).
+
+    One deviation: set payloads carry the losslessly unpacked dense
+    registers, where the wire's axiomhq nibble form saturates register
+    spreads > 15 (hll_ops.serialize tailcut) — identical whenever the
+    spread fits, strictly more accurate otherwise."""
     for i, (_slot, meta) in enumerate(table.get_meta("counter")):
         if meta.scope != SCOPE_GLOBAL:
             continue  # only global counters forward (worker.go:186-193)
-        m = mpb.Metric(name=_wire_str(meta.name),
-                       tags=[_wire_str(t) for t in meta.tags],
-                       type=mpb.Counter, scope=mpb.Global)
-        m.counter.value = int(round(float(raw["counter"][i])))
-        out.append(m)
+        yield ("counter", meta, SCOPE_GLOBAL,
+               {"value": int(round(float(raw["counter"][i])))})
 
     for i, (_slot, meta) in enumerate(table.get_meta("gauge")):
         if meta.scope != SCOPE_GLOBAL:
             continue
-        m = mpb.Metric(name=_wire_str(meta.name),
-                       tags=[_wire_str(t) for t in meta.tags],
-                       type=mpb.Gauge, scope=mpb.Global)
-        m.gauge.value = float(raw["gauge"][i])
-        out.append(m)
+        yield ("gauge", meta, SCOPE_GLOBAL,
+               {"value": float(raw["gauge"][i])})
 
     for i, (_slot, meta) in enumerate(table.get_meta("set")):
         if meta.scope == SCOPE_LOCAL:
             continue  # local-only sets flush locally, never forward
-        m = mpb.Metric(name=_wire_str(meta.name),
-                       tags=[_wire_str(t) for t in meta.tags], type=mpb.Set,
-                       scope=mpb.Global if meta.scope == SCOPE_GLOBAL
-                       else mpb.Mixed)
-        m.set.hyper_log_log = hll_ops.serialize(raw["hll"][i],
-                                                hll_precision)
-        out.append(m)
+        regs = hll_ops.unpack_registers_np(
+            np.asarray(raw["hll"][i], np.int32), precision=hll_precision)
+        yield ("set", meta,
+               SCOPE_GLOBAL if meta.scope == SCOPE_GLOBAL else 0,
+               {"registers": np.asarray(regs, np.uint8)})
 
     for i, (_slot, meta) in enumerate(table.get_meta("histogram")):
         if meta.scope == SCOPE_LOCAL:
@@ -107,20 +110,52 @@ def export_metrics(raw: Dict[str, np.ndarray], table: KeyTable,
         live = w > 0
         if not live.any():
             continue
-        mtype = mpb.Timer if meta.kind == "timer" else mpb.Histogram
-        m = mpb.Metric(name=_wire_str(meta.name),
-                       tags=[_wire_str(t) for t in meta.tags], type=mtype,
-                       scope=mpb.Global if meta.scope == SCOPE_GLOBAL
-                       else mpb.Mixed)
-        td = m.histogram.t_digest
-        td.compression = compression
-        td.min = float(raw["h_min"][i])
-        td.max = float(raw["h_max"][i])
-        td.reciprocalSum = float(raw["h_recip"][i])
-        means = raw["h_mean"][i][live]
-        weights = w[live]
-        for mean, wt in zip(means, weights):
-            td.main_centroids.add(mean=float(mean), weight=float(wt))
+        kind = "timer" if meta.kind == "timer" else "histogram"
+        yield (kind, meta,
+               SCOPE_GLOBAL if meta.scope == SCOPE_GLOBAL else 0,
+               {"means": raw["h_mean"][i][live], "weights": w[live],
+                "min": float(raw["h_min"][i]),
+                "max": float(raw["h_max"][i]),
+                "recip": float(raw["h_recip"][i])})
+
+
+def export_metrics(raw: Dict[str, np.ndarray], table: KeyTable,
+                   compression: float, hll_precision: int
+                   ) -> List[mpb.Metric]:
+    """Build the forwardable MetricList from a flush's raw state."""
+    out: List[mpb.Metric] = []
+    for kind, meta, _scope, payload in iter_forwardable(raw, table,
+                                                        hll_precision):
+        name = _wire_str(meta.name)
+        tags = [_wire_str(t) for t in meta.tags]
+        pb_scope = (mpb.Global if meta.scope == SCOPE_GLOBAL
+                    else mpb.Mixed)
+        if kind == "counter":
+            m = mpb.Metric(name=name, tags=tags, type=mpb.Counter,
+                           scope=mpb.Global)
+            m.counter.value = payload["value"]
+        elif kind == "gauge":
+            m = mpb.Metric(name=name, tags=tags, type=mpb.Gauge,
+                           scope=mpb.Global)
+            m.gauge.value = payload["value"]
+        elif kind == "set":
+            m = mpb.Metric(name=name, tags=tags, type=mpb.Set,
+                           scope=pb_scope)
+            # serialize unpacks packed rows itself, so dense registers
+            # produce the identical wire bytes
+            m.set.hyper_log_log = hll_ops.serialize(payload["registers"],
+                                                    hll_precision)
+        else:
+            mtype = mpb.Timer if kind == "timer" else mpb.Histogram
+            m = mpb.Metric(name=name, tags=tags, type=mtype,
+                           scope=pb_scope)
+            td = m.histogram.t_digest
+            td.compression = compression
+            td.min = payload["min"]
+            td.max = payload["max"]
+            td.reciprocalSum = payload["recip"]
+            for mean, wt in zip(payload["means"], payload["weights"]):
+                td.main_centroids.add(mean=float(mean), weight=float(wt))
         out.append(m)
 
     return out

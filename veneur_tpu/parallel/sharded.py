@@ -32,19 +32,13 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from veneur_tpu.aggregation.state import DeviceState, TableSpec, empty_state
-from veneur_tpu.aggregation.step import Batch, ingest_core, flush_core
-from veneur_tpu.ops import hll as hll_ops
-from veneur_tpu.ops import tdigest as td
-
-REPLICA_AXIS = "replica"
-SHARD_AXIS = "shard"
-
-# jax.shard_map went public after 0.4.x; older installs only have the
-# experimental location
-try:
-    _shard_map = jax.shard_map
-except AttributeError:
-    from jax.experimental.shard_map import shard_map as _shard_map
+from veneur_tpu.aggregation.step import Batch, flush_core, ingest_core
+# the replica-tier merge collectives live in collective/ops.py (reusable
+# over any named axis); this module keeps the mesh/state plumbing and the
+# historical names
+from veneur_tpu.collective.ops import (
+    REPLICA_AXIS, SHARD_AXIS, merge_replica_block,
+    shard_map as _shard_map)
 
 
 def make_mesh(n_replicas: int, n_shards: int, devices=None) -> Mesh:
@@ -161,108 +155,9 @@ def make_sharded_ingest_packed(mesh: Mesh, spec: TableSpec, sizes: tuple):
 
 def _merge_replica_block(state: DeviceState, spec: TableSpec):
     """Inside shard_map: merge a [r_local, s_local, ...] block over the full
-    replica axis (local reduce + named-axis collective). Returns arrays with
-    the replica dims reduced away — one merged table per shard tile."""
-    ax = REPLICA_AXIS
-
-    def pair_total(hi, lo, acc):
-        """Sum two-float pairs across ALL replicas without collapsing to
-        f32 (a plain psum of hi+lo rounds the ~48-bit pairs back to 24
-        bits — the same boundary bug combine_flush_scalars fixes on the
-        host). Gather every replica's pair and fold sequentially with
-        error-free TwoSum merges; the global counter merge then matches
-        the reference's exact int64 adds (importsrv -> Counter.Merge)."""
-        from veneur_tpu.utils.numerics import twofloat_add, twofloat_merge
-        hi, lo = twofloat_add(hi, lo, acc)   # absorb any unfolded acc
-        hs = jax.lax.all_gather(hi, ax)      # [Rg, r_local, s, K]
-        ls = jax.lax.all_gather(lo, ax)
-        hs = hs.reshape((-1,) + hs.shape[2:])
-        ls = ls.reshape((-1,) + ls.shape[2:])
-
-        def body(carry, x):
-            return twofloat_merge(carry[0], carry[1], x[0], x[1]), None
-
-        (h, l), _ = jax.lax.scan(body, (hs[0], ls[0]), (hs[1:], ls[1:]))
-        return h, l
-
-    counters = pair_total(state.counter_hi, state.counter_lo,
-                          state.counter_acc)
-    h_count = pair_total(state.h_count_hi, state.h_count_lo,
-                         state.h_count_acc)
-    h_sum = pair_total(state.h_sum_hi, state.h_sum_lo, state.h_sum_acc)
-    h_recip = pair_total(state.h_recip_hi, state.h_recip_lo,
-                         state.h_recip_acc)
-
-    # HLL: register-wise max (reference Set.Merge = HLL union,
-    # samplers/samplers.go:461). The resident layout is 6-bit packed i32
-    # words; componentwise max of packed WORDS is not register max (a high
-    # register field dominates the word compare regardless of the low
-    # fields), so unpack to dense u8 registers, max locally and across the
-    # collective, repack. The dense form is transient — it never lands in
-    # state or HBM-resident buffers.
-    dense = hll_ops.unpack_registers(state.hll, precision=spec.hll_precision)
-    dense = jax.lax.pmax(dense.max(axis=0), ax)
-    hll = hll_ops.pack_registers(dense, precision=spec.hll_precision)
-
-    # gauges/status: last-write-wins with canonical order = highest global
-    # replica index that wrote (reference Gauge.Merge overwrites, :297)
-    def lww(val, stamp):
-        r_local = val.shape[0]
-        ridx = jax.lax.axis_index(ax) * r_local + jnp.arange(r_local)
-        prio = jnp.where(stamp > 0, ridx[:, None, None] + 1, 0)
-        vals = jax.lax.all_gather(val, ax)          # [Rg, r_local, s, K]
-        prios = jax.lax.all_gather(prio, ax)
-        vals = vals.reshape((-1,) + vals.shape[2:])
-        prios = prios.reshape((-1,) + prios.shape[2:])
-        win = jnp.argmax(prios, axis=0)
-        merged = jnp.take_along_axis(vals, win[None], axis=0)[0]
-        written = prios.max(axis=0) > 0
-        return merged, written.astype(jnp.uint8)
-
-    gauge, gauge_stamp = lww(state.gauge, state.gauge_stamp)
-    status, status_stamp = lww(state.status, state.status_stamp)
-
-    # t-digest: gather every replica's centroids for the key, concatenate
-    # along the centroid axis, re-compress to canonical cells (the
-    # fixed-shape analogue of Histo.Merge digest re-add,
-    # samplers/samplers.go:726)
-    wm = jax.lax.all_gather(state.h_wm, ax)   # [Rg, r_local, s, K, C]
-    w = jax.lax.all_gather(state.h_w, ax)
-    wm = jnp.moveaxis(wm.reshape((-1,) + wm.shape[2:]), 0, -2)  # [s,K,R,C]
-    w = jnp.moveaxis(w.reshape((-1,) + w.shape[2:]), 0, -2)
-    s_l, k, r, c = w.shape
-    mean = wm / jnp.maximum(w, 1e-30)
-    mean = mean.reshape(s_l, k, r * c)
-    w = w.reshape(s_l, k, r * c)
-    m2, w2 = td.compress_rows(mean, w, compression=spec.compression,
-                              cells_per_k=spec.cells_per_k,
-                              out_c=spec.centroids,
-                              exact_extremes=spec.exact_extremes)
-    # back to the state's [C + temp] column layout, temp emptied
-    pad = jnp.zeros(w2.shape[:-1] + (spec.temp_cells,), w2.dtype)
-    w2 = jnp.concatenate([w2, pad], axis=-1)
-    wm2 = jnp.concatenate([m2 * w2[..., :spec.centroids], pad], axis=-1)
-
-    h_min = jax.lax.pmin(state.h_min.min(axis=0), ax)
-    h_max = jax.lax.pmax(state.h_max.max(axis=0), ax)
-
-    z = jnp.zeros_like
-    merged = DeviceState(
-        counter_acc=z(counters[0]), counter_hi=counters[0],
-        counter_lo=counters[1],
-        gauge=gauge, gauge_stamp=gauge_stamp,
-        status=status, status_stamp=status_stamp,
-        hll=hll,
-        h_wm=wm2, h_w=w2,
-        h_temp_n=jnp.zeros(w2.shape[:-1], jnp.int32),
-        h_min=h_min, h_max=h_max,
-        h_count_acc=z(h_count[0]), h_count_hi=h_count[0],
-        h_count_lo=h_count[1],
-        h_sum_acc=z(h_sum[0]), h_sum_hi=h_sum[0], h_sum_lo=h_sum[1],
-        h_recip_acc=z(h_recip[0]), h_recip_hi=h_recip[0],
-        h_recip_lo=h_recip[1],
-    )
-    return merged
+    replica axis. The per-family sketch merges live in collective/ops.py
+    (generalized over the axis name); this wrapper pins the replica axis."""
+    return merge_replica_block(state, spec, REPLICA_AXIS)
 
 
 def make_merged_flush(mesh: Mesh, spec: TableSpec):

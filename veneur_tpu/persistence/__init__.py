@@ -6,8 +6,11 @@ warm restart (README §Durability).
   snapshot  build the in-memory snapshot from a flush's outputs
   writer    background serialize/fsync/rename/GC, off the flush path
   restore   validate, quarantine-on-corrupt, fold via sketch merges
+  assembly  multi-host checkpoints: per-process parts, one manifest
 """
 
+from veneur_tpu.persistence.assembly import (  # noqa: F401
+    finalize_assembly, list_assemblies, load_assembly, write_part)
 from veneur_tpu.persistence.codec import (  # noqa: F401
     SNAPSHOT_FORMAT_VERSION, CorruptSnapshot, list_checkpoints,
     load_dir, read_manifest, schema_hash, verify_dir)

@@ -44,11 +44,19 @@ def _digest(kind: str, name: str, joined_tags: str) -> int:
 def restore_latest(root: str, on_corrupt=None
                    ) -> Optional[Tuple[dict, str]]:
     """Newest-first scan: load the first checkpoint that validates,
-    quarantining every rejected one along the way. Returns
-    (snapshot, path) or None for a cold start."""
-    for seq, path in reversed(codec.list_checkpoints(root)):
+    quarantining every rejected one along the way. Multi-host assemblies
+    (persistence/assembly.py) rank alongside single-process checkpoints
+    by sequence number, assemblies first on a tie (an assembly at seq N
+    supersedes any stray single part at N). Returns (snapshot, path) or
+    None for a cold start."""
+    from veneur_tpu.persistence import assembly
+    candidates = sorted(
+        [(seq, 0, path) for seq, path in codec.list_checkpoints(root)]
+        + [(seq, 1, path) for seq, path in assembly.list_assemblies(root)])
+    for seq, is_asm, path in reversed(candidates):
         try:
-            snap = codec.load_dir(path)
+            snap = (assembly.load_assembly(path) if is_asm
+                    else codec.load_dir(path))
         except codec.CorruptSnapshot as e:
             log.warning("rejecting checkpoint %s: %s", path, e)
             try:

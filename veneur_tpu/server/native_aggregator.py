@@ -24,6 +24,13 @@ Known imprecisions, documented:
   deterministic (native batch first, Python batch second → Python-side
   write wins) but not arrival-ordered across the two streams. The
   single-stream case — by far the common one — is exactly ordered.
+- A corrupt MetricList tail is a PARTIAL apply: import_pb_bytes stages
+  incrementally, so metrics decoded before the undecodable boundary are
+  already merged when the tail is dropped-and-counted, where the Python
+  path's whole-request deserialize would reject ALL of them. Every
+  intact metric is preserved either way; the difference is only which
+  side of a mid-request corruption survives. (PARITY.md pins this with
+  the other native-path deviations.)
 """
 
 from __future__ import annotations

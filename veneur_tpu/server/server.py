@@ -174,7 +174,30 @@ class Server:
             devices = jax.devices()
             if len(devices) > 1 and devices[0].platform != "cpu":
                 n_shards = len(devices)
-        if n_shards > 1:
+        self._collective_registered = ""
+        if cfg.collective_enabled:
+            # collective global tier: the mesh-resident backend
+            # (collective/tier.py) over (tpu_n_replicas, shards); takes
+            # routed absorbs from co-located locals and replica-merges on
+            # device at flush
+            from veneur_tpu.collective import tier as collective_tier
+            n_replicas = max(1, cfg.tpu_n_replicas)
+            if cfg.tpu_n_shards == 0:
+                import jax
+                n_shards = max(1, len(jax.devices()) // n_replicas)
+            spec = agg_args["spec"]
+            while n_shards > 1 and any(
+                    getattr(spec, f) % n_shards
+                    for f in ("counter_capacity", "gauge_capacity",
+                              "status_capacity", "set_capacity",
+                              "histo_capacity")):
+                n_shards -= 1
+            agg_args["n_shards"] = n_shards
+            self.aggregator = collective_tier.CollectiveGlobalTier(
+                n_replicas=n_replicas, **agg_args)
+            collective_tier.register(cfg.collective_group, self.aggregator)
+            self._collective_registered = cfg.collective_group
+        elif n_shards > 1:
             # device scale-out: sharded mesh backend (parallel/sharded.py);
             # C++ staging composes with the mesh when native_ingest is on
             agg_args["n_shards"] = n_shards
@@ -297,6 +320,16 @@ class Server:
             "veneur.forward.envelope_rejected_total",
             "forward imports rejected for malformed or out-of-bound "
             "(source_id, epoch, seq) envelopes — never folded")
+        # collective tier absorb path (collective/tier.py) — registered
+        # even with the tier off so the inventory is stable
+        self._c_coll_rows = M.counter(
+            "veneur.collective.absorbed_rows_total",
+            "forwardable rows handed to the co-located collective tier "
+            "as device arrays instead of gRPC")
+        self._c_coll_errors = M.counter(
+            "veneur.collective.absorb_errors_total",
+            "co-located collective absorbs that failed (the interval "
+            "falls back to the wire forward path)")
         self._c_flush_count = M.counter(
             "veneur.flush.completed_total",
             "flush intervals run to completion (success or failure)")
@@ -403,6 +436,9 @@ class Server:
         # a source identity and ack-gates its spill buffer (the spill
         # becomes the durable send queue — see reliability/spill.py).
         self._dedup = None
+        # participant row in the attached collective tier, assigned by
+        # the tier on first successful absorb (stable for process life)
+        self._collective_participant = None
         self._fwd_source_id = None
         self._fwd_epoch = 0
         self._fwd_next_seq = 0
@@ -1591,7 +1627,7 @@ class Server:
         # forwarding client, dialed once at start (server.go:843-851);
         # http(s):// addresses take the HTTP /import path unless
         # forward_use_grpc forces gRPC (flusher.go:84-95 dispatch)
-        if self.cfg.is_local:
+        if self.cfg.forward_address:
             from veneur_tpu.forward.rpc import (
                 ForwardClient, HTTPForwardClient)
             addr = self.cfg.forward_address
@@ -1901,7 +1937,8 @@ class Server:
         ckpt_due = (self._ckpt_writer is not None
                     and self._flushes_since_ckpt + 1
                     >= max(1, self.cfg.checkpoint_interval_flushes))
-        if self._forward_client is not None or ckpt_due:
+        if (self._forward_client is not None or ckpt_due
+                or self.cfg.collective_attach):
             flush_arrays, table, raw = self.aggregator.compute_flush(
                 state, table, self.cfg.percentiles, want_raw=True)
         else:
@@ -1916,7 +1953,17 @@ class Server:
         # under a fresh (epoch, seq) BEFORE the checkpoint build, so the
         # snapshot's spill chunk carries the payload with its envelope
         # (_stage_forward_unit explains the crash-replay invariant)
-        if self._fwd_source_id is not None and raw is not None:
+        #
+        # co-located collective tier: hand this interval's forwardable
+        # rows to the in-process tier as device staging (zero
+        # serialization). A successful absorb IS the forward — the wire
+        # path (stage + gRPC/HTTP) is skipped for the interval; any
+        # failure falls through to it untouched.
+        absorbed = False
+        if self.cfg.collective_attach and raw is not None:
+            absorbed = self._absorb_colocated(raw, table)
+        if (self._fwd_source_id is not None and raw is not None
+                and not absorbed):
             self._stage_forward_unit(raw, table)
         if self._ckpt_writer is not None:
             if ckpt_due:
@@ -1935,7 +1982,7 @@ class Server:
                 self._flushes_since_ckpt = 0
             else:
                 self._flushes_since_ckpt += 1
-        if self._forward_client is not None:
+        if self._forward_client is not None and not absorbed:
             # fire-and-forget, concurrent with sink flushes
             # (flusher.go:84-95); _forward logs and counts its own errors,
             # and the flush thread must never block on a slow global tier
@@ -2174,6 +2221,31 @@ class Server:
             self._c_forward_errors.inc()
             log.exception("forward export/staging failed; interval not "
                           "staged")
+
+    def _absorb_colocated(self, raw, table) -> bool:
+        """Hand this interval's forwardable rows to the co-located
+        collective tier (collective/tier.py) as device staging. True
+        means the tier took the interval and the wire path must not run
+        (staging it too would double-count the additive kinds); False
+        means no tier / failed absorb, and the caller falls back to the
+        ordinary forward path untouched."""
+        from veneur_tpu.collective import tier as collective_tier
+        t = collective_tier.lookup(self.cfg.collective_attach)
+        if t is None:
+            # no co-located tier in this process (yet) — DCN fallback
+            return False
+        try:
+            if self._collective_participant is None:
+                self._collective_participant = t.assign_participant()
+            n = t.absorb_raw(raw, table,
+                             participant=self._collective_participant)
+        except Exception:
+            self._c_coll_errors.inc()
+            log.exception("co-located collective absorb failed; interval "
+                          "falls back to the wire forward path")
+            return False
+        self._c_coll_rows.inc(n)
+        return True
 
     def _pump_traced(self, span):
         try:
@@ -2789,7 +2861,10 @@ class Server:
                     # with its envelope, the restart replays it once, and
                     # fold_snapshot(skip_forwarded) keeps its rows from
                     # re-exporting under a second seq
-                    if self._fwd_source_id is not None:
+                    absorbed = False
+                    if self.cfg.collective_attach:
+                        absorbed = self._absorb_colocated(raw, table)
+                    if self._fwd_source_id is not None and not absorbed:
                         self._stage_forward_unit(raw, table)
                     spill_bytes, spill_n = None, 0
                     if self.forward_spill is not None:
@@ -2827,3 +2902,7 @@ class Server:
             # best-effort quiesce: a torn-down backend raising here is
             # expected during interpreter exit, but say so
             log.debug("final device quiesce skipped: %s", e)
+        if self._collective_registered:
+            from veneur_tpu.collective import tier as collective_tier
+            collective_tier.unregister(self._collective_registered,
+                                       self.aggregator)

@@ -33,6 +33,10 @@ EVENT_NAME_MAX_LENGTH = 256
 EVENT_DESCRIPTION_MAX_LENGTH = 256
 # tokens-API pagination (reference signalfx.go:273-277)
 _TOKEN_PAGE_LIMIT = 200
+# pagination backstop: a server that keeps returning full pages (or
+# ignores offset) must not spin the token fetch forever; 500 pages =
+# 100k tokens, far past any real org
+_TOKEN_MAX_PAGES = 500
 
 log = logging.getLogger("veneur_tpu.sinks.signalfx")
 
@@ -110,12 +114,15 @@ class SignalFxMetricSink(ResilientSink, MetricSink):
         return True
 
     def _fetch_api_keys(self) -> Dict[str, str]:
-        """Paginated GET {api_endpoint}/v2/token until an empty page
+        """Paginated GET {api_endpoint}/v2/token until a SHORT page
         (reference signalfx.go:321-344 fetchAPIKeys): each result row
-        contributes name → secret."""
+        contributes name → secret. A page under the requested limit is
+        the last one — stopping only on an EMPTY page pays one wasted
+        round-trip per refresh and spins forever against a server that
+        ignores offset; _TOKEN_MAX_PAGES backstops even that."""
         out: Dict[str, str] = {}
         offset = 0
-        while True:
+        for _page in range(_TOKEN_MAX_PAGES):
             q = urllib.parse.urlencode({
                 "limit": _TOKEN_PAGE_LIMIT, "name": "", "offset": offset})
             req = urllib.request.Request(
@@ -141,9 +148,13 @@ class SignalFxMetricSink(ResilientSink, MetricSink):
                         "signalfx api")
                 out[row["name"]] = row["secret"]
                 count += 1
-            if count == 0:
+            if count < _TOKEN_PAGE_LIMIT:
                 return out
             offset += _TOKEN_PAGE_LIMIT
+        log.warning("signalfx token fetch stopped at the %d-page cap "
+                    "with every page full; token list may be truncated",
+                    _TOKEN_MAX_PAGES)
+        return out
 
     def _datapoint_from(self, name, ts, value, tags, host):
         """The ONE datapoint serialization both flush paths share."""

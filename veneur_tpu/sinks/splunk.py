@@ -69,6 +69,10 @@ class SplunkSpanSink(ResilientSink, SpanSink):
         self.submitted = 0
         self.skipped = 0
         self.dropped = 0
+        # flush() ack waits that expired before the worker answered (a
+        # slow POST holding the worker) — the flush returned with that
+        # worker's batch possibly still in flight
+        self.flush_timeouts = 0
         self.excluded_tag_keys: set = set()
         self.workers = max(1, workers)
         # bounded so a stalled HEC can't grow memory without limit, but
@@ -161,8 +165,20 @@ class SplunkSpanSink(ResilientSink, SpanSink):
             for req, ack in self._flush_reqs:
                 ack.clear()
                 req.set()
-            for req, ack in self._flush_reqs:
-                ack.wait(self.send_timeout)
+            # Event.wait returns False on timeout — a dropped result
+            # here silently reported a complete sync the stalled worker
+            # never confirmed. Collect each verdict; an expired wait is
+            # counted and warned so operators see the partial flush.
+            timed_out = [idx
+                         for idx, (_req, ack) in enumerate(self._flush_reqs)
+                         if not ack.wait(self.send_timeout)]
+            if timed_out:
+                self.flush_timeouts += len(timed_out)
+                log.warning(
+                    "splunk flush: %d of %d workers did not ack within "
+                    "%.1fs (workers %s; batches may still be in flight)",
+                    len(timed_out), len(self._flush_reqs),
+                    self.send_timeout, timed_out)
 
     def stop(self) -> None:
         # flush FIRST: once _stop is visible an idle worker exits at the
