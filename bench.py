@@ -279,7 +279,9 @@ def main():
                  "--only", "parse_metric_warm",
                  "--only", "worker_ingest", "--only", "flush_label_frame",
                  "--only", "import_decode_native",
-                 "--only", "pipeline_pump"],
+                 "--only", "pipeline_pump",
+                 "--only", "telemetry_overhead",
+                 "--only", "telemetry_scrape"],
                 capture_output=True, text=True, timeout=micro_t,
                 cwd=here, env=cache_env(force_cpu=True))
             host = {}
@@ -295,6 +297,14 @@ def main():
                     if "h2d_mb_per_sec" in row:
                         host[row["bench"] + "_h2d_mb_per_sec"] = \
                             row["h2d_mb_per_sec"]
+                    # telemetry_overhead is a GATE, not just a rate:
+                    # record the A/B verdict and the per-source scrape
+                    # costs so a regression names its source
+                    for extra in ("overhead_pct", "gate_lt_2pct",
+                                  "ops_per_sec_off", "ring_stats_ns",
+                                  "reader_counters_ns", "hbm_stats_ns"):
+                        if extra in row:
+                            host[f"{row['bench']}_{extra}"] = row[extra]
                 elif "skipped" in row:
                     host[row["bench"]] = row["skipped"]
             if proc.returncode != 0:

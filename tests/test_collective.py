@@ -347,3 +347,64 @@ def test_server_colocated_absorb_skips_wire():
     finally:
         lsrv.shutdown()
         gsrv.shutdown()
+
+
+def test_colocated_flush_produces_connected_span_tree():
+    """PR-11 cross-tier tracing: one co-located flush yields a single
+    connected trace — the local flush.forward stage span (tagged
+    transport=colocated) parents the global tier's collective.absorb
+    span, which in turn parents the replica_merge span emitted by the
+    global flush. All three share the local flush root's trace id."""
+    import time as _time
+    from veneur_tpu.server.server import Server
+    from veneur_tpu.sinks.debug import DebugMetricSink, DebugSpanSink
+    from tests.test_server import _send_udp, _wait_processed, small_config
+
+    gsrv = Server(small_config(collective_enabled=True,
+                               collective_group="span1",
+                               tpu_n_shards=4, tpu_n_replicas=2),
+                  metric_sinks=[DebugMetricSink()])
+    gsrv.start()
+    ssink = DebugSpanSink()
+    lsrv = Server(small_config(collective_attach="span1"),
+                  metric_sinks=[DebugMetricSink()], span_sinks=[ssink])
+    try:
+        lsrv.start()
+        _send_udp(lsrv.local_addr(), [b"sp.count:1|c|#veneurglobalonly"])
+        _wait_processed(lsrv, 1)
+        lsrv.trigger_flush()      # colocated absorb: forward+absorb spans
+        gsrv.trigger_flush()      # global flush: replica_merge span
+        # spans report through the LOCAL server's trace client and loop
+        # back through its pipeline; later local flushes deliver them
+        want = {"flush.forward", "collective.absorb",
+                "collective.replica_merge"}
+
+        def _tree():
+            by_trace = {}
+            for sp in list(ssink.spans):
+                by_trace.setdefault(sp.trace_id, {})[sp.name] = sp
+            for tree in by_trace.values():
+                if want <= set(tree):
+                    return tree
+            return None
+        t0 = _time.time()
+        tree = _tree()
+        while tree is None and _time.time() - t0 < 60.0:
+            lsrv.trigger_flush()
+            _time.sleep(0.05)
+            tree = _tree()
+        assert tree is not None, \
+            f"spans seen: {sorted({s.name for s in list(ssink.spans)})}"
+        fwd, absorb = tree["flush.forward"], tree["collective.absorb"]
+        merge = tree["collective.replica_merge"]
+        assert fwd.tags.get("transport") == "colocated"
+        assert absorb.tags.get("transport") == "colocated"
+        assert absorb.parent_id == fwd.id
+        assert merge.parent_id == absorb.id
+        assert int(absorb.tags["rows"]) > 0
+        # the forward stage hangs off the local flush root
+        if "flush" in tree:
+            assert fwd.parent_id == tree["flush"].id
+    finally:
+        lsrv.shutdown()
+        gsrv.shutdown()

@@ -249,6 +249,21 @@ ENVELOPE_REJECT_CORPUS = [
      "veneur-seq": "513"},
     {"veneur-source-id": _SID_OK, "veneur-epoch": "0",
      "veneur-seq": str(10 ** 18)},
+    # trace context travels as a pair: half-present is corruption (a
+    # legacy peer omits BOTH keys — that stays a 202, asserted below)
+    {"veneur-source-id": _SID_OK, "veneur-epoch": "0", "veneur-seq": "0",
+     "veneur-trace-id": "7"},
+    {"veneur-source-id": _SID_OK, "veneur-epoch": "0", "veneur-seq": "0",
+     "veneur-parent-span-id": "7"},
+    # non-integer / non-positive ids
+    {"veneur-source-id": _SID_OK, "veneur-epoch": "0", "veneur-seq": "0",
+     "veneur-trace-id": "x", "veneur-parent-span-id": "7"},
+    {"veneur-source-id": _SID_OK, "veneur-epoch": "0", "veneur-seq": "0",
+     "veneur-trace-id": "7", "veneur-parent-span-id": "1.5"},
+    {"veneur-source-id": _SID_OK, "veneur-epoch": "0", "veneur-seq": "0",
+     "veneur-trace-id": "0", "veneur-parent-span-id": "7"},
+    {"veneur-source-id": _SID_OK, "veneur-epoch": "0", "veneur-seq": "0",
+     "veneur-trace-id": "7", "veneur-parent-span-id": "-3"},
 ]
 
 # wrapped-body envelopes that must 400 + count one rejection each
@@ -258,6 +273,13 @@ ENVELOPE_REJECT_BODY_CORPUS = [
     {"source_id": _SID_OK, "epoch": 0},
     {"source_id": "short", "epoch": 0, "seq": 0},
     {"source_id": _SID_OK, "epoch": 0, "seq": -1},
+    # partial / malformed trace context in wrapped-body form
+    {"source_id": _SID_OK, "epoch": 0, "seq": 0, "trace_id": 7},
+    {"source_id": _SID_OK, "epoch": 0, "seq": 0, "parent_span_id": 7},
+    {"source_id": _SID_OK, "epoch": 0, "seq": 0,
+     "trace_id": "x", "parent_span_id": 7},
+    {"source_id": _SID_OK, "epoch": 0, "seq": 0,
+     "trace_id": 7, "parent_span_id": 0},
 ]
 
 
@@ -315,6 +337,12 @@ def test_envelope_corpus_rejections_all_accounted():
         assert _post_import(port, [_counter_jm()], ok_env) == 202
         assert _post_import(port, [_counter_jm()], ok_env) == 202
         assert srv._c_dup_suppressed.value() == 1.0
+        # a WELL-FORMED trace-context pair on a fresh seq imports and
+        # folds like any other batch (PR-11 cross-tier tracing)
+        traced = {"veneur-source-id": _SID_OK, "veneur-epoch": "0",
+                  "veneur-seq": "6", "veneur-trace-id": "7",
+                  "veneur-parent-span-id": "9"}
+        assert _post_import(port, [_counter_jm()], traced) == 202
         # a fresh forward jump (within max_skip) folds and drags the
         # window forward so a regressing seq drops past its reach...
         jump = {"veneur-source-id": _SID_OK, "veneur-epoch": "0",
@@ -327,8 +355,9 @@ def test_envelope_corpus_rejections_all_accounted():
         assert srv._c_dup_suppressed.value() == 2.0
 
         # the pipeline survived all of it, and only the fresh imports
-        # (seq 5, seq 100, a legacy unenveloped batch) ever folded:
-        # env.fuzz == 2 folds x 3, despite 24 batches carrying it
+        # (seq 5, traced seq 6, seq 100, a legacy unenveloped batch)
+        # ever folded: env.fuzz == 3 folds x 3, despite the dozens of
+        # batches carrying it
         before = srv.aggregator.processed
         assert _post_import(port, [_counter_jm("env.legacy")]) == 202
         _wait_until(lambda: srv.aggregator.processed > before,
@@ -336,7 +365,7 @@ def test_envelope_corpus_rejections_all_accounted():
         srv.trigger_flush()
         from tests.test_server import by_name
         flushed = by_name(sink.flushed)
-        assert flushed["env.fuzz"].value == 6.0
+        assert flushed["env.fuzz"].value == 9.0
         assert flushed["env.legacy"].value == 3.0
     finally:
         srv.shutdown()

@@ -210,6 +210,44 @@ def test_metrics_endpoint_conformance(prom_server):
         seen.add(key)
 
 
+def test_metrics_exposes_ring_device_and_collective_families(prom_server):
+    """PR-11 scrape round-trip: the native-ring, device-runtime and
+    collective-phase families registered this PR all reach the /metrics
+    exposition.  Ring gauges/counters are scalar callbacks (render a 0
+    sample even without the native engine); HBM gauges and the phase
+    timer are label-shaped, so at minimum their TYPE line renders."""
+    srv, _ = prom_server
+    _send_udp(srv.local_addr(), [b"ring.a:1|c"])
+    _wait_processed(srv, 1)
+    assert srv.trigger_flush(wait=True)
+    text = _scrape(srv)
+    types, samples = parse_exposition(text)
+    by_name = {}
+    for name, labels, value in samples:
+        by_name.setdefault(name, []).append((labels, value))
+
+    # ring snapshot: scalar callbacks always emit a sample
+    for name in ("veneur_ring_depth", "veneur_ring_depth_highwater",
+                 "veneur_ring_pump_batches_total",
+                 "veneur_ring_buffer_swap_stalls_total",
+                 "veneur_ring_emit_packed_total",
+                 "veneur_ring_emit_packed_ns_total"):
+        assert name in by_name, name
+        assert types[name] == ("gauge" if "depth" in name else "counter")
+    # device runtime: dispatch/sync split counters are scalar too
+    assert "veneur_device_dispatch_ns_total" in by_name
+    assert "veneur_device_steps_synced_total" in by_name
+    assert types["veneur_device_dispatch_ns_total"] == "counter"
+    # and the pre-existing step timer kept its family
+    assert "veneur_device_step_ns_total" in by_name
+    # HBM gauges: per-device dicts (empty off-TPU) — family is typed
+    assert types["veneur_device_hbm_bytes_in_use"] == "gauge"
+    assert types["veneur_device_hbm_bytes_peak"] == "gauge"
+    # collective phase timer + ring emit timer register unconditionally
+    assert types["veneur_collective_phase_duration_ns"] == "summary"
+    assert types["veneur_ring_emit_packed_duration_ns"] == "summary"
+
+
 def test_metrics_counters_monotonic_across_flushes(prom_server):
     srv, _ = prom_server
     _send_udp(srv.local_addr(), [b"mono.a:1|c"])
@@ -254,7 +292,10 @@ def test_prometheus_cli_scrapes_own_metrics(prom_server):
     url = f"http://127.0.0.1:{srv.http_port}/metrics"
     fetch = make_fetcher(url)
     tr = Translator()
-    assert scrape_once(fetch, tr) == []   # first poll primes the cache
+    # first poll primes the counter cache: only always-on gauges (the
+    # ring-depth callbacks) may translate, never a counter delta
+    first = scrape_once(fetch, tr)
+    assert not any(b"|c" in p for p in first)
 
     k = 7
     _send_udp(srv.local_addr(),
@@ -319,8 +360,25 @@ def test_flush_trace_span_tree():
         assert srv.trigger_flush(wait=True)
         want = {"flush", "flush.ingest_drain", "flush.device_update",
                 "flush.frame_build", "flush.sinks", "flush.sink.debug"}
-        _wait_span_names(ssink, want)
-        spans = {sp.name: sp for sp in list(ssink.spans)}
+        # span packets from one flush can straddle a flush boundary and
+        # deliver across two sink fanouts; group by trace and wait for a
+        # single trace carrying the whole tree rather than mixing traces
+        def _complete_trace():
+            by_trace = {}
+            for sp in list(ssink.spans):
+                by_trace.setdefault(sp.trace_id, {})[sp.name] = sp
+            for tree in by_trace.values():
+                if want <= set(tree):
+                    return tree
+            return None
+        t0 = time.time()
+        spans = _complete_trace()
+        while spans is None and time.time() - t0 < 30.0:
+            time.sleep(0.05)
+            spans = _complete_trace()
+        assert spans is not None, \
+            f"no single trace held {sorted(want)}; saw " \
+            f"{sorted({sp.name for sp in list(ssink.spans)})}"
         root = spans["flush"]
         for name in want - {"flush"}:
             sp = spans[name]

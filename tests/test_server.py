@@ -487,6 +487,32 @@ def test_stats_address_mirrors_self_metrics():
         ext.close()
 
 
+def test_stats_and_profile_return_503_during_shutdown():
+    """PR-11 satellite: once shutdown begins, /stats and /debug/profile
+    answer 503 immediately instead of racing teardown (or stalling a
+    profiler capture against a dying runtime)."""
+    import urllib.error
+    import urllib.request
+    srv = Server(small_config(http_address="127.0.0.1:0",
+                              profile_capture_enabled=True),
+                 metric_sinks=[DebugMetricSink()])
+    srv.start()
+    try:
+        port = srv.http_port
+        # healthy first: /stats serves normally
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/stats", timeout=10) as r:
+            assert r.status == 200
+        srv._shutdown.set()        # shutdown has begun; HTTP still up
+        for path in ("/stats", "/debug/profile?seconds=1"):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=10)
+            assert ei.value.code == 503, path
+    finally:
+        srv.shutdown()
+
+
 def test_synchronized_ticker_aligns_first_flush():
     """synchronize_with_interval delays the first tick to a wall-clock
     multiple of the interval (server.go:866-870 CalculateTickDelay)."""

@@ -26,7 +26,7 @@ from veneur_tpu.analysis import (PASSES, ambiguous_paths, accounting_flow,
                                  bare_except, drop_accounting,
                                  hot_path_alloc, jax_hot_path,
                                  lock_discipline, metric_names,
-                                 run_passes, snapshot_schema)
+                                 run_passes, snapshot_schema, timer_sync)
 from veneur_tpu.analysis.core import (Project, filter_suppressed,
                                       reasonless_suppressions)
 
@@ -285,6 +285,57 @@ CASES = [
         """},
     ),
     (
+        # timing a jitted dispatch without a sync measures enqueue cost,
+        # not device work — must flag; the dispatch_* naming convention
+        # and an in-range block_until_ready / sync_and_time must not
+        "timer-sync",
+        lambda p: timer_sync.run(p, files=["pkg/mod.py"]),
+        {"pkg/mod.py": """
+            import time
+            import jax
+
+            class C:
+                def step(self, state, batch):
+                    t0 = time.perf_counter_ns()
+                    state = jax.numpy.add(state, batch)
+                    self.step_ns += time.perf_counter_ns() - t0
+                    return state
+        """},
+        {"pkg/mod.py": """
+            import time
+            import jax
+            from veneur_tpu.observability import jaxruntime
+
+            class C:
+                def enqueue_only(self, state, batch):
+                    t0 = time.perf_counter_ns()
+                    state = jax.numpy.add(state, batch)
+                    dispatch_dt = time.perf_counter_ns() - t0
+                    self.dispatch_ns += dispatch_dt
+                    return state
+
+                def synced(self, state, batch):
+                    t0 = time.perf_counter_ns()
+                    state = jax.numpy.add(state, batch)
+                    jax.block_until_ready(state)
+                    self.step_ns += time.perf_counter_ns() - t0
+                    return state
+
+                def sampled(self, state, batch):
+                    t0 = time.perf_counter_ns()
+                    state = jax.numpy.add(state, batch)
+                    self.step_ns += jaxruntime.sync_and_time(state) + (
+                        time.perf_counter_ns() - t0)
+                    return state
+
+                def host_only(self, rows):
+                    t0 = time.perf_counter_ns()
+                    n = sum(len(r) for r in rows)
+                    self.host_ns += time.perf_counter_ns() - t0
+                    return n
+        """},
+    ),
+    (
         "accounting-flow",
         lambda p: accounting_flow.run(p, targets=["pkg"], send_targets={}),
         {"pkg/ingest.py": """
@@ -440,11 +491,12 @@ def test_run_passes_json_schema_stability(tmp_path):
         {"name", "doc", "findings", "runtime_s"}]
 
 
-def test_registry_covers_all_nine_passes():
+def test_registry_covers_all_ten_passes():
     assert list(PASSES) == [
         "hot-path-alloc", "drop-accounting", "ambiguous-paths",
         "bare-except", "metric-names", "snapshot-schema",
-        "jax-hot-path", "lock-discipline", "accounting-flow"]
+        "jax-hot-path", "lock-discipline", "accounting-flow",
+        "timer-sync"]
     for name, mod in PASSES.items():
         assert mod.NAME == name and mod.DOC
 

@@ -25,7 +25,7 @@ from veneur_tpu.analysis import (ambiguous_paths, accounting_flow,
                                  bare_except, drop_accounting,
                                  hot_path_alloc, jax_hot_path,
                                  lock_discipline, metric_names,
-                                 snapshot_schema)
+                                 snapshot_schema, timer_sync)
 from veneur_tpu.analysis.core import (REPO, Finding, Project,
                                       filter_suppressed,
                                       reasonless_suppressions)
@@ -44,6 +44,7 @@ PASSES = {
         jax_hot_path,
         lock_discipline,
         accounting_flow,
+        timer_sync,
     )
 }
 

@@ -1,0 +1,160 @@
+"""vtlint pass: wall-time deltas around device dispatch must sync.
+
+JAX dispatch is async: `time.perf_counter_ns()` deltas taken around a
+bare step call measure the host-side ENQUEUE cost (microseconds), not
+the device work — the exact bug class behind the old step_ns
+accounting, where "device time" collapsed to dispatch time and the
+real cost surfaced later as a mystery stall in whoever synced first.
+
+The rule: inside the warm dispatch files, a `t = perf_counter_ns()` /
+`... perf_counter_ns() - t` pair with a device-tainted call between
+the two timestamps must also have a sync (`block_until_ready` or
+`jaxruntime.sync_and_time`) between them — OR store the delta under a
+name containing `dispatch`, which declares the enqueue-only meaning
+explicitly (the `dispatch_dt` convention the aggregators use).
+
+The taint walk is jax_hot_path's (`state` roots + jax.* results +
+assignment growth); a measurement this pass cannot see through (e.g. a
+callee that host-materializes, which IS an implicit sync) carries a
+one-line reasoned suppression.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from veneur_tpu.analysis.core import FileContext, Finding, Project
+from veneur_tpu.analysis.jax_hot_path import _is_tainted
+
+NAME = "timer-sync"
+DOC = ("perf_counter_ns deltas spanning device dispatch either sync "
+       "(block_until_ready / sync_and_time) or are named dispatch_*")
+
+# the warm dispatch files: everywhere a perf_counter pair can wrap a
+# jitted step call. server.py's flush phases are out of scope — its
+# compute_flush callees host-materialize (an implicit sync) and the
+# phases deliberately measure mixed host+device wall time.
+FILES = [
+    "veneur_tpu/server/native_aggregator.py",
+    "veneur_tpu/server/aggregator.py",
+    "veneur_tpu/server/sharded_aggregator.py",
+    "veneur_tpu/collective/tier.py",
+]
+
+_SYNC_LEAVES = ("block_until_ready", "sync_and_time")
+
+
+def _is_pcns(node: ast.AST, ctx: FileContext) -> bool:
+    """Is this expression a bare time.perf_counter_ns() call?"""
+    if not isinstance(node, ast.Call):
+        return False
+    resolved = ctx.resolve(node.func) or ""
+    return resolved.rsplit(".", 1)[-1] == "perf_counter_ns"
+
+
+def _target_name(node: ast.AST,
+                 parents: Dict[ast.AST, ast.AST],
+                 ctx: FileContext) -> Optional[str]:
+    """The name the enclosing Assign/AugAssign stores into, or None
+    when the delta feeds straight into a call (observe(...))."""
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, ast.AugAssign):
+            t = cur.target
+            return ctx.dotted(t) if isinstance(t, ast.Attribute) \
+                else getattr(t, "id", None)
+        if isinstance(cur, ast.Assign):
+            for t in cur.targets:
+                if isinstance(t, ast.Name):
+                    return t.id
+                if isinstance(t, ast.Attribute):
+                    return ctx.dotted(t)
+            return None
+        cur = parents.get(cur)
+    return None
+
+
+def _check_fn(ctx: FileContext, fn) -> List[Finding]:
+    tainted: Set[str] = set()
+    for arg in fn.args.args:
+        if arg.arg == "state":
+            tainted.add("state")
+    parents: Dict[ast.AST, ast.AST] = {}
+    for p in ast.walk(fn):
+        for c in ast.iter_child_nodes(p):
+            parents[c] = p
+
+    t0s: Dict[str, int] = {}
+    device_calls: List[int] = []
+    syncs: List[int] = []
+    deltas: List[Tuple[int, str, Optional[str]]] = []
+
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            if _is_pcns(node.value, ctx):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        t0s[t.id] = node.lineno
+            elif _is_tainted(node.value, ctx, tainted):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        tainted.add(t.id)
+        elif isinstance(node, ast.Call):
+            fname = node.func
+            resolved = ctx.resolve(fname) or ""
+            leaf = resolved.rsplit(".", 1)[-1]
+            if leaf in _SYNC_LEAVES or (
+                    isinstance(fname, ast.Attribute)
+                    and fname.attr in _SYNC_LEAVES):
+                syncs.append(node.lineno)
+            elif not _is_pcns(node, ctx) \
+                    and _is_tainted(node, ctx, tainted):
+                device_calls.append(node.lineno)
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub) \
+                and _is_pcns(node.left, ctx) \
+                and isinstance(node.right, ast.Name):
+            deltas.append((node.lineno, node.right.id,
+                           _target_name(node, parents, ctx)))
+
+    findings: List[Finding] = []
+    for lineno, t0_name, target in deltas:
+        start = t0s.get(t0_name)
+        if start is None or lineno <= start:
+            continue
+        if target is not None and "dispatch" in target:
+            continue  # declared enqueue-only measurement
+        spanned = [l for l in device_calls if start < l < lineno]
+        if not spanned:
+            continue
+        if any(start < l < lineno for l in syncs):
+            continue
+        findings.append(Finding(
+            NAME, ctx.rel, lineno,
+            f"perf_counter_ns delta in {fn.name}() spans a device "
+            f"dispatch (line {spanned[0]}) with no block_until_ready/"
+            "sync_and_time before the second timestamp — this measures "
+            "async enqueue cost, not device work; sync inside the "
+            "range, or store it as dispatch_* if enqueue time is "
+            "the point"))
+    return findings
+
+
+def run(project: Project, files: List[str] = None) -> List[Finding]:
+    findings: List[Finding] = []
+    seen: Set[Tuple[str, int]] = set()
+    for rel in (files if files is not None else FILES):
+        ctx = project.file(rel)
+        if ctx is None:
+            findings.append(Finding(
+                NAME, rel, 0, "file missing — update FILES in "
+                "veneur_tpu/analysis/timer_sync.py"))
+            continue
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for f in _check_fn(ctx, node):
+                    key = (f.file, f.line)
+                    if key not in seen:  # nested defs are walked twice
+                        seen.add(key)
+                        findings.append(f)
+    return findings

@@ -1,0 +1,80 @@
+"""veneur-tpu-telemetry: one-shot operator view of a running server's
+telemetry registry (README §Observability).
+
+Scrapes GET /metrics once (the server must run with
+prometheus_metrics_enabled: true) and prints every series as one
+sorted `name{labels} value` line — grep-friendly, diff-friendly, no
+Prometheus required. `--json` emits the same series as a list of
+{name, labels, value, type} objects.
+
+  python -m veneur_tpu.cli.telemetry http://127.0.0.1:8127/metrics
+  python -m veneur_tpu.cli.telemetry --json | jq '.[].name'
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+
+from veneur_tpu.cli.prometheus import make_fetcher, parse_exposition
+
+log = logging.getLogger("veneur_tpu.cli.telemetry")
+
+DEFAULT_URL = "http://127.0.0.1:8127/metrics"
+
+
+def _format_series(name: str, labels: dict) -> str:
+    if not labels:
+        return name
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return f"{name}{{{inner}}}"
+
+
+def dump_once(fetch, as_json: bool, out=None) -> int:
+    """One scrape → sorted text (or JSON) on `out`. Returns an exit
+    code: 1 on fetch failure, 0 otherwise (an empty exposition is a
+    valid — if suspicious — answer, reported as such)."""
+    out = out if out is not None else sys.stdout
+    try:
+        text = fetch()
+    except Exception as e:
+        print(f"scrape failed: {e}", file=sys.stderr)
+        return 1
+    types, samples = parse_exposition(text)
+    rows = sorted((_format_series(n, lb), v, types.get(n, ""))
+                  for n, lb, v in samples)
+    if as_json:
+        print(json.dumps([{"series": s, "value": v, "type": t}
+                          for s, v, t in rows], indent=1), file=out)
+        return 0
+    if not rows:
+        print("(empty exposition — is prometheus_metrics_enabled on?)",
+              file=out)
+        return 0
+    width = max(len(s) for s, _, _ in rows)
+    for series, value, _ in rows:
+        print(f"{series:<{width}}  {value:g}", file=out)
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="veneur-tpu-telemetry")
+    ap.add_argument("url", nargs="?", default=DEFAULT_URL,
+                    help=f"the server's /metrics URL "
+                         f"(default {DEFAULT_URL})")
+    ap.add_argument("--socket", default=None,
+                    help="scrape over a unix socket instead of TCP")
+    ap.add_argument("--timeout", type=float, default=10.0)
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.WARNING)
+    fetch = make_fetcher(args.url, socket_path=args.socket,
+                         timeout=args.timeout)
+    return dump_once(fetch, args.as_json)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

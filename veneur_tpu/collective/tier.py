@@ -40,6 +40,9 @@ from veneur_tpu.aggregation.host import Batcher, BatchSpec
 from veneur_tpu.aggregation.state import TableSpec
 from veneur_tpu.aggregation.step import Batch
 from veneur_tpu.collective.keytable import CollectiveKeyTable
+from veneur_tpu.observability import jaxruntime
+from veneur_tpu.observability.registry import Timer
+from veneur_tpu.server.aggregator import _SYNC_EVERY
 from veneur_tpu.server.sharded_aggregator import (
     ShardedAggregator, per_shard_spec)
 
@@ -117,8 +120,30 @@ class CollectiveGlobalTier(ShardedAggregator):
         self.dropped_capacity = 0
         self.h2d_bytes = 0
         self.step_ns = 0
+        self.dispatch_ns = 0
         self.steps_total = 0
+        self.steps_synced = 0
+        # always-on phase timers: a private Timer instance until a host
+        # server injects its registry-owned one (set_phase_timer), so
+        # phase durations accumulate with or without a Server around.
+        # Phases: stage (absorb_raw host staging), all_to_all_route
+        # (routed dispatch), replica_merge / flush (compute_flush).
+        self._phase_timer = Timer(
+            "veneur.collective.phase_duration_ns",
+            help="collective tier phase wall time by phase (ns)",
+            labelnames=("phase",))
+        # cross-tier tracing: the last absorb's (trace_id, span_id) so
+        # compute_flush's replica_merge span parents onto it, closing
+        # the local->global span tree; the trace client rides along.
+        self._last_absorb = None
+        self._trace_client = None
         self._init_degrade()
+
+    def set_phase_timer(self, timer) -> None:
+        """Adopt a registry-owned phase-duration Timer (the host Server
+        registers `veneur.collective.phase_duration_ns` and injects it
+        here so phase observations reach its /metrics exposition)."""
+        self._phase_timer = timer
 
     # -- absorb staging ------------------------------------------------------
     def _make_stage_grid(self):
@@ -159,8 +184,14 @@ class CollectiveGlobalTier(ShardedAggregator):
         self.h2d_bytes += sum(a.nbytes for a in batch if a is not None)
         t0 = time.perf_counter_ns()
         self.state = self._routed(self.state, batch)
-        self.step_ns += time.perf_counter_ns() - t0
+        dispatch_dt = time.perf_counter_ns() - t0
+        self.dispatch_ns += dispatch_dt
+        self._phase_timer.observe(dispatch_dt, phase="all_to_all_route")
         self.steps_total += 1
+        if self.steps_total % _SYNC_EVERY == 0:
+            self.step_ns += dispatch_dt + jaxruntime.sync_and_time(
+                self.state)
+            self.steps_synced += 1
         # absorbed digest rows land in temp cells like any other ingest;
         # ride the packed program's in-band compact word at the same
         # cadence as direct traffic so they recompress
@@ -204,7 +235,12 @@ class CollectiveGlobalTier(ShardedAggregator):
         self.h2d_bytes += flat.nbytes
         t0 = time.perf_counter_ns()
         self.state = self._ingest(self.state, flat)
-        self.step_ns += time.perf_counter_ns() - t0
+        dispatch_dt = time.perf_counter_ns() - t0
+        self.dispatch_ns += dispatch_dt
+        if self.steps_total % _SYNC_EVERY == 0:
+            self.step_ns += dispatch_dt + jaxruntime.sync_and_time(
+                self.state)
+            self.steps_synced += 1
 
     # -- zero-serialization absorb -------------------------------------------
     def assign_participant(self) -> int:
@@ -215,13 +251,21 @@ class CollectiveGlobalTier(ShardedAggregator):
             self._next_participant += 1
             return p
 
-    def absorb_raw(self, raw, table, participant: Optional[int] = None
-                   ) -> int:
+    def absorb_raw(self, raw, table, participant: Optional[int] = None,
+                   parent_span=None, trace_client=None) -> int:
         """Fold a co-located local tier's flush output (raw arrays + its
         detached KeyTable) into the collective state. Returns the number
         of rows absorbed. Thread-safe against concurrent absorbs and the
-        tier's own swap."""
+        tier's own swap. With parent_span (the local's flush.forward
+        span), emits a collective.absorb child span carrying rows/bytes
+        tags — the same tree shape the wire path's import span produces
+        — and remembers its ids so compute_flush's replica_merge span
+        parents onto this absorb."""
         from veneur_tpu.forward.convert import iter_forwardable
+        span = None
+        if parent_span is not None:
+            span = parent_span.child("collective.absorb")
+            span.set_tag("transport", "colocated")
         with self._absorb_lock:
             if participant is None:
                 participant = self._next_participant
@@ -229,11 +273,25 @@ class CollectiveGlobalTier(ShardedAggregator):
             r = participant % self.n_replicas
             j = (participant // self.n_replicas) % self.n_shards
             n = 0
+            t0 = time.perf_counter_ns()
             for kind, meta, scope, payload in iter_forwardable(
                     raw, table, self.spec.hll_precision):
                 self._absorb_one(r, j, kind, meta, scope, payload)
                 n += 1
+            self._phase_timer.observe(time.perf_counter_ns() - t0,
+                                      phase="stage")
             self.absorbed_rows += n
+            if span is not None:
+                span.set_tag("rows", str(n))
+                try:
+                    span.set_tag("bytes", str(sum(
+                        a.nbytes for a in raw.values()
+                        if hasattr(a, "nbytes"))))
+                except AttributeError:
+                    pass
+                self._last_absorb = (span.trace_id, span.id)
+                self._trace_client = trace_client
+                span.client_finish(trace_client)
             return n
 
     def _absorb_one(self, r: int, j: int, kind: str, meta, scope: int,
@@ -284,6 +342,11 @@ class CollectiveGlobalTier(ShardedAggregator):
     def swap(self):
         with self._absorb_lock:
             self._emit_absorbed()
+            if self._routed_steps and not self._steps:
+                # absorb-only interval: the inherited swap's boundary
+                # sync keys off _steps, which routed dispatch bypasses
+                self.step_ns += jaxruntime.sync_and_time(self.state)
+                self.steps_synced += 1
             state, table = super().swap()
             # super() installed a plain KeyTable; the collective tier
             # routes by key identity
@@ -294,6 +357,41 @@ class CollectiveGlobalTier(ShardedAggregator):
 
     def compute_flush(self, state, table, percentiles,
                       want_raw: bool = False):
+        t_flush = time.perf_counter_ns()
+        try:
+            return self._compute_flush_timed(state, table, percentiles,
+                                             want_raw)
+        finally:
+            # implicitly synced: every return path host-materializes the
+            # flush arrays (np.asarray), so this is true wall time
+            # vtlint: disable=timer-sync -- callee's np.asarray is the sync
+            self._phase_timer.observe(time.perf_counter_ns() - t_flush,
+                                      phase="flush")
+
+    def _compute_flush_timed(self, state, table, percentiles,
+                             want_raw: bool = False):
+        # the replica_merge span parents onto the most recent co-located
+        # absorb and is emitted on EVERY flush path — on the plain path
+        # the merge collectives run inside the compiled flush itself, so
+        # the span covers the whole compute; either way the cross-tier
+        # trace stays connected (local forward -> absorb -> merge)
+        from veneur_tpu.trace.tracer import Span
+        mspan = None
+        if self._last_absorb is not None:
+            tid, sid = self._last_absorb
+            mspan = Span("collective.replica_merge", service="veneur",
+                         trace_id=tid, parent_id=sid)
+            mspan.set_tag("replicas", str(self.n_replicas))
+        try:
+            return self._compute_flush_inner(state, table, percentiles,
+                                             want_raw)
+        finally:
+            if mspan is not None:
+                mspan.client_finish(self._trace_client)
+                self._last_absorb = None
+
+    def _compute_flush_inner(self, state, table, percentiles,
+                             want_raw: bool = False):
         if not want_raw or self.n_replicas == 1:
             # R == 1: the inherited raw gather reads the state verbatim,
             # byte-identical to the sharded backend by construction
@@ -311,7 +409,11 @@ class CollectiveGlobalTier(ShardedAggregator):
             live_indices(table, "set", self.spec.set_capacity))
         hidx = jnp.asarray(
             live_indices(table, "histogram", self.spec.histo_capacity))
+        t0 = time.perf_counter_ns()
         merged = jax.tree.map(lambda x: x[None], self._merge(state))
+        jaxruntime.sync_and_time(merged)
+        merge_synced_dt = time.perf_counter_ns() - t0
+        self._phase_timer.observe(merge_synced_dt, phase="replica_merge")
         r = unpack_flush(
             np.asarray(_gather_sharded_raw(merged, setidx, hidx)),
             _sharded_raw_shapes(self.pspec, len(setidx), len(hidx)))

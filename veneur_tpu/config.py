@@ -152,6 +152,10 @@ class Config:
     prometheus_metrics_enabled: bool = False  # serve GET /metrics
     flush_trace_enabled: bool = False  # per-phase span tree + row/byte tags
     self_timer_compression: float = 50.0  # t-digest delta for self-timers
+    # serve GET /debug/profile?seconds=N — an on-demand jax.profiler
+    # device trace written to a temp dir. Off by default: capture stalls
+    # the runtime, so it must be an explicit operator decision.
+    profile_capture_enabled: bool = False
 
     # overload management (veneur_tpu/reliability/overload.py; README
     # §Overload & health). Off by default: no controller, no poller

@@ -59,6 +59,16 @@ META_EPOCH = "veneur-epoch"
 META_SEQ = "veneur-seq"
 _META_KEYS = (META_SOURCE_ID, META_EPOCH, META_SEQ)
 
+# optional trace context (cross-tier flush tracing): the local tier's
+# flush.forward span rides the envelope so the global tier's
+# import/absorb spans parent onto it. Both-or-none: absent = legacy /
+# untraced peer, exactly one present = corruption (same contract as the
+# partial-envelope rule). Zero is not a valid id (tracer ids are
+# `getrandbits(63) | 1`), so "zero keys" cannot masquerade as a trace.
+META_TRACE_ID = "veneur-trace-id"
+META_PARENT_SPAN_ID = "veneur-parent-span-id"
+_TRACE_KEYS = (META_TRACE_ID, META_PARENT_SPAN_ID)
+
 FRESH = "fresh"
 DUPLICATE = "duplicate"
 STALE = "stale"
@@ -80,6 +90,9 @@ class Envelope:
     source_id: str
     epoch: int
     seq: int
+    # cross-tier trace context; None/None = untraced (legacy-compatible)
+    trace_id: Optional[int] = None
+    parent_span_id: Optional[int] = None
 
     def validate(self) -> "Envelope":
         if not _SOURCE_ID_RE.match(self.source_id or ""):
@@ -89,18 +102,55 @@ class Envelope:
         if self.epoch < 0 or self.seq < 0:
             raise EnvelopeError(
                 f"negative epoch/seq ({self.epoch}, {self.seq})")
+        if (self.trace_id is None) != (self.parent_span_id is None):
+            raise EnvelopeError(
+                "partial trace context: trace_id and parent_span_id "
+                "travel together")
+        if self.trace_id is not None \
+                and (self.trace_id <= 0 or self.parent_span_id <= 0):
+            raise EnvelopeError(
+                f"non-positive trace context ({self.trace_id}, "
+                f"{self.parent_span_id})")
         return self
 
     # -- wire codecs --------------------------------------------------------
     def to_metadata(self) -> tuple:
-        """gRPC invocation metadata / HTTP header pairs."""
-        return ((META_SOURCE_ID, self.source_id),
+        """gRPC invocation metadata / HTTP header pairs; trace-context
+        keys ride only when present, so untraced senders stay
+        byte-identical to pre-trace peers."""
+        meta = ((META_SOURCE_ID, self.source_id),
                 (META_EPOCH, str(self.epoch)),
                 (META_SEQ, str(self.seq)))
+        if self.trace_id is not None:
+            meta += ((META_TRACE_ID, str(self.trace_id)),
+                     (META_PARENT_SPAN_ID, str(self.parent_span_id)))
+        return meta
 
     def to_json(self) -> dict:
-        return {"source_id": self.source_id, "epoch": self.epoch,
-                "seq": self.seq}
+        d = {"source_id": self.source_id, "epoch": self.epoch,
+             "seq": self.seq}
+        if self.trace_id is not None:
+            d["trace_id"] = self.trace_id
+            d["parent_span_id"] = self.parent_span_id
+        return d
+
+    @staticmethod
+    def _parse_trace(get_trace, get_parent) -> tuple:
+        """Shared trace-context parse for both codecs: both absent ->
+        (None, None); exactly one present or non-integer -> reject."""
+        tid_s, psid_s = get_trace, get_parent
+        if tid_s is None and psid_s is None:
+            return None, None
+        if tid_s is None or psid_s is None:
+            missing = (META_TRACE_ID if tid_s is None
+                       else META_PARENT_SPAN_ID)
+            raise EnvelopeError(
+                f"partial trace context: missing {missing}")
+        try:
+            return int(tid_s), int(psid_s)
+        except (TypeError, ValueError):
+            raise EnvelopeError(
+                f"non-integer trace context ({tid_s!r}, {psid_s!r})")
 
     @classmethod
     def from_mapping(cls, meta: Mapping) -> Optional["Envelope"]:
@@ -108,7 +158,9 @@ class Envelope:
         dict(grpc invocation_metadata) or an email.message.Message).
         Returns None when NO envelope keys are present (legacy sender);
         raises EnvelopeError when the envelope is partial or malformed —
-        a half-present envelope is corruption, not a legacy peer."""
+        a half-present envelope is corruption, not a legacy peer. The
+        trace-context pair follows the same rule independently: absent
+        = untraced, half-present = rejected."""
         vals = [meta.get(k) for k in _META_KEYS]
         if all(v is None for v in vals):
             return None
@@ -121,7 +173,9 @@ class Envelope:
         except (TypeError, ValueError):
             raise EnvelopeError(
                 f"non-integer epoch/seq ({epoch_s!r}, {seq_s!r})")
-        return cls(str(sid), epoch, seq).validate()
+        tid, psid = cls._parse_trace(meta.get(META_TRACE_ID),
+                                     meta.get(META_PARENT_SPAN_ID))
+        return cls(str(sid), epoch, seq, tid, psid).validate()
 
     @classmethod
     def from_json(cls, d: object) -> Optional["Envelope"]:
@@ -135,7 +189,10 @@ class Envelope:
             epoch, seq = int(d.get("epoch")), int(d.get("seq"))
         except (TypeError, ValueError):
             raise EnvelopeError("non-integer epoch/seq in JSON envelope")
-        return cls(str(d.get("source_id") or ""), epoch, seq).validate()
+        tid, psid = cls._parse_trace(d.get("trace_id"),
+                                     d.get("parent_span_id"))
+        return cls(str(d.get("source_id") or ""), epoch, seq,
+                   tid, psid).validate()
 
 
 class DedupWindow:

@@ -102,6 +102,8 @@ def _build_and_load():
                                 ctypes.POINTER(ctypes.c_uint64)]
         lib.vr_counters.argtypes = [ctypes.c_void_p,
                                     ctypes.POINTER(ctypes.c_uint64)]
+        lib.vr_stats.argtypes = [ctypes.c_void_p,
+                                 ctypes.POINTER(ctypes.c_uint64)]
         lib.vr_admission_set.argtypes = [
             ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_double,
             ctypes.c_double, ctypes.c_char_p, ctypes.c_int]
@@ -372,6 +374,24 @@ class NativeIngest:
         _lib.vr_counters(r, out)
         return {"datagrams": out[0], "ring_dropped": out[1],
                 "ring_depth": out[2], "toolong": out[3]}
+
+    def ring_stats(self) -> dict:
+        """Deep ring/emit telemetry snapshot, callable from any thread
+        (one C++ lock, no hot-path cost): ring depth + high-water, pump
+        batch/stall counts, emit_packed call/ns totals, datagram and
+        ring-drop totals. Zeros when no reader group is running."""
+        r = getattr(self, "_readers", None)
+        if not r:
+            return {"ring_depth": 0, "ring_highwater": 0,
+                    "pump_batches": 0, "pump_stalls": 0,
+                    "emit_packed_calls": 0, "emit_packed_ns": 0,
+                    "datagrams": 0, "ring_dropped": 0}
+        out = (ctypes.c_uint64 * 8)()
+        _lib.vr_stats(r, out)
+        return {"ring_depth": out[0], "ring_highwater": out[1],
+                "pump_batches": out[2], "pump_stalls": out[3],
+                "emit_packed_calls": out[4], "emit_packed_ns": out[5],
+                "datagrams": out[6], "ring_dropped": out[7]}
 
     def admission_set(self, enabled: bool, state: int, rate: float,
                       burst: float, high_tags) -> None:

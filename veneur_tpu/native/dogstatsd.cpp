@@ -204,6 +204,12 @@ struct Parser {
   uint64_t processed = 0;
   uint64_t parse_errors = 0;
 
+  // emit_packed timing: atomics because the poll thread snapshots
+  // (vr_stats) while the pipeline thread emits; relaxed is enough for a
+  // monotonic telemetry pair read independently.
+  std::atomic<uint64_t> emit_packed_calls{0};
+  std::atomic<uint64_t> emit_packed_ns{0};
+
   // scratch
   std::vector<std::pair<const char*, size_t>> tag_views;
   std::string keybuf, joined;
@@ -528,6 +534,7 @@ void vt_emit(void* hp, int32_t* c_slot, float* c_inc, int32_t* g_slot,
 void vt_emit_packed(void* hp, int32_t* buf, const int32_t* off,
                     uint32_t* prev, uint32_t* counts_out) {
   auto* p = (Parser*)hp;
+  auto t0 = std::chrono::steady_clock::now();
   int32_t* c_slot = buf + off[0];
   float*   c_inc  = (float*)(buf + off[1]);
   int32_t* g_slot = buf + off[2];
@@ -561,6 +568,12 @@ void vt_emit_packed(void* hp, int32_t* buf, const int32_t* off,
   counts_out[2] = p->ns; prev[2] = p->ns;
   counts_out[3] = p->nh; prev[3] = p->nh;
   p->nc = p->ng = p->ns = p->nh = 0;
+  p->emit_packed_calls.fetch_add(1, std::memory_order_relaxed);
+  p->emit_packed_ns.fetch_add(
+      (uint64_t)std::chrono::duration_cast<std::chrono::nanoseconds>(
+          std::chrono::steady_clock::now() - t0)
+          .count(),
+      std::memory_order_relaxed);
 }
 
 int vt_pending(void* hp) {
@@ -1083,6 +1096,9 @@ struct ReaderGroup {
   uint64_t ring_dropped = 0;      // guarded by mu
   uint64_t datagrams = 0;         // guarded by mu
   uint64_t toolong = 0;           // guarded by mu; MSG_TRUNC drops
+  uint64_t ring_highwater = 0;    // guarded by mu; max depth ever seen
+  uint64_t pump_batches = 0;      // guarded by mu; vr_pump calls that parsed
+  uint64_t pump_stalls = 0;       // guarded by mu; vr_pump forced a swap
   Admission adm;                  // guarded by mu
   // datagram whose parse hit a full lane, parked whole with a resume
   // offset (no remainder copy)
@@ -1212,6 +1228,8 @@ void reader_main(ReaderGroup* g, int fd, int max_len) {
           continue;
         }
         g->ring.emplace_back(bufs[i].data(), (size_t)msgs[i].msg_len);
+        if ((uint64_t)g->ring.size() > g->ring_highwater)
+          g->ring_highwater = (uint64_t)g->ring.size();
       }
     }
     g->cv.notify_one();
@@ -1283,6 +1301,8 @@ int vr_pump(void* gp, int max_wait_ms, uint64_t* out) {
     out[1] = (uint64_t)g->ring.size();
     out[2] = g->ring_dropped;
     out[3] = g->datagrams;
+    if (parsed_dg > 0) g->pump_batches++;
+    if (full) g->pump_stalls++;  // staging lane filled: forced buffer swap
   }
   out[0] = parsed_dg;
   return full;
@@ -1336,6 +1356,28 @@ void vr_counters(void* gp, uint64_t* out) {
   out[1] = g->ring_dropped;
   out[2] = (uint64_t)g->ring.size();
   out[3] = g->toolong;
+}
+
+// Deep ring/emit telemetry snapshot (any thread, one lock, no allocation):
+// [0]=ring depth now, [1]=ring depth high-water, [2]=pump batches (vr_pump
+// calls that parsed >=1 datagram), [3]=buffer-swap stalls (vr_pump returned
+// full), [4]=emit_packed calls, [5]=emit_packed ns total, [6]=datagrams
+// received, [7]=ring_dropped. Per-class admission is NOT repeated here —
+// vr_admission_counters already drains it exactly.
+void vr_stats(void* gp, uint64_t* out) {
+  auto* g = (ReaderGroup*)gp;
+  {
+    std::lock_guard<std::mutex> lk(g->mu);
+    out[0] = (uint64_t)g->ring.size();
+    out[1] = g->ring_highwater;
+    out[2] = g->pump_batches;
+    out[3] = g->pump_stalls;
+    out[6] = g->datagrams;
+    out[7] = g->ring_dropped;
+  }
+  auto* p = (Parser*)g->parser;
+  out[4] = p->emit_packed_calls.load(std::memory_order_relaxed);
+  out[5] = p->emit_packed_ns.load(std::memory_order_relaxed);
 }
 
 void vr_stop(void* gp) {

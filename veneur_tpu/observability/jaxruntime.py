@@ -1,4 +1,5 @@
-"""JAX runtime telemetry: jit recompile counts and compile wall time.
+"""JAX runtime telemetry: compile events, synced step timing, HBM gauges,
+and on-demand profiler captures.
 
 The single biggest silent perf cliff in this codebase is an accidental
 recompile of the ingest/flush programs (a shape-static argument that
@@ -13,12 +14,22 @@ unregister; multiple Server instances in one process — the test suite —
 must not stack listeners). Servers export the accumulators through
 registry callbacks, so every server's /metrics reports the same
 process-wide truth.
+
+This module is also the ONE sanctioned device-sync site: XLA dispatch is
+async, so `perf_counter_ns` around a bare step call measures dispatch
+latency, not device time. sync_and_time() times a block_until_ready on
+the result token; aggregators sample it every N steps (and at every
+swap) so `step_ns` means what it says while `dispatch_ns` keeps the
+cheap always-on host-side number. The vtlint timer-sync pass enforces
+the split everywhere else.
 """
 
 from __future__ import annotations
 
 import logging
+import tempfile
 import threading
+import time
 
 log = logging.getLogger("veneur_tpu.observability.jax")
 
@@ -67,3 +78,81 @@ def compiles_total() -> int:
 def compile_time_ns_total() -> float:
     with _lock:
         return _compile_seconds_total * 1e9
+
+
+# -- synced step timing -------------------------------------------------------
+
+def sync_and_time(token) -> int:
+    """Wall nanoseconds until `token` (a donated step result / pytree of
+    device arrays) is actually ready. XLA dispatch is async, so timing a
+    bare step call measures host-side dispatch, not device work; this is
+    the ONE production sync point — aggregators sample it every
+    _SYNC_EVERY steps and at swap(), keeping `step_ns` honest while
+    `dispatch_ns` stays the cheap per-step number."""
+    import jax
+    t0 = time.perf_counter_ns()
+    # the sanctioned sampled sync point: callers time device completion
+    # here instead of around dispatch
+    # vtlint: disable=jax-hot-path -- deliberate sampled device sync
+    jax.block_until_ready(token)
+    return time.perf_counter_ns() - t0
+
+
+# -- HBM accounting -----------------------------------------------------------
+
+def hbm_stats() -> dict:
+    """{device_label: {"bytes_in_use": n, "peak_bytes_in_use": n}} from
+    each local device's allocator. Empty on backends that expose no
+    memory_stats (CPU) — callers treat absence as 'no series'."""
+    try:
+        import jax
+        devices = jax.local_devices()
+    except Exception:
+        return {}
+    out = {}
+    for d in devices:
+        try:
+            ms = d.memory_stats()
+        except Exception:
+            ms = None
+        if not ms:
+            continue
+        out[f"{d.platform}:{d.id}"] = {
+            "bytes_in_use": int(ms.get("bytes_in_use", 0)),
+            "peak_bytes_in_use": int(ms.get("peak_bytes_in_use", 0)),
+        }
+    return out
+
+
+def hbm_bytes_in_use() -> dict:
+    return {(label,): s["bytes_in_use"] for label, s in hbm_stats().items()}
+
+
+def hbm_bytes_peak() -> dict:
+    return {(label,): s["peak_bytes_in_use"]
+            for label, s in hbm_stats().items()}
+
+
+# -- on-demand profiler capture ----------------------------------------------
+
+_profile_lock = threading.Lock()
+
+
+def capture_profile(seconds: float, base_dir: str = None) -> str:
+    """Run jax.profiler for `seconds` and return the trace directory.
+    One capture at a time per process (the profiler is a global
+    resource); a concurrent request raises RuntimeError — the HTTP layer
+    maps it to 409."""
+    if not _profile_lock.acquire(blocking=False):
+        raise RuntimeError("profile capture already in progress")
+    try:
+        import jax
+        trace_dir = tempfile.mkdtemp(prefix="veneur-trace-", dir=base_dir)
+        jax.profiler.start_trace(trace_dir)
+        try:
+            time.sleep(max(0.0, float(seconds)))
+        finally:
+            jax.profiler.stop_trace()
+        return trace_dir
+    finally:
+        _profile_lock.release()

@@ -19,6 +19,7 @@ from veneur_tpu.aggregation.host import Batcher, BatchSpec, KeyTable
 from veneur_tpu.aggregation.state import (TableSpec, empty_state_compiled)
 from veneur_tpu.aggregation.step import (
     batch_sizes, ingest_step_packed, pack_batch)
+from veneur_tpu.observability import jaxruntime
 from veneur_tpu.samplers.parser import UDPMetric
 from veneur_tpu.utils.hashing import fnv1a_64, splitmix64
 
@@ -34,6 +35,11 @@ def set_member_bytes(value) -> bytes:
     MetroHash."""
     return value if isinstance(value, bytes) else str(value).encode(
         "utf-8", "surrogateescape")
+
+
+# sampled device-sync cadence for step_ns (see __init__ accounting
+# comment); every backend's dispatch loop shares it
+_SYNC_EVERY = 64
 
 
 class Aggregator:
@@ -57,11 +63,17 @@ class Aggregator:
         self.processed = 0
         self.dropped_capacity = 0
         self.h2d_bytes = 0  # packed ingest bytes shipped to the device
-        # device-step accounting for /metrics (observability/): dispatch
-        # wall time (host-side; XLA execution is async) and a monotonic
-        # step count — _steps resets every swap, steps_total never does
+        # device-step accounting for /metrics (observability/):
+        # dispatch_ns is host-side dispatch wall time (XLA execution is
+        # async, so this is NOT device time); step_ns is the honest
+        # synced number, sampled every _SYNC_EVERY steps and at swap()
+        # via jaxruntime.sync_and_time. steps_total is monotonic
+        # (_steps resets every swap); steps_synced counts the samples
+        # behind step_ns.
         self.step_ns = 0
+        self.dispatch_ns = 0
         self.steps_total = 0
+        self.steps_synced = 0
         # persistent pack targets, two per lane-size signature: batch N+1
         # packs into one buffer while batch N's h2d + donated step is
         # still in flight against the other (pack_batch `out` contract)
@@ -157,7 +169,14 @@ class Aggregator:
         t0 = time.perf_counter_ns()
         self.state = ingest_step_packed(
             self.state, flat, spec=self.spec, sizes=sizes)
-        self.step_ns += time.perf_counter_ns() - t0
+        dispatch_dt = time.perf_counter_ns() - t0
+        self.dispatch_ns += dispatch_dt
+        if self.steps_total % _SYNC_EVERY == 0:
+            # sampled sync: dispatch + wait-until-ready = true step wall
+            # time (covers the queued tail, which is the point)
+            self.step_ns += dispatch_dt + jaxruntime.sync_and_time(
+                self.state)
+            self.steps_synced += 1
 
     def process_metric(self, m: UDPMetric) -> None:
         """reference worker.go:344 ProcessMetric: switch on type+scope,
@@ -378,6 +397,11 @@ class Aggregator:
         self.batcher.emit()
         while self._hll_slots:
             self._flush_hll_imports()
+        if self._steps:
+            # interval boundary sync: step_ns is never 0 after a flush
+            # that ingested, even when _SYNC_EVERY never fired
+            self.step_ns += jaxruntime.sync_and_time(self.state)
+            self.steps_synced += 1
         state, table = self.state, self.table
         self.state = empty_state_compiled(self.spec)
         self.table = KeyTable(self.spec, self.n_shards)

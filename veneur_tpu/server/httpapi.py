@@ -3,7 +3,8 @@
 Endpoints: GET /healthcheck, GET /healthz (liveness), GET /readyz
 (readiness — see server/health.py), GET /version, GET /builddate,
 POST /import, optional POST/GET /quitquitquit (gated on http_quit,
-server.go:80).
+server.go:80), GET /debug/profile?seconds=N (gated on
+profile_capture_enabled: on-demand jax.profiler device trace).
 
 /import accepts BOTH body formats, optionally zlib-deflated
 (handlers_global.go:134-146):
@@ -118,6 +119,12 @@ def start_http_server(server, address) -> "http.server.ThreadingHTTPServer":
             elif self.path == "/builddate":
                 self._reply(200, BUILD_DATE.encode())
             elif self.path == "/stats":
+                # a tearing-down server must answer, not hang: the
+                # registry collectors read aggregator/device state that
+                # shutdown is concurrently draining
+                if server._shutdown.is_set():
+                    self._reply(503, b"shutting down")
+                    return
                 body = json.dumps({
                     "packets_received": server.packets_received,
                     "parse_errors": server.parse_errors
@@ -164,6 +171,48 @@ def start_http_server(server, address) -> "http.server.ThreadingHTTPServer":
                     return
                 self._reply(200, _sample_profile(min(seconds, 60.0)),
                             "text/plain")
+            elif self.path.startswith("/debug/profile"):
+                # on-demand device trace (jax.profiler). Ordering:
+                # shutdown guard first (capture during teardown would
+                # block on a dying runtime), then the config gate (an
+                # unaware deployment exposes nothing), then parsing.
+                import math
+                from urllib.parse import parse_qs, urlparse
+                parsed = urlparse(self.path)
+                if parsed.path != "/debug/profile":
+                    self._reply(404, b"not found")
+                    return
+                if server._shutdown.is_set():
+                    self._reply(503, b"shutting down")
+                    return
+                if not getattr(server.cfg, "profile_capture_enabled",
+                               False):
+                    self._reply(404, b"profile_capture_enabled is off")
+                    return
+                q = parse_qs(parsed.query)
+                try:
+                    seconds = float(q.get("seconds", ["5"])[0])
+                except ValueError:
+                    seconds = float("nan")
+                if not math.isfinite(seconds) or seconds <= 0:
+                    self._reply(400, b"bad seconds")
+                    return
+                from veneur_tpu.observability import jaxruntime
+                try:
+                    trace_dir = jaxruntime.capture_profile(
+                        min(seconds, 60.0))
+                except RuntimeError as e:
+                    # single-flight: one capture at a time
+                    self._reply(409, str(e).encode())
+                    return
+                except Exception as e:
+                    log.warning("profile capture failed: %s", e)
+                    self._reply(500, b"profile capture failed")
+                    return
+                self._reply(200, json.dumps(
+                    {"trace_dir": trace_dir,
+                     "seconds": min(seconds, 60.0)}).encode(),
+                    "application/json")
             elif self.path == "/quitquitquit" and server.cfg.http_quit:
                 self._quit()
             else:

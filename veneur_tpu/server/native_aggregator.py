@@ -227,6 +227,8 @@ class NativeAggregator(Aggregator):
         import time
 
         from veneur_tpu.aggregation.step import ingest_step_packed
+        from veneur_tpu.observability import jaxruntime
+        from veneur_tpu.server.aggregator import _SYNC_EVERY
         idx = self._pk_idx
         flat = self._pk_bufs[idx]
         nc, ng, ns, nh = self.eng.emit_packed(flat, self._pk_offs,
@@ -241,7 +243,12 @@ class NativeAggregator(Aggregator):
         t0 = time.perf_counter_ns()
         self.state = ingest_step_packed(
             self.state, flat, spec=self.spec, sizes=self._pk_sizes)
-        self.step_ns += time.perf_counter_ns() - t0
+        dispatch_dt = time.perf_counter_ns() - t0
+        self.dispatch_ns += dispatch_dt
+        if self.steps_total % _SYNC_EVERY == 0:
+            self.step_ns += dispatch_dt + jaxruntime.sync_and_time(
+                self.state)
+            self.steps_synced += 1
 
     def extra_parse_errors(self) -> int:
         return self.eng.stats()["parse_errors"]
@@ -320,6 +327,11 @@ class NativeAggregator(Aggregator):
 
     def reader_counters(self) -> dict:
         return self.eng.reader_counters()
+
+    def ring_stats(self) -> dict:
+        """Deep ring/emit telemetry (vr_stats): depth, high-water, pump
+        batches/stalls, emit_packed call/ns totals. Any thread."""
+        return self.eng.ring_stats()
 
     def admission_set(self, enabled: bool, state: int, rate: float,
                       burst: float, high_tags) -> None:

@@ -349,6 +349,22 @@ class Server:
             "veneur.sink.flush_duration_ns",
             "one sink flush call, success or failure",
             labelnames=("sink",))
+        # co-located collective tier phase accounting — registered even
+        # with the tier off so the inventory is stable; injected into
+        # the tier at attach time (set_phase_timer) so the tier module
+        # stays registry-free
+        self._t_coll_phase = M.timer(
+            "veneur.collective.phase_duration_ns",
+            "collective tier phase wall time: stage, all_to_all_route, "
+            "replica_merge, flush",
+            labelnames=("phase",))
+        # native ring emit latency, observed as a per-flush delta
+        # average of the C++ emit_packed counters (zero hot-path cost)
+        self._t_ring_emit = M.timer(
+            "veneur.ring.emit_packed_duration_ns",
+            "average packed-emit call latency over the last flush "
+            "interval (C++ vt_emit_packed, steady_clock)")
+        self._ring_emit_prev = (0, 0)
         # durability layer (veneur_tpu/persistence/) — registered even
         # with checkpointing off so the inventory is stable; they just
         # stay zero
@@ -579,10 +595,58 @@ class Server:
         M.callback("veneur.device.step_ns_total",
                    lambda: getattr(self.aggregator, "step_ns", 0),
                    kind="counter",
-                   help="device ingest-step dispatch wall time (host side)")
+                   help="device ingest-step wall time including the "
+                        "sampled block_until_ready sync (host side)")
+        M.callback("veneur.device.dispatch_ns_total",
+                   lambda: getattr(self.aggregator, "dispatch_ns", 0),
+                   kind="counter",
+                   help="device ingest-step dispatch-only wall time — "
+                        "async enqueue cost, no sync (host side)")
         M.callback("veneur.device.steps_total",
                    lambda: getattr(self.aggregator, "steps_total", 0),
                    kind="counter", help="device ingest steps dispatched")
+        M.callback("veneur.device.steps_synced_total",
+                   lambda: getattr(self.aggregator, "steps_synced", 0),
+                   kind="counter",
+                   help="ingest steps that ran a block_until_ready sync "
+                        "(1-in-N sample plus swap boundaries)")
+        M.callback("veneur.device.hbm_bytes_in_use",
+                   jaxruntime.hbm_bytes_in_use, labelnames=("device",),
+                   help="live device memory per accelerator "
+                        "(memory_stats; absent on backends without it)")
+        M.callback("veneur.device.hbm_bytes_peak",
+                   jaxruntime.hbm_bytes_peak, labelnames=("device",),
+                   help="peak device memory per accelerator "
+                        "(memory_stats; absent on backends without it)")
+        # native ring (C++ vr_stats snapshot; mutex-guarded counters +
+        # relaxed parser atomics, safe to read while the pipeline emits)
+        M.callback("veneur.ring.depth",
+                   lambda: float(self._ring_stats().get("ring_depth", 0)),
+                   help="parsed datagrams waiting in the native ring")
+        M.callback("veneur.ring.depth_highwater",
+                   lambda: float(
+                       self._ring_stats().get("ring_highwater", 0)),
+                   help="deepest the native ring has been since start")
+        M.callback("veneur.ring.pump_batches_total",
+                   lambda: float(
+                       self._ring_stats().get("pump_batches", 0)),
+                   kind="counter",
+                   help="non-empty batches drained by pipeline_pump")
+        M.callback("veneur.ring.buffer_swap_stalls_total",
+                   lambda: float(self._ring_stats().get("pump_stalls", 0)),
+                   kind="counter",
+                   help="pump drains that hit the staging-buffer cap "
+                        "(double-buffer swap had to wait on the device)")
+        M.callback("veneur.ring.emit_packed_total",
+                   lambda: float(
+                       self._ring_stats().get("emit_packed_calls", 0)),
+                   kind="counter",
+                   help="packed-emit calls made by the C++ engine")
+        M.callback("veneur.ring.emit_packed_ns_total",
+                   lambda: float(
+                       self._ring_stats().get("emit_packed_ns", 0)),
+                   kind="counter",
+                   help="wall time inside C++ vt_emit_packed")
         M.callback("veneur.jax.compiles_total", jaxruntime.compiles_total,
                    kind="counter",
                    help="XLA backend compiles observed, process-wide")
@@ -677,6 +741,24 @@ class Server:
                         "degraded timer sampling / set subsampling")
 
     # -- registry collector helpers -----------------------------------------
+    def _ring_stats(self) -> dict:
+        """Native ring snapshot, or {} on servers without the C++
+        engine (collectors then read their zero defaults)."""
+        fn = getattr(self.aggregator, "ring_stats", None)
+        return fn() if fn is not None else {}
+
+    def _poll_ring_telemetry(self) -> None:
+        """Flush-interval poll: turn the cumulative C++ emit counters
+        into one per-interval average-latency observation. Runs on the
+        flush worker thread only (the prev-tuple needs no lock)."""
+        st = self._ring_stats()
+        calls = int(st.get("emit_packed_calls", 0))
+        ns = int(st.get("emit_packed_ns", 0))
+        pc, pn = self._ring_emit_prev
+        if calls > pc:
+            self._t_ring_emit.observe((ns - pn) / (calls - pc))
+        self._ring_emit_prev = (calls, ns)
+
     def _breaker_list(self):
         out = [(s.name, self._sink_breakers[id(s)])
                for s in self.metric_sinks + self.span_sinks
@@ -1708,6 +1790,7 @@ class Server:
         if self._dedup_check(envelope):
             return True
         self.packet_queue.put(_ImportBatch(metrics))
+        self._trace_import_absorb(envelope, rows=len(metrics))
         return True
 
     def import_bytes(self, data: bytes, envelope=None) -> bool:
@@ -1720,7 +1803,28 @@ class Server:
         if self._dedup_check(envelope):
             return True
         self.packet_queue.put(_ImportBytes(data))
+        self._trace_import_absorb(envelope, nbytes=len(data))
         return True
+
+    def _trace_import_absorb(self, envelope, rows=None, nbytes=None):
+        """Wire-side half of the cross-tier flush trace: when the
+        sender's envelope carries trace context, record an absorb span
+        parented onto ITS flush.forward span — the receiving tier's
+        span pipeline then holds one connected tree per interval. A
+        legacy / untraced envelope (no context) records nothing."""
+        if envelope is None \
+                or getattr(envelope, "trace_id", None) is None:
+            return
+        from veneur_tpu.trace.tracer import Span
+        sp = Span("veneur.import.absorb", service="veneur",
+                  trace_id=envelope.trace_id,
+                  parent_id=envelope.parent_span_id)
+        sp.set_tag("source_id", envelope.source_id)
+        if rows is not None:
+            sp.set_tag("rows", str(rows))
+        if nbytes is not None:
+            sp.set_tag("bytes", str(nbytes))
+        sp.client_finish(self.trace_client)
 
     def process_span_metrics(self, metrics: List) -> None:
         """Extraction-sink loop-back: span-derived UDPMetrics re-enter the
@@ -1961,7 +2065,16 @@ class Server:
         # failure falls through to it untouched.
         absorbed = False
         if self.cfg.collective_attach and raw is not None:
-            absorbed = self._absorb_colocated(raw, table)
+            # the co-located absorb IS this interval's forward, so it
+            # gets the same flush.forward stage span the wire path
+            # would; the tier parents its absorb span onto it and the
+            # span tree stays connected across tiers without a wire hop
+            asp = stage("forward")
+            asp.set_tag("transport", "colocated")
+            try:
+                absorbed = self._absorb_colocated(raw, table, span=asp)
+            finally:
+                asp.client_finish(self.trace_client)
         if (self._fwd_source_id is not None and raw is not None
                 and not absorbed):
             self._stage_forward_unit(raw, table)
@@ -2128,6 +2241,8 @@ class Server:
         # reference always tallies flush totals (flusher.go:300-336), and an
         # idle server must still bootstrap veneur.flush.* / packet counters
         # into its own pipeline.
+        # per-interval native-ring poll (emit latency delta average)
+        self._poll_ring_telemetry()
         self._report_self_metrics(len(final), time.perf_counter() - flush_t0,
                                   stats, final=final)
         # total = downstream work + the pipeline-thread swap it rode in on
@@ -2222,23 +2337,29 @@ class Server:
             log.exception("forward export/staging failed; interval not "
                           "staged")
 
-    def _absorb_colocated(self, raw, table) -> bool:
+    def _absorb_colocated(self, raw, table, span=None) -> bool:
         """Hand this interval's forwardable rows to the co-located
         collective tier (collective/tier.py) as device staging. True
         means the tier took the interval and the wire path must not run
         (staging it too would double-count the additive kinds); False
         means no tier / failed absorb, and the caller falls back to the
-        ordinary forward path untouched."""
+        ordinary forward path untouched. `span` is the local flush's
+        forward stage span — the tier's absorb span parents onto it."""
         from veneur_tpu.collective import tier as collective_tier
         t = collective_tier.lookup(self.cfg.collective_attach)
         if t is None:
             # no co-located tier in this process (yet) — DCN fallback
             return False
+        # inject the registry-backed phase timer (idempotent; last
+        # writer wins and every local attaches the same server's timer)
+        t.set_phase_timer(self._t_coll_phase)
         try:
             if self._collective_participant is None:
                 self._collective_participant = t.assign_participant()
             n = t.absorb_raw(raw, table,
-                             participant=self._collective_participant)
+                             participant=self._collective_participant,
+                             parent_span=span,
+                             trace_client=self.trace_client)
         except Exception:
             self._c_coll_errors.inc()
             log.exception("co-located collective absorb failed; interval "
@@ -2274,7 +2395,16 @@ class Server:
                     and not self._forward_breaker.allow()):
                 raise CircuitOpenError("forward: circuit open")
             for unit in self.forward_spill.pending_units():
-                env = Envelope(self._fwd_source_id, unit.epoch, unit.seq)
+                # trace context rides the envelope so the receiving
+                # tier's absorb span parents onto THIS flush's forward
+                # span; untraced (span=None) stays wire-identical to a
+                # legacy sender
+                env = Envelope(self._fwd_source_id, unit.epoch, unit.seq,
+                               trace_id=(span.trace_id
+                                         if span is not None else None),
+                               parent_span_id=(span.id
+                                              if span is not None
+                                              else None))
                 n_metrics += len(unit.metrics)
                 self._send_forward(unit.metrics, span, envelope=env)
                 self.forward_spill.ack(unit.epoch, unit.seq)

@@ -25,7 +25,9 @@ import numpy as np
 
 from veneur_tpu.aggregation.host import Batcher, BatchSpec, KeyTable
 from veneur_tpu.aggregation.state import TableSpec
-from veneur_tpu.server.aggregator import Aggregator, set_member_bytes
+from veneur_tpu.observability import jaxruntime
+from veneur_tpu.server.aggregator import (
+    _SYNC_EVERY, Aggregator, set_member_bytes)
 
 
 def per_shard_spec(spec: TableSpec, n_shards: int) -> TableSpec:
@@ -146,10 +148,14 @@ class ShardedAggregator(Aggregator):
         self.processed = 0
         self.dropped_capacity = 0
         # same device-step accounting surface as the single-device
-        # Aggregator (observability callbacks read these by getattr)
+        # Aggregator (observability callbacks read these by getattr):
+        # dispatch_ns = host-side dispatch, step_ns = sampled synced
+        # wall time (see Aggregator.__init__)
         self.h2d_bytes = 0
         self.step_ns = 0
+        self.dispatch_ns = 0
         self.steps_total = 0
+        self.steps_synced = 0
         self._init_degrade()
 
     # -- slot routing --------------------------------------------------------
@@ -284,7 +290,12 @@ class ShardedAggregator(Aggregator):
         self.h2d_bytes += flat.nbytes
         t0 = time.perf_counter_ns()
         self.state = self._ingest(self.state, flat)
-        self.step_ns += time.perf_counter_ns() - t0
+        dispatch_dt = time.perf_counter_ns() - t0
+        self.dispatch_ns += dispatch_dt
+        if self.steps_total % _SYNC_EVERY == 0:
+            self.step_ns += dispatch_dt + jaxruntime.sync_and_time(
+                self.state)
+            self.steps_synced += 1
 
     def _on_shard_batch(self, shard: int, batch):
         self._dispatch_row([batch if i == shard else b.force_emit()
@@ -337,6 +348,10 @@ class ShardedAggregator(Aggregator):
     def swap(self):
         self._emit_all()
         self._apply_hll_imports()
+        if self._steps:
+            # interval boundary sync (see Aggregator.swap)
+            self.step_ns += jaxruntime.sync_and_time(self.state)
+            self.steps_synced += 1
         state, table = self.state, self.table
         self.state = self._empty()
         self.table = KeyTable(self.spec, self.n_shards)
