@@ -280,6 +280,7 @@ def main():
                  "--only", "worker_ingest", "--only", "flush_label_frame",
                  "--only", "import_decode_native",
                  "--only", "pipeline_pump",
+                 "--only", "pipeline_pump_mc",
                  "--only", "telemetry_overhead",
                  "--only", "telemetry_scrape"],
                 capture_output=True, text=True, timeout=micro_t,
@@ -297,12 +298,17 @@ def main():
                     if "h2d_mb_per_sec" in row:
                         host[row["bench"] + "_h2d_mb_per_sec"] = \
                             row["h2d_mb_per_sec"]
-                    # telemetry_overhead is a GATE, not just a rate:
-                    # record the A/B verdict and the per-source scrape
-                    # costs so a regression names its source
+                    # telemetry_overhead and pipeline_pump_mc are GATES,
+                    # not just rates: record the A/B verdicts (and the
+                    # per-source scrape costs / ring-scaling ratio) so a
+                    # regression names its source
                     for extra in ("overhead_pct", "gate_lt_2pct",
                                   "ops_per_sec_off", "ring_stats_ns",
-                                  "reader_counters_ns", "hbm_stats_ns"):
+                                  "reader_counters_ns", "hbm_stats_ns",
+                                  "ops_per_sec_1ring", "n_rings",
+                                  "host_cores", "scaling_x",
+                                  "accounting_exact",
+                                  "gate_ge_2p5x_armed", "gate_ge_2p5x_ok"):
                         if extra in row:
                             host[f"{row['bench']}_{extra}"] = row[extra]
                 elif "skipped" in row:

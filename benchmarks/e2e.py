@@ -1170,7 +1170,17 @@ def config10_wire_to_flush_firehose(scale=1.0):
     reader's received-datagram counter so the kernel socket buffer — the
     one lossy hop the identity cannot see — never overflows. The on-chip
     throughput gate (≥5M samples/sec/host through the pump) arms on TPU
-    only; CPU smoke checks the accounting + shedding behavior."""
+    only; CPU smoke checks the accounting + shedding behavior.
+
+    Round 14: the firehose rides the MULTI-RING engine (reader_rings=4,
+    README §Host feed architecture) — four SO_REUSEPORT sockets, one
+    ring + parse worker each, per-ring admission with the rate split in
+    C++. The admitted/shed identity is asserted with every term drained
+    from EVERY ring (srv._sync_native_admission folds all rings), plus a
+    cross-ring fold check that the aggregate reader counters equal the
+    per-ring sums. The ≥20M samples/sec/host gate arms on a TPU host
+    with the cores to feed four rings; the 1-core CPU CI box records the
+    rate and the exactness booleans only (cpu_smoke stays green)."""
     import jax
 
     from veneur_tpu import native as native_mod
@@ -1196,6 +1206,7 @@ def config10_wire_to_flush_firehose(scale=1.0):
     # the idle histogram table instead of the feed path under test
     srv = _mk_server(
         [BlackholeMetricSink()], udp=True, num_readers=2,
+        reader_rings=4,
         overload_enabled=True, overload_poll_interval_s=0.05,
         overload_hold_s=0.5,
         shed_priority_tags=["veneur.priority:high"],
@@ -1346,6 +1357,25 @@ def config10_wire_to_flush_firehose(scale=1.0):
                    default=ov.state)
         sps = processed / dt
         on_tpu = jax.default_backend() == "tpu"
+        # cross-ring fold exactness: the aggregate reader counters the
+        # identity above used must equal the per-ring sums — a ring the
+        # aggregate silently skipped would pass the identity by luck on
+        # an idle ring and lose counts on a busy one
+        eng = getattr(srv.aggregator, "eng", None)
+        n_rings = eng.n_rings if eng is not None else 0
+        per_ring_datagrams = []
+        fold_exact = None
+        if n_rings:
+            dsum = tsum = 0
+            for r in range(n_rings):
+                c = eng.ring_counters_one(r)
+                per_ring_datagrams.append(int(c["datagrams"]))
+                dsum += c["datagrams"]
+                tsum += c["toolong"]
+            fold_exact = (dsum == rc1["datagrams"]
+                          and tsum == rc1["toolong"])
+        host_cores = len(os.sched_getaffinity(0))
+        gate20_armed = on_tpu and host_cores >= 5
         return {
             "config": 10, "name": "wire_to_flush_firehose",
             "datagrams_sent": len(payloads),
@@ -1366,6 +1396,13 @@ def config10_wire_to_flush_firehose(scale=1.0):
             "samples_per_sec": round(sps, 1),
             "on_chip_gate_5m_armed": on_tpu,
             "samples_per_sec_ge_5m": (sps >= 5e6) if on_tpu else None,
+            "n_rings": int(n_rings),
+            "host_cores": host_cores,
+            "per_ring_datagrams": per_ring_datagrams,
+            "cross_ring_fold_exact": fold_exact,
+            "host_gate_20m_armed": gate20_armed,
+            "samples_per_sec_ge_20m": (sps >= 20e6) if gate20_armed
+            else None,
             "wall_seconds": round(dt, 3),
         }
     finally:

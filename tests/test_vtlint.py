@@ -356,6 +356,33 @@ CASES = [
                     stats.dropped += 1
         """},
     ),
+    (
+        # surface 3 of the same pass (pytest uniquifies the repeated id)
+        "accounting-flow",
+        lambda p: accounting_flow.run(p, targets=[], send_targets={},
+                                      ring_targets=["pkg"]),
+        # positive: a per-ring drain outside any fold loop silently
+        # reads (and for admission, destructively resets) ONE ring
+        {"pkg/drain.py": """
+            def reader_totals(eng):
+                out = eng.ring_counters_one(0)
+                adm = eng.ring_admission_drain_one(0)
+                return out, adm
+        """},
+        # negative: folded across all rings, plus the `_one`-suffix
+        # accessor exemption (the suffix IS the caller-must-fold
+        # contract this surface enforces on callers)
+        {"pkg/drain.py": """
+            def reader_totals(eng, n_rings):
+                total = 0
+                for r in range(n_rings):
+                    total += eng.ring_counters_one(r)["datagrams"]
+                return total
+
+            def ring_counters_one(eng, r):
+                return eng.vrm_counters(r)
+        """},
+    ),
 ]
 
 _IDS = [c[0] for c in CASES]

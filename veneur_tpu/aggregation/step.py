@@ -359,6 +359,32 @@ ingest_step_packed = partial(
     donate_argnames=("state",))(packed_step_core)
 
 
+def packed_rings_core(state: DeviceState, arena, *, spec: TableSpec,
+                      sizes: tuple) -> DeviceState:
+    """Multi-ring step: `arena` is i32[R, words] — one packed row per
+    reader ring, all shipped in ONE host->device transfer (the multi-ring
+    pipeline's whole point: R rings cost one RTT, not R). The loop is
+    unrolled at trace time (R is static via the arena shape), so XLA sees
+    R back-to-back packed steps in a single program — same executable
+    residency story as ingest_step_packed, and the fused Pallas ingest
+    kernel (when active inside ingest_core) runs per row against its
+    scalar-prefetch windows unchanged. Idle rings ride as sentinel-only
+    rows whose scatters all drop; the host skips the step entirely when
+    every ring emitted zero rows. Only row 0 carries the compact control
+    word — one compaction per step, exactly like the single-ring path."""
+    n_rings = arena.shape[0]
+    state = packed_step_core(state, arena[0], spec=spec, sizes=sizes)
+    for r in range(1, n_rings):
+        state = ingest_core(state, unpack_batch(arena[r][1:], sizes),
+                            spec=spec)
+    return state
+
+
+ingest_step_packed_rings = partial(
+    jax.jit, static_argnames=("spec", "sizes"),
+    donate_argnames=("state",))(packed_rings_core)
+
+
 def _fold_core(state: DeviceState) -> DeviceState:
     ch, cl = twofloat_add(state.counter_hi, state.counter_lo, state.counter_acc)
     hch, hcl = twofloat_add(state.h_count_hi, state.h_count_lo, state.h_count_acc)

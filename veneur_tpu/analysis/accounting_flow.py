@@ -27,9 +27,27 @@ from veneur_tpu.analysis import ambiguous_paths, drop_accounting
 
 NAME = "accounting-flow"
 DOC = ("every branch of a drop/send-failure handler accounts before "
-       "it exits (dataflow, follows early returns + helper calls)")
+       "it exits (dataflow, follows early returns + helper calls); "
+       "per-ring counter drains fold across ALL rings")
 
 _REJECT_NAMES = ("invalid", "drop", "reject", "shed", "error")
+
+# surface 3: cross-ring counter folds. The multi-ring engine's per-ring
+# drains are DESTRUCTIVE (admission deltas) or partial (one ring's
+# counters); a caller that reads one ring outside a fold loop silently
+# loses the other rings' counts — exactly the bug class the
+# datagrams == toolong + admitted + shed invariant exists to catch.
+# Calls to these names must sit inside a for/while fold over the rings;
+# `*_one` accessors are exempt BY NAME (the suffix is the documented
+# "caller must fold" contract this surface enforces on their callers).
+RING_DRAINS = frozenset({
+    "vrm_admission_counters", "vrm_counters", "vrm_ring_stats",
+    "ring_admission_drain_one", "ring_counters_one", "ring_stats_one"})
+RING_TARGETS = (
+    "veneur_tpu/native/__init__.py",
+    "veneur_tpu/server/server.py",
+    "veneur_tpu/server/native_aggregator.py",
+)
 
 
 def _helper_name(call: ast.Call) -> Optional[str]:
@@ -161,8 +179,62 @@ class _Flow:
         return findings
 
 
+def _call_leaf(call: ast.Call) -> Optional[str]:
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+def _walk_shallow(node, root):
+    """ast.walk that does NOT descend into nested function defs (each
+    def is analyzed as its own fold scope)."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        if n is not root and isinstance(
+                n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _ring_fold_findings(ctx: FileContext, drains) -> List[Finding]:
+    """Per-ring drain calls outside a for/while fold loop, per function.
+    A lone drain reads (or destructively resets) ONE ring where the
+    accounting invariant needs the sum over all of them."""
+    findings: List[Finding] = []
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if fn.name.endswith("_one"):
+            continue   # per-ring accessor shim: contract rides the name
+        looped = set()
+        for node in _walk_shallow(fn, fn):
+            if isinstance(node, (ast.For, ast.While)):
+                for sub in _walk_shallow(node, fn):
+                    if sub is node:
+                        continue
+                    if isinstance(sub, ast.Call) \
+                            and _call_leaf(sub) in drains:
+                        looped.add(id(sub))
+        for node in _walk_shallow(fn, fn):
+            if isinstance(node, ast.Call) and _call_leaf(node) in drains \
+                    and id(node) not in looped:
+                findings.append(Finding(
+                    NAME, ctx.rel, node.lineno,
+                    f"per-ring drain `{_call_leaf(node)}` in "
+                    f"{fn.name}() outside a fold loop — counters from "
+                    "the other rings are lost (sum across all rings)"))
+    return findings
+
+
 def run(project: Project, targets: List[str] = None,
-        send_targets: Dict[str, Set[str]] = None) -> List[Finding]:
+        send_targets: Dict[str, Set[str]] = None,
+        ring_targets: List[str] = None,
+        ring_drains: Set[str] = None) -> List[Finding]:
     findings: List[Finding] = []
     # surface 1: drop-exception handlers across the ingest/egress tree
     for ctx in project.files(*(targets if targets is not None
@@ -187,4 +259,9 @@ def run(project: Project, targets: List[str] = None,
                 ctx.tree, funcs):
             findings.extend(flow.check_handler(
                 handler, f"except in {fname}()"))
+    # surface 3: per-ring counter drains must fold across all rings
+    drains = ring_drains if ring_drains is not None else RING_DRAINS
+    for ctx in project.files(*(ring_targets if ring_targets is not None
+                               else RING_TARGETS)):
+        findings.extend(_ring_fold_findings(ctx, drains))
     return findings

@@ -32,6 +32,31 @@ def _format_series(name: str, labels: dict) -> str:
     return f"{name}{{{inner}}}"
 
 
+def ring_table(samples) -> list:
+    """Render the multi-ring ingest family (veneur.ring.per_ring_*,
+    ring=<i> label) as one aligned row per ring — the operator's at-a-
+    glance skew check (one cold ring = a mis-pinned core or a kernel
+    flow-hash imbalance). Empty outside multi-ring mode."""
+    per_ring: dict = {}
+    cols: list = []
+    for name, labels, value in samples:
+        if "per_ring_" not in name or "ring" not in labels:
+            continue
+        stat = name.split("per_ring_", 1)[1]
+        if stat not in cols:
+            cols.append(stat)
+        per_ring.setdefault(labels["ring"], {})[stat] = value
+    if not per_ring:
+        return []
+    rows = [["ring"] + cols]
+    for ring in sorted(per_ring, key=lambda r: (len(r), r)):
+        rows.append([ring] + [f"{per_ring[ring].get(c, 0):g}"
+                              for c in cols])
+    widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
+    return ["  ".join(f"{cell:>{w}}" for cell, w in zip(r, widths))
+            for r in rows]
+
+
 def dump_once(fetch, as_json: bool, out=None) -> int:
     """One scrape → sorted text (or JSON) on `out`. Returns an exit
     code: 1 on fetch failure, 0 otherwise (an empty exposition is a
@@ -56,6 +81,12 @@ def dump_once(fetch, as_json: bool, out=None) -> int:
     width = max(len(s) for s, _, _ in rows)
     for series, value, _ in rows:
         print(f"{series:<{width}}  {value:g}", file=out)
+    table = ring_table(samples)
+    if table:
+        print("", file=out)
+        print("native ingest rings:", file=out)
+        for line in table:
+            print(f"  {line}", file=out)
     return 0
 
 

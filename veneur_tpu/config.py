@@ -298,6 +298,20 @@ class Config:
     # C++ recvmmsg reader threads for UDP statsd (GIL-free socket reads;
     # requires native_ingest). Python reader threads otherwise.
     native_udp_readers: bool = True
+    # Multi-ring host scale-out: one ring + parser + packed arena row per
+    # reader core (requires native_udp_readers). 1 keeps the proven
+    # single-ring engine; each SO_REUSEPORT reader fd owns its ring at
+    # >1. See README "Host feed architecture".
+    reader_rings: int = 1
+    # Optional per-ring sched_affinity pinning: core id per ring (shorter
+    # lists leave the remaining rings unpinned; empty = no pinning).
+    reader_pin_cores: List[int] = dataclasses.field(default_factory=list)
+    # Pre-sharded native emit on sharded/collective backends: staged rows
+    # leave the engine grouped by route_digest owner shard so the
+    # _split_shards argsort and the collective all_to_all shuffle are
+    # no-ops on the native path. Flush output is byte-identical either
+    # way (tests/test_native_preshard.py pins it).
+    native_preshard_enabled: bool = False
     tpu_counter_capacity: int = 1 << 17
     tpu_gauge_capacity: int = 1 << 15
     tpu_status_capacity: int = 1 << 10

@@ -125,6 +125,49 @@ def _build_and_load():
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32),
             ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
             ctypes.POINTER(ctypes.c_float), ctypes.c_int]
+        lib.vt_route_digest.restype = ctypes.c_uint32
+        lib.vt_route_digest.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_int]
+        lib.vt_emit_sharded.argtypes = [ctypes.c_void_p] + \
+            [ctypes.c_void_p] * 10 + [ctypes.POINTER(ctypes.c_int32),
+                                      ctypes.POINTER(ctypes.c_uint32)]
+        lib.vrm_start.restype = ctypes.c_void_p
+        lib.vrm_start.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int), ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.POINTER(ctypes.c_int)]
+        lib.vrm_n_rings.restype = ctypes.c_int
+        lib.vrm_n_rings.argtypes = [ctypes.c_void_p]
+        lib.vrm_inject.restype = ctypes.c_int
+        lib.vrm_inject.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                   ctypes.c_char_p, ctypes.c_int]
+        lib.vrm_wait.restype = ctypes.c_int
+        lib.vrm_wait.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.vrm_pending.restype = ctypes.c_int
+        lib.vrm_pending.argtypes = [ctypes.c_void_p]
+        lib.vrm_emit.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_uint32),
+            ctypes.POINTER(ctypes.c_uint32)]
+        lib.vrm_emit_sharded.argtypes = [ctypes.c_void_p, ctypes.c_int] + \
+            [ctypes.c_void_p] * 10 + [ctypes.POINTER(ctypes.c_int32),
+                                      ctypes.POINTER(ctypes.c_uint32)]
+        lib.vrm_pause.argtypes = [ctypes.c_void_p]
+        lib.vrm_resume.argtypes = [ctypes.c_void_p]
+        lib.vrm_reset.argtypes = [ctypes.c_void_p]
+        lib.vrm_counters.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                     ctypes.POINTER(ctypes.c_uint64)]
+        lib.vrm_ring_stats.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                       ctypes.POINTER(ctypes.c_uint64)]
+        lib.vrm_admission_set.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_double,
+            ctypes.c_double, ctypes.c_char_p, ctypes.c_int]
+        lib.vrm_admission_counters.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_uint64)]
+        lib.vrm_stats.argtypes = [ctypes.c_void_p,
+                                  ctypes.POINTER(ctypes.c_uint64)]
+        lib.vrm_stop.argtypes = [ctypes.c_void_p]
         _lib = lib
     except Exception as e:  # noqa: BLE001 — any failure => python fallback
         _load_err = str(e)
@@ -158,6 +201,21 @@ def hash64_batch(members: List[bytes]) -> "np.ndarray":
         buf, offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n,
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)))
     return out
+
+
+def route_digest(kind: str, name: str, joined_tags: str) -> int:
+    """The C++ engine's routing digest (fnv1a-32 over name, kind, joined
+    tags) — must be byte-identical to collective.keytable.route_digest;
+    tests/test_native.py pins the parity over a fuzz corpus. Raises when
+    the engine isn't built — callers gate on available()."""
+    _build_and_load()
+    if _lib is None:
+        raise RuntimeError(f"native ingest unavailable: {_load_err}")
+    name_b = name.encode("utf-8", "surrogateescape")
+    kind_b = kind.encode("utf-8")
+    tags_b = joined_tags.encode("utf-8", "surrogateescape")
+    return int(_lib.vt_route_digest(name_b, len(name_b), kind_b,
+                                    len(kind_b), tags_b, len(tags_b)))
 
 
 class NativeIngest:
@@ -221,6 +279,20 @@ class NativeIngest:
             lane_offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
             prev_counts.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
             counts)
+        return tuple(counts)
+
+    def emit_sharded(self, batcher_arrays, bounds: "np.ndarray") -> tuple:
+        """Pre-sharded emit: like emit_into but rows arrive grouped by
+        owner shard (stable, so arrival order — gauge LWW — is preserved
+        within each shard) with slots rebased shard-local. `bounds`
+        (int32[4*(n_shards+1)], kinds in counter/gauge/set/histo order)
+        receives per-kind shard prefix bounds so per-shard batchers take
+        contiguous slices with no argsort. Returns (nc, ng, ns, nh)."""
+        counts = (ctypes.c_uint32 * 4)()
+        ptrs = [a.ctypes.data_as(ctypes.c_void_p) for a in batcher_arrays]
+        _lib.vt_emit_sharded(
+            self._h, *ptrs,
+            bounds.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), counts)
         return tuple(counts)
 
     def pending(self) -> int:
@@ -334,11 +406,21 @@ class NativeIngest:
         return out
 
     def reset(self):
-        _lib.vt_reset(self._h)
+        r = getattr(self, "_rings", None)
+        if r:
+            # clears the master tables AND every ring's key-replica cache;
+            # callers hold the rings_pause() quiesce across this
+            _lib.vrm_reset(r)
+        else:
+            _lib.vt_reset(self._h)
 
     def stats(self) -> dict:
         s = (ctypes.c_uint64 * 3)()
-        _lib.vt_stats(self._h, s)
+        r = getattr(self, "_rings", None)
+        if r:
+            _lib.vrm_stats(r, s)  # summed over ring parsers + master
+        else:
+            _lib.vt_stats(self._h, s)
         return {"processed": s[0], "parse_errors": s[1], "dropped": s[2]}
 
     # -- native UDP reader group (vr_* in dogstatsd.cpp) --------------------
@@ -365,7 +447,20 @@ class NativeIngest:
                             "ring_dropped": out[2], "datagrams": out[3]}
 
     def reader_counters(self) -> dict:
-        """Live reader-group counters, callable from any thread."""
+        """Live reader counters, callable from any thread. With the
+        multi-ring engine the totals are exact sums over every ring."""
+        m = getattr(self, "_rings", None)
+        if m:
+            agg = {"datagrams": 0, "ring_dropped": 0, "ring_depth": 0,
+                   "toolong": 0}
+            out = (ctypes.c_uint64 * 4)()
+            for i in range(self._n_rings):
+                _lib.vrm_counters(m, i, out)
+                agg["datagrams"] += out[0]
+                agg["ring_dropped"] += out[1]
+                agg["ring_depth"] += out[2]
+                agg["toolong"] += out[3]
+            return agg
         r = getattr(self, "_readers", None)
         if not r:
             return {"datagrams": 0, "ring_dropped": 0, "ring_depth": 0,
@@ -379,7 +474,26 @@ class NativeIngest:
         """Deep ring/emit telemetry snapshot, callable from any thread
         (one C++ lock, no hot-path cost): ring depth + high-water, pump
         batch/stall counts, emit_packed call/ns totals, datagram and
-        ring-drop totals. Zeros when no reader group is running."""
+        ring-drop totals. Zeros when no reader group is running. With the
+        multi-ring engine, counters are exact cross-ring sums and
+        ring_depth/ring_highwater aggregate as sum/max."""
+        m = getattr(self, "_rings", None)
+        if m:
+            agg = {"ring_depth": 0, "ring_highwater": 0,
+                   "pump_batches": 0, "pump_stalls": 0,
+                   "emit_packed_calls": 0, "emit_packed_ns": 0,
+                   "datagrams": 0, "ring_dropped": 0}
+            for per in self.ring_stats_per_ring():
+                agg["ring_depth"] += per["ring_depth"]
+                agg["ring_highwater"] = max(agg["ring_highwater"],
+                                            per["ring_highwater"])
+                agg["pump_batches"] += per["pump_batches"]
+                agg["pump_stalls"] += per["pump_stalls"]
+                agg["emit_packed_calls"] += per["emit_packed_calls"]
+                agg["emit_packed_ns"] += per["emit_packed_ns"]
+                agg["datagrams"] += per["datagrams"]
+                agg["ring_dropped"] += per["ring_dropped"]
+            return agg
         r = getattr(self, "_readers", None)
         if not r:
             return {"ring_depth": 0, "ring_highwater": 0,
@@ -397,11 +511,19 @@ class NativeIngest:
                       burst: float, high_tags) -> None:
         """Push the OverloadController's statsd admission knobs into the
         reader ring (called from the controller poll thread). high_tags is
-        an iterable of shed_priority_tags strings."""
+        an iterable of shed_priority_tags strings. With the multi-ring
+        engine, rate/burst split evenly across rings inside the C++ so the
+        host-level admit rate matches the single-ring contract."""
+        joined = "\n".join(high_tags).encode("utf-8", "surrogateescape")
+        m = getattr(self, "_rings", None)
+        if m:
+            _lib.vrm_admission_set(m, 1 if enabled else 0, int(state),
+                                   float(rate), float(burst), joined,
+                                   len(joined))
+            return
         r = getattr(self, "_readers", None)
         if not r:
             return
-        joined = "\n".join(high_tags).encode("utf-8", "surrogateescape")
         _lib.vr_admission_set(r, 1 if enabled else 0, int(state),
                               float(rate), float(burst), joined,
                               len(joined))
@@ -409,13 +531,30 @@ class NativeIngest:
     def admission_drain(self) -> dict:
         """Drain-and-reset exact per-class ring admission deltas:
         {"admitted": {class: n}, "shed": {class: n}} with zero entries
-        omitted (classes: self/high/low, mirroring PriorityClassifier)."""
+        omitted (classes: self/high/low, mirroring PriorityClassifier).
+        With the multi-ring engine, the per-class deltas are drained from
+        EVERY ring and summed so the invariant sent == toolong + admitted
+        + shed holds host-wide."""
+        names = ("self", "high", "low")
+        m = getattr(self, "_rings", None)
+        if m:
+            adm = [0, 0, 0]
+            shed = [0, 0, 0]
+            out = (ctypes.c_uint64 * 6)()
+            for i in range(self._n_rings):
+                _lib.vrm_admission_counters(m, i, out)
+                for c in range(3):
+                    adm[c] += out[c]
+                    shed[c] += out[3 + c]
+            return {
+                "admitted": {names[i]: adm[i] for i in range(3) if adm[i]},
+                "shed": {names[i]: shed[i] for i in range(3) if shed[i]},
+            }
         r = getattr(self, "_readers", None)
         if not r:
             return {"admitted": {}, "shed": {}}
         out = (ctypes.c_uint64 * 6)()
         _lib.vr_admission_counters(r, out)
-        names = ("self", "high", "low")
         return {
             "admitted": {names[i]: out[i] for i in range(3) if out[i]},
             "shed": {names[i]: out[3 + i] for i in range(3) if out[3 + i]},
@@ -426,3 +565,124 @@ class NativeIngest:
         if r:
             _lib.vr_stop(r)
             self._readers = None
+        m = getattr(self, "_rings", None)
+        if m:
+            _lib.vrm_stop(m)
+            self._rings = None
+            self._n_rings = 0
+
+    # -- multi-ring engine (vrm_* in dogstatsd.cpp) -------------------------
+
+    @property
+    def n_rings(self) -> int:
+        """Rings in the multi-ring engine; 0 when it isn't running."""
+        return getattr(self, "_n_rings", 0) if getattr(
+            self, "_rings", None) else 0
+
+    def rings_start(self, n_rings: int, fds=None, max_len: int = 65536,
+                    ring_cap: int = 65536, pin_cores=None) -> None:
+        """Start the multi-ring engine: one ring + parser thread pair per
+        entry (vrm_start), all sharing this instance's key tables. fds[i]
+        >= 0 attaches a dup()ed SO_REUSEPORT socket to ring i; None/-1
+        entries make inject-only rings (benches, tests use rings_inject
+        for deterministic placement). pin_cores[i] >= 0 pins ring i's
+        reader+worker threads to that core."""
+        fd_arr = (ctypes.c_int * n_rings)(
+            *[(fds[i] if fds is not None and i < len(fds)
+               and fds[i] is not None else -1) for i in range(n_rings)])
+        pin_arr = None
+        if pin_cores:
+            pin_arr = (ctypes.c_int * n_rings)(
+                *[(pin_cores[i] if i < len(pin_cores) else -1)
+                  for i in range(n_rings)])
+        self._rings = _lib.vrm_start(self._h, fd_arr, n_rings, max_len,
+                                     ring_cap, pin_arr)
+        self._n_rings = n_rings
+
+    def rings_inject(self, ring: int, data: bytes) -> bool:
+        """Queue one datagram onto ring i through the same toolong/
+        admission/ring-cap accounting as the socket path. False when the
+        datagram was counted-and-dropped."""
+        return bool(_lib.vrm_inject(self._rings, ring, data, len(data)))
+
+    def rings_wait(self, max_wait_ms: int) -> int:
+        """Block (GIL released) until a ring stalls on full staging or
+        staging runs rich, or the timeout passes. Returns the number of
+        stalled rings."""
+        return _lib.vrm_wait(self._rings, max_wait_ms)
+
+    def rings_pending(self) -> int:
+        """Staged rows across all rings (racy snapshot, idle heuristic)."""
+        return _lib.vrm_pending(self._rings)
+
+    def rings_emit(self, ring: int, flat: "np.ndarray",
+                   lane_offs: "np.ndarray",
+                   prev_counts: "np.ndarray") -> tuple:
+        """emit_packed for ring i's staging into its packed arena row
+        (same layout/sentinel contract as emit_packed; `flat` is the
+        ring's row view of the (rings, words) arena)."""
+        counts = (ctypes.c_uint32 * 4)()
+        _lib.vrm_emit(
+            self._rings, ring,
+            flat.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            lane_offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            prev_counts.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            counts)
+        return tuple(counts)
+
+    def rings_emit_sharded(self, ring: int, batcher_arrays,
+                           bounds: "np.ndarray") -> tuple:
+        """emit_sharded for ring i's staging: rows grouped by owner shard
+        with shard-local slots and per-kind shard bounds — the sharded
+        backend's per-ring drain."""
+        counts = (ctypes.c_uint32 * 4)()
+        ptrs = [a.ctypes.data_as(ctypes.c_void_p) for a in batcher_arrays]
+        _lib.vrm_emit_sharded(
+            self._rings, ring, *ptrs,
+            bounds.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), counts)
+        return tuple(counts)
+
+    def rings_pause(self) -> None:
+        """Swap-boundary quiesce: no ring worker parses again until
+        rings_resume(). Emit every ring, then reset(), inside this."""
+        _lib.vrm_pause(self._rings)
+
+    def rings_resume(self) -> None:
+        _lib.vrm_resume(self._rings)
+
+    def ring_counters_one(self, ring: int) -> dict:
+        """Per-ring reader counters (reader_counters layout)."""
+        out = (ctypes.c_uint64 * 4)()
+        _lib.vrm_counters(self._rings, ring, out)
+        return {"datagrams": out[0], "ring_dropped": out[1],
+                "ring_depth": out[2], "toolong": out[3]}
+
+    def ring_stats_one(self, ring: int) -> dict:
+        """Per-ring deep telemetry (ring_stats layout)."""
+        out = (ctypes.c_uint64 * 8)()
+        _lib.vrm_ring_stats(self._rings, ring, out)
+        return {"ring_depth": out[0], "ring_highwater": out[1],
+                "pump_batches": out[2], "pump_stalls": out[3],
+                "emit_packed_calls": out[4], "emit_packed_ns": out[5],
+                "datagrams": out[6], "ring_dropped": out[7]}
+
+    def ring_stats_per_ring(self) -> List[dict]:
+        """ring_stats_one for every ring (empty when not multi-ring)."""
+        if not getattr(self, "_rings", None):
+            return []
+        out = []
+        for i in range(self._n_rings):
+            out.append(self.ring_stats_one(i))
+        return out
+
+    def ring_admission_drain_one(self, ring: int) -> dict:
+        """Drain-and-reset ring i's exact per-class admission deltas
+        (admission_drain layout). Callers must fold across ALL rings —
+        use admission_drain() for the exact host-wide sum."""
+        out = (ctypes.c_uint64 * 6)()
+        _lib.vrm_admission_counters(self._rings, ring, out)
+        names = ("self", "high", "low")
+        return {
+            "admitted": {names[i]: out[i] for i in range(3) if out[i]},
+            "shed": {names[i]: out[3 + i] for i in range(3) if out[3 + i]},
+        }

@@ -30,12 +30,16 @@
 #include <cstdlib>
 #include <cstring>
 #include <deque>
+#include <memory>
 #include <mutex>
+#include <shared_mutex>
 #include <string>
 #include <thread>
 #include <unordered_map>
 #include <vector>
 
+#include <pthread.h>
+#include <sched.h>
 #include <sys/socket.h>
 #include <sys/types.h>
 #include <unistd.h>
@@ -184,6 +188,19 @@ struct Parser {
   KindTable counters, gauges, sets, histos;
   int hll_precision = 14;
 
+  // Multi-ring sharing: ring parsers keep their own staging lanes and
+  // scratch but route every key-table/new-key/special access to the
+  // master parser so all rings share ONE slot space. Steady-state lookups
+  // are served from a ring-local replica cache with no lock at all; the
+  // shared table is touched only on cache miss (shared lock) and on
+  // first-allocation (unique lock, once per key per flush interval).
+  Parser* master = nullptr;
+  std::shared_mutex key_mu;                          // tables + new_keys
+  std::mutex specials_mu;                            // specials deque
+  std::unordered_map<std::string, int32_t> local_cache;
+
+  Parser& rt() { return master ? *master : *this; }
+
   // staging (fixed batch capacities; slot sentinel fill done by Python)
   uint32_t bc, bg, bs, bh;
   std::vector<int32_t> c_slot;  std::vector<float> c_inc;
@@ -201,8 +218,10 @@ struct Parser {
   std::vector<ImportStat> import_stats;
   bool alloc_imported = false;
 
-  uint64_t processed = 0;
-  uint64_t parse_errors = 0;
+  // atomics: ring workers bump these off-GIL while vt_stats/vrm_stats
+  // snapshot from the pipeline thread
+  std::atomic<uint64_t> processed{0};
+  std::atomic<uint64_t> parse_errors{0};
 
   // emit_packed timing: atomics because the poll thread snapshots
   // (vr_stats) while the pipeline thread emits; relaxed is enough for a
@@ -213,6 +232,8 @@ struct Parser {
   // scratch
   std::vector<std::pair<const char*, size_t>> tag_views;
   std::string keybuf, joined;
+  // shard counting-sort scratch (vt_emit_sharded); grown once, reused
+  std::vector<uint32_t> ss_cnt, ss_pos, ss_order;
 
   void init(uint32_t cc, uint32_t gc, uint32_t sc, uint32_t hc,
             uint32_t shards, int precision, uint32_t bc_, uint32_t bg_,
@@ -233,6 +254,7 @@ struct Parser {
     return nc >= bc || ng >= bg || ns >= bs || nh >= bh;
   }
 
+  // `t` must be a table of rt() — callers route through rt().counters etc.
   int32_t slot_for(KindTable& t, uint8_t kind, uint8_t scope,
                    const char* name, size_t name_len, uint32_t digest) {
     // key = kind byte + name + '\x1f' + joined tags (joined is in `joined`)
@@ -241,20 +263,42 @@ struct Parser {
     keybuf.append(name, name_len);
     keybuf.push_back('\x1f');
     keybuf.append(joined);
-    auto it = t.by_key.find(keybuf);
-    if (it != t.by_key.end()) return it->second;
-    uint32_t shard = digest % t.n_shards;
-    uint32_t nxt = t.next_free[shard];
-    if (nxt >= t.per_shard) {
-      t.dropped++;
-      return -1;
+    if (master) {
+      // lock-free hot path: the ring-local replica (slots are stable
+      // within a flush interval; vrm_reset clears these under quiesce)
+      auto cit = local_cache.find(keybuf);
+      if (cit != local_cache.end()) return cit->second;
     }
-    t.next_free[shard] = nxt + 1;
-    int32_t slot = (int32_t)(shard * t.per_shard + nxt);
-    t.by_key.emplace(keybuf, slot);
-    new_keys.push_back(NewKey{kind, slot, scope,
-                              (uint8_t)(alloc_imported ? 1 : 0),
-                              std::string(name, name_len), joined});
+    Parser& m = rt();
+    {
+      std::shared_lock<std::shared_mutex> lk(m.key_mu);
+      auto it = t.by_key.find(keybuf);
+      if (it != t.by_key.end()) {
+        int32_t slot = it->second;
+        lk.unlock();
+        if (master) local_cache.emplace(keybuf, slot);
+        return slot;
+      }
+    }
+    std::unique_lock<std::shared_mutex> lk(m.key_mu);
+    auto it = t.by_key.find(keybuf);
+    if (it == t.by_key.end()) {
+      uint32_t shard = digest % t.n_shards;
+      uint32_t nxt = t.next_free[shard];
+      if (nxt >= t.per_shard) {
+        t.dropped++;
+        return -1;
+      }
+      t.next_free[shard] = nxt + 1;
+      int32_t slot = (int32_t)(shard * t.per_shard + nxt);
+      it = t.by_key.emplace(keybuf, slot).first;
+      m.new_keys.push_back(NewKey{kind, slot, scope,
+                                  (uint8_t)(alloc_imported ? 1 : 0),
+                                  std::string(name, name_len), joined});
+    }
+    int32_t slot = it->second;
+    lk.unlock();
+    if (master) local_cache.emplace(keybuf, slot);
     return slot;
   }
 
@@ -282,7 +326,9 @@ struct Parser {
     if (len >= 3 && line[0] == '_' &&
         ((line[1] == 'e' && line[2] == '{') ||
          (line[1] == 's' && line[2] == 'c'))) {
-      specials.emplace_back(line, len);
+      Parser& m = rt();
+      std::lock_guard<std::mutex> lk(m.specials_mu);
+      m.specials.emplace_back(line, len);
       return 2;
     }
     // split into pipe chunks
@@ -401,7 +447,7 @@ struct Parser {
 
     switch (kind) {
       case K_COUNTER: {
-        int32_t slot = slot_for(counters, kind, scope, name, name_len, h);
+        int32_t slot = slot_for(rt().counters, kind, scope, name, name_len, h);
         if (slot < 0) return 0;
         c_slot[nc] = slot;
         c_inc[nc] = (float)(value_f * (1.0 / rate));
@@ -409,7 +455,7 @@ struct Parser {
         break;
       }
       case K_GAUGE: {
-        int32_t slot = slot_for(gauges, kind, scope, name, name_len, h);
+        int32_t slot = slot_for(rt().gauges, kind, scope, name, name_len, h);
         if (slot < 0) return 0;
         g_slot[ng] = slot;
         g_val[ng] = (float)value_f;
@@ -417,7 +463,7 @@ struct Parser {
         break;
       }
       case K_SET: {
-        int32_t slot = slot_for(sets, kind, scope, name, name_len, h);
+        int32_t slot = slot_for(rt().sets, kind, scope, name, name_len, h);
         if (slot < 0) return 0;
         uint64_t mh = metro64(value, value_len);
         uint32_t reg = (uint32_t)(mh >> (64 - hll_precision));
@@ -437,7 +483,7 @@ struct Parser {
       }
       case K_HISTO:
       case K_TIMER: {
-        int32_t slot = slot_for(histos, kind, scope, name, name_len, h);
+        int32_t slot = slot_for(rt().histos, kind, scope, name, name_len, h);
         if (slot < 0) return 0;
         h_slot[nh] = slot;
         h_val[nh] = (float)value_f;
@@ -581,12 +627,94 @@ int vt_pending(void* hp) {
   return (int)(p->nc + p->ng + p->ns + p->nh);
 }
 
+namespace {
+
+// Stable counting sort of a staged slot lane by owner shard. Slots already
+// encode the route: slot = shard*per_shard + local with shard =
+// route_digest % n_shards (KindTable alloc), so grouping by slot/per_shard
+// IS grouping by route_digest — no rehash. Stability preserves arrival
+// order within each shard (gauge last-write-wins exactness). `bnd` gets
+// n_shards+1 prefix bounds; `order` maps output row -> staged row.
+void shard_order(Parser* p, const std::vector<int32_t>& sv, uint32_t n,
+                 uint32_t per_shard, uint32_t n_shards, int32_t* bnd) {
+  uint32_t ps = per_shard ? per_shard : 1;
+  p->ss_cnt.assign(n_shards + 1, 0);
+  for (uint32_t i = 0; i < n; i++) p->ss_cnt[(uint32_t)sv[i] / ps + 1]++;
+  for (uint32_t s = 0; s < n_shards; s++) p->ss_cnt[s + 1] += p->ss_cnt[s];
+  for (uint32_t s = 0; s <= n_shards; s++) bnd[s] = (int32_t)p->ss_cnt[s];
+  p->ss_pos.assign(p->ss_cnt.begin(), p->ss_cnt.end());
+  if (p->ss_order.size() < n) p->ss_order.resize(n);
+  for (uint32_t i = 0; i < n; i++)
+    p->ss_order[p->ss_pos[(uint32_t)sv[i] / ps]++] = i;
+}
+
+}  // namespace
+
+// Pre-sharded emit: like vt_emit but rows arrive grouped by owner shard
+// with slots rebased shard-local, plus a per-kind bounds table
+// (int32[4*(n_shards+1)], kinds in counter/gauge/set/histo order) so the
+// sharded aggregator feeds per-shard batchers with contiguous slices —
+// no argsort, no slot subtraction, and the collective all_to_all shuffle
+// sees rows already in owner order. counts_out like vt_emit; staging is
+// reset.
+void vt_emit_sharded(void* hp, int32_t* c_slot, float* c_inc,
+                     int32_t* g_slot, float* g_val, int32_t* s_slot,
+                     int32_t* s_reg, uint8_t* s_rho, int32_t* h_slot,
+                     float* h_val, float* h_wt, int32_t* bounds,
+                     uint32_t* counts_out) {
+  auto* p = (Parser*)hp;
+  const uint32_t S = p->counters.n_shards;  // all tables share n_shards
+  uint32_t ps;
+
+  ps = p->counters.per_shard ? p->counters.per_shard : 1;
+  shard_order(p, p->c_slot, p->nc, ps, S, bounds);
+  for (uint32_t k = 0; k < p->nc; k++) {
+    uint32_t j = p->ss_order[k];
+    int32_t sl = p->c_slot[j];
+    c_slot[k] = sl - (int32_t)((uint32_t)sl / ps * ps);
+    c_inc[k] = p->c_inc[j];
+  }
+  ps = p->gauges.per_shard ? p->gauges.per_shard : 1;
+  shard_order(p, p->g_slot, p->ng, ps, S, bounds + (S + 1));
+  for (uint32_t k = 0; k < p->ng; k++) {
+    uint32_t j = p->ss_order[k];
+    int32_t sl = p->g_slot[j];
+    g_slot[k] = sl - (int32_t)((uint32_t)sl / ps * ps);
+    g_val[k] = p->g_val[j];
+  }
+  ps = p->sets.per_shard ? p->sets.per_shard : 1;
+  shard_order(p, p->s_slot, p->ns, ps, S, bounds + 2 * (S + 1));
+  for (uint32_t k = 0; k < p->ns; k++) {
+    uint32_t j = p->ss_order[k];
+    int32_t sl = p->s_slot[j];
+    s_slot[k] = sl - (int32_t)((uint32_t)sl / ps * ps);
+    s_reg[k] = p->s_reg[j];
+    s_rho[k] = p->s_rho[j];
+  }
+  ps = p->histos.per_shard ? p->histos.per_shard : 1;
+  shard_order(p, p->h_slot, p->nh, ps, S, bounds + 3 * (S + 1));
+  for (uint32_t k = 0; k < p->nh; k++) {
+    uint32_t j = p->ss_order[k];
+    int32_t sl = p->h_slot[j];
+    h_slot[k] = sl - (int32_t)((uint32_t)sl / ps * ps);
+    h_val[k] = p->h_val[j];
+    h_wt[k] = p->h_wt[j];
+  }
+  counts_out[0] = p->nc;
+  counts_out[1] = p->ng;
+  counts_out[2] = p->ns;
+  counts_out[3] = p->nh;
+  p->nc = p->ng = p->ns = p->nh = 0;
+  p->emit_packed_calls.fetch_add(1, std::memory_order_relaxed);
+}
+
 // Drain new-key records into buf as
 // [u8 kind][i32 slot][u8 scope][u16 name_len][name][u16 tags_len][tags]*.
 // Returns bytes written, or -needed when cap is too small (nothing
 // consumed in that case).
 int vt_new_keys(void* hp, char* buf, int cap) {
   auto* p = (Parser*)hp;
+  std::unique_lock<std::shared_mutex> lk(p->key_mu);
   int need = 0;
   for (const auto& k : p->new_keys)
     need += 1 + 4 + 1 + 2 + (int)k.name.size() + 2 + (int)k.joined_tags.size();
@@ -613,6 +741,7 @@ int vt_new_keys(void* hp, char* buf, int cap) {
 // -needed if cap too small (line stays queued).
 int vt_next_special(void* hp, char* buf, int cap) {
   auto* p = (Parser*)hp;
+  std::lock_guard<std::mutex> slk(p->specials_mu);
   if (p->specials.empty()) return 0;
   const std::string& s = p->specials.front();
   if ((int)s.size() > cap) return -(int)s.size();
@@ -651,6 +780,7 @@ int32_t vt_slot_for(void* hp, int kind, int scope, const char* name,
 // Flush boundary: clear key maps (state is flush-scoped, worker.go:498).
 void vt_reset(void* hp) {
   auto* p = (Parser*)hp;
+  std::unique_lock<std::shared_mutex> lk(p->key_mu);
   p->counters.reset();
   p->gauges.reset();
   p->sets.reset();
@@ -669,10 +799,23 @@ void vt_hash64_batch(const char* buf, const int64_t* offsets, int n,
 
 void vt_stats(void* hp, uint64_t* out) {
   auto* p = (Parser*)hp;
-  out[0] = p->processed;
-  out[1] = p->parse_errors;
+  out[0] = p->processed.load(std::memory_order_relaxed);
+  out[1] = p->parse_errors.load(std::memory_order_relaxed);
+  std::shared_lock<std::shared_mutex> lk(p->key_mu);
   out[2] = p->counters.dropped + p->gauges.dropped + p->sets.dropped +
            p->histos.dropped;
+}
+
+// The routing digest the collective key table shards on
+// (collective/keytable.py route_digest): fnv1a-32 over name, then the
+// lowercase kind string, then the joined tags — exactly the running `h`
+// parse_line feeds slot_for, exported so a test can pin C++/Python
+// byte-parity over raw (surrogateescape) corpora.
+uint32_t vt_route_digest(const char* name, int name_len, const char* kind,
+                         int kind_len, const char* tags, int tags_len) {
+  uint32_t h = fnv32(name, (size_t)name_len, FNV32_OFFSET);
+  h = fnv32(kind, (size_t)kind_len, h);
+  return fnv32(tags, (size_t)tags_len, h);
 }
 
 }  // extern "C"
@@ -1151,6 +1294,27 @@ bool bucket_allow(Admission& a, int which,
 // CRITICAL(3) then runs the "statsd/high" bucket; low is shed outright
 // at SHEDDING(2)+ and bucketed at PRESSURED(1). Returns true to admit;
 // counts either way.
+// Apply pushed-down controller knobs to one Admission (caller holds the
+// owning mutex). Rate/burst changes re-prime the buckets on the next
+// decision.
+void apply_admission(Admission& a, int enabled, int state, double rate,
+                     double burst, const char* tags, int tags_len) {
+  if (a.rate != rate || a.burst != burst) a.primed = false;
+  a.enabled = enabled != 0;
+  a.state = state;
+  a.rate = rate;
+  a.burst = burst;
+  a.high_tags.clear();
+  const char* p = tags;
+  const char* end = tags + (tags_len > 0 ? tags_len : 0);
+  while (p && p < end) {
+    const char* nl = (const char*)memchr(p, '\n', (size_t)(end - p));
+    size_t n = nl ? (size_t)(nl - p) : (size_t)(end - p);
+    if (n) a.high_tags.emplace_back(p, n);
+    p += n + 1;
+  }
+}
+
 bool admit_datagram(Admission& a, const char* p, size_t n,
                     std::chrono::steady_clock::time_point now) {
   int cls = classify_datagram(a, p, n);
@@ -1316,21 +1480,7 @@ void vr_admission_set(void* gp, int enabled, int state, double rate,
                       double burst, const char* tags, int tags_len) {
   auto* g = (ReaderGroup*)gp;
   std::lock_guard<std::mutex> lk(g->mu);
-  Admission& a = g->adm;
-  if (a.rate != rate || a.burst != burst) a.primed = false;
-  a.enabled = enabled != 0;
-  a.state = state;
-  a.rate = rate;
-  a.burst = burst;
-  a.high_tags.clear();
-  const char* p = tags;
-  const char* end = tags + (tags_len > 0 ? tags_len : 0);
-  while (p && p < end) {
-    const char* nl = (const char*)memchr(p, '\n', (size_t)(end - p));
-    size_t n = nl ? (size_t)(nl - p) : (size_t)(end - p);
-    if (n) a.high_tags.emplace_back(p, n);
-    p += n + 1;
-  }
+  apply_admission(g->adm, enabled, state, rate, burst, tags, tags_len);
 }
 
 // Drain-and-reset the exact per-class admission deltas so the controller
@@ -1387,6 +1537,444 @@ void vr_stop(void* gp) {
     if (t.joinable()) t.join();
   for (int fd : g->owned_fds) close(fd);
   delete g;
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Multi-ring reader groups (vrm_*): one ring + parser + staging pair per
+// reader core. The single-ring design above parses on the pipeline thread
+// (vr_pump), which caps the host at one core of parse; here each ring owns
+// a reader thread (recvmmsg -> ring, optional) AND a worker thread (ring ->
+// parse -> staging), so N rings parse on N cores concurrently while the
+// pipeline thread only memcpys staged lanes into its packed arena rows and
+// steps the device. All rings share the master parser's key tables (see
+// Parser::slot_for: ring-local replica cache, shared lock on miss), so a
+// flow-hashed key landing on any ring maps to the same device slot.
+// Admission, toolong, and ring-cap accounting run per ring with the same
+// datagrams == toolong + admitted + shed invariant, summed by Python.
+
+namespace {
+
+struct MultiRing;
+
+struct Ring {
+  Parser parser;                 // staging + key cache; tables -> master
+  int fd = -1;                   // dup()ed socket; -1 = inject-only ring
+  int max_len = 65536;
+  int pin_core = -1;
+  std::thread reader;
+  std::thread worker;
+  std::mutex mu;                 // ring deque + counters + admission
+  std::condition_variable cv;        // ring became non-empty
+  std::condition_variable space_cv;  // staging emitted / resumed
+  std::deque<std::string> ring;
+  size_t ring_cap = 65536;
+  uint64_t datagrams = 0;        // guarded by mu
+  uint64_t toolong = 0;          // guarded by mu
+  uint64_t ring_dropped = 0;     // guarded by mu
+  uint64_t ring_highwater = 0;   // guarded by mu
+  uint64_t parse_batches = 0;    // guarded by mu; datagrams parsed
+  uint64_t stalls = 0;           // guarded by mu; staging filled mid-parse
+  Admission adm;                 // guarded by mu
+  std::atomic<bool> stalled{false};
+  std::mutex stage_mu;           // staging lanes: worker parse vs emit
+};
+
+struct MultiRing {
+  Parser* master = nullptr;
+  std::vector<std::unique_ptr<Ring>> rings;
+  std::atomic<bool> stop{false};
+  std::atomic<bool> pause{false};        // swap-boundary quiesce
+  std::mutex wait_mu;
+  std::condition_variable wait_cv;       // pipeline wakeup
+};
+
+void pin_self(int core) {
+  if (core < 0) return;
+  cpu_set_t set;
+  CPU_ZERO(&set);
+  CPU_SET(core, &set);
+  pthread_setaffinity_np(pthread_self(), sizeof(set), &set);
+}
+
+// Shared push for the socket reader and the inject path so bench traffic
+// hits the same invariant: every arriving datagram is counted exactly once
+// as toolong, admitted, or shed (ring-full drops are post-admission and
+// counted separately). Returns true when queued.
+bool ring_push(Ring* r, const char* data, size_t n, bool kernel_trunc) {
+  {
+    std::lock_guard<std::mutex> lk(r->mu);
+    r->datagrams++;
+    if (kernel_trunc || n >= (size_t)r->max_len) {
+      r->toolong++;
+      return false;
+    }
+    if (r->adm.enabled &&
+        !admit_datagram(r->adm, data, n, std::chrono::steady_clock::now()))
+      return false;
+    if (r->ring.size() >= r->ring_cap) {
+      r->ring_dropped++;
+      return false;
+    }
+    r->ring.emplace_back(data, n);
+    if ((uint64_t)r->ring.size() > r->ring_highwater)
+      r->ring_highwater = (uint64_t)r->ring.size();
+  }
+  r->cv.notify_one();
+  return true;
+}
+
+void vrm_reader_main(MultiRing* mr, Ring* r) {
+  pin_self(r->pin_core);
+  constexpr int VLEN = 64;
+  std::vector<std::vector<char>> bufs(VLEN, std::vector<char>(r->max_len));
+  mmsghdr msgs[VLEN];
+  iovec iovs[VLEN];
+  struct timeval tv;
+  tv.tv_sec = 0;
+  tv.tv_usec = 200 * 1000;
+  setsockopt(r->fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  while (!mr->stop.load(std::memory_order_relaxed)) {
+    for (int i = 0; i < VLEN; i++) {
+      iovs[i].iov_base = bufs[i].data();
+      iovs[i].iov_len = (size_t)r->max_len;
+      memset(&msgs[i], 0, sizeof(msgs[i]));
+      msgs[i].msg_hdr.msg_iov = &iovs[i];
+      msgs[i].msg_hdr.msg_iovlen = 1;
+    }
+    int n = recvmmsg(r->fd, msgs, VLEN, MSG_WAITFORONE, nullptr);
+    if (n <= 0) {
+      if (n < 0 && errno != EAGAIN && errno != EWOULDBLOCK &&
+          errno != EINTR)
+        std::this_thread::sleep_for(std::chrono::milliseconds(50));
+      continue;
+    }
+    for (int i = 0; i < n; i++)
+      ring_push(r, bufs[i].data(), (size_t)msgs[i].msg_len,
+                (msgs[i].msg_hdr.msg_flags & MSG_TRUNC) != 0);
+  }
+}
+
+// Per-ring parse loop: pop one datagram, parse it into this ring's staging
+// under stage_mu (held only for the parse itself). A full staging lane
+// parks the datagram with its resume offset and waits for the pipeline to
+// emit; the swap-boundary pause parks it the same way.
+void vrm_worker_main(MultiRing* mr, Ring* r) {
+  pin_self(r->pin_core);
+  std::string local;
+  size_t off = 0;
+  bool have = false;
+  while (!mr->stop.load(std::memory_order_relaxed)) {
+    if (!have) {
+      std::unique_lock<std::mutex> lk(r->mu);
+      if (r->ring.empty())
+        r->cv.wait_for(lk, std::chrono::milliseconds(100));
+      if (mr->stop.load(std::memory_order_relaxed)) break;
+      if (r->ring.empty() || mr->pause.load(std::memory_order_relaxed))
+        continue;
+      local = std::move(r->ring.front());
+      r->ring.pop_front();
+      r->parse_batches++;
+      off = 0;
+      have = true;
+    }
+    bool full = false;
+    bool parsed = false;
+    bool rich = false;
+    {
+      std::unique_lock<std::mutex> lk(r->stage_mu);
+      if (!mr->pause.load(std::memory_order_relaxed)) {
+        int consumed = 0;
+        full = vt_feed(&r->parser, local.data(), (int)local.size(),
+                       (int)off, &consumed) != 0;
+        off = (size_t)consumed;
+        if (!full) have = false;
+        parsed = true;
+        Parser& p = r->parser;
+        rich = p.nc * 2 >= p.bc || p.ng * 2 >= p.bg || p.ns * 2 >= p.bs ||
+               p.nh * 2 >= p.bh;
+      }
+    }
+    if (parsed && !full) {
+      // opportunistic wake when lanes run half full so emits don't wait
+      // for a hard stall (lost wakeups here only cost one wait timeout)
+      if (rich) mr->wait_cv.notify_all();
+      continue;
+    }
+    if (full) {
+      {
+        std::lock_guard<std::mutex> lk(r->mu);
+        r->stalls++;
+      }
+      r->stalled.store(true, std::memory_order_release);
+      // ordered notify: the pipeline checks stalled under wait_mu, so
+      // taking it here makes the stall wakeup lossless
+      { std::lock_guard<std::mutex> lk(mr->wait_mu); }
+      mr->wait_cv.notify_all();
+    }
+    // stalled (wait for an emit) or paused (wait for resume)
+    std::unique_lock<std::mutex> lk(r->mu);
+    r->space_cv.wait_for(lk, std::chrono::milliseconds(50), [&] {
+      return mr->stop.load(std::memory_order_relaxed) ||
+             (!mr->pause.load(std::memory_order_relaxed) &&
+              !r->stalled.load(std::memory_order_acquire));
+    });
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Start n_rings independent ingest lanes against the master parser.
+// fds[i] >= 0 attaches a dup()ed SO_REUSEPORT socket to ring i (fds may be
+// null / entries -1 for inject-only rings, e.g. benches). pin_cores[i] >= 0
+// pins ring i's reader+worker threads to that core (null = no pinning).
+void* vrm_start(void* parser, const int* fds, int n_rings, int max_len,
+                int ring_cap, const int* pin_cores) {
+  auto* mr = new MultiRing();
+  auto* m = (Parser*)parser;
+  mr->master = m;
+  for (int i = 0; i < n_rings; i++) {
+    auto r = std::make_unique<Ring>();
+    r->max_len = max_len > 0 ? max_len : 65536;
+    r->ring_cap = (size_t)(ring_cap > 0 ? ring_cap : 65536);
+    r->pin_core = pin_cores ? pin_cores[i] : -1;
+    r->parser.init(m->counters.capacity, m->gauges.capacity,
+                   m->sets.capacity, m->histos.capacity,
+                   m->counters.n_shards, m->hll_precision, m->bc, m->bg,
+                   m->bs, m->bh);
+    r->parser.master = m;
+    if (fds && fds[i] >= 0) {
+      int own = dup(fds[i]);
+      if (own >= 0) r->fd = own;
+    }
+    mr->rings.push_back(std::move(r));
+  }
+  for (auto& r : mr->rings) {
+    Ring* rp = r.get();
+    if (rp->fd >= 0) rp->reader = std::thread(vrm_reader_main, mr, rp);
+    rp->worker = std::thread(vrm_worker_main, mr, rp);
+  }
+  return mr;
+}
+
+int vrm_n_rings(void* h) { return (int)((MultiRing*)h)->rings.size(); }
+
+// Queue one datagram onto ring i through the same toolong/admission/
+// ring-cap accounting as the socket path (benches and tests use this for
+// deterministic ring placement — SO_REUSEPORT flow hashing is opaque).
+// Returns 1 when queued, 0 when counted-and-dropped.
+int vrm_inject(void* h, int ring, const char* data, int len) {
+  auto* mr = (MultiRing*)h;
+  return ring_push(mr->rings[ring].get(), data, (size_t)len, false) ? 1 : 0;
+}
+
+// Block the pipeline thread until a ring stalls on full staging (or the
+// opportunistic half-full wake fires, or max_wait_ms passes). Returns the
+// number of currently-stalled rings.
+int vrm_wait(void* h, int max_wait_ms) {
+  auto* mr = (MultiRing*)h;
+  auto pred = [&] {
+    if (mr->stop.load(std::memory_order_relaxed)) return true;
+    for (auto& r : mr->rings) {
+      if (r->stalled.load(std::memory_order_acquire)) return true;
+      Parser& p = r->parser;
+      if (p.nc * 2 >= p.bc || p.ng * 2 >= p.bg || p.ns * 2 >= p.bs ||
+          p.nh * 2 >= p.bh)
+        return true;
+    }
+    return false;
+  };
+  {
+    std::unique_lock<std::mutex> lk(mr->wait_mu);
+    if (max_wait_ms > 0 && !pred())
+      mr->wait_cv.wait_for(lk, std::chrono::milliseconds(max_wait_ms),
+                           pred);
+  }
+  int n = 0;
+  for (auto& r : mr->rings)
+    if (r->stalled.load(std::memory_order_acquire)) n++;
+  return n;
+}
+
+// Staged rows across all rings (racy snapshot; idle heuristic only).
+int vrm_pending(void* h) {
+  auto* mr = (MultiRing*)h;
+  uint64_t n = 0;
+  for (auto& r : mr->rings) {
+    Parser& p = r->parser;
+    n += p.nc + p.ng + p.ns + p.nh;
+  }
+  return (int)n;
+}
+
+// Emit ring i's staged lanes into its packed arena row (same layout/
+// sentinel contract as vt_emit_packed). stage_mu holds off the worker's
+// parse for the copy; clearing the stall under the ring mutex makes the
+// worker's resume wakeup lossless.
+void vrm_emit(void* h, int ring, int32_t* buf, const int32_t* off,
+              uint32_t* prev, uint32_t* counts_out) {
+  auto* mr = (MultiRing*)h;
+  Ring* r = mr->rings[ring].get();
+  {
+    std::lock_guard<std::mutex> lk(r->stage_mu);
+    vt_emit_packed(&r->parser, buf, off, prev, counts_out);
+  }
+  {
+    std::lock_guard<std::mutex> lk(r->mu);
+    r->stalled.store(false, std::memory_order_release);
+  }
+  r->space_cv.notify_all();
+}
+
+// Pre-sharded emit of ring i's staging (vt_emit_sharded semantics: rows
+// grouped by owner shard, slots rebased shard-local, per-kind shard
+// bounds). Same locking/stall discipline as vrm_emit — this is the
+// sharded backend's per-ring drain.
+void vrm_emit_sharded(void* h, int ring, int32_t* c_slot, float* c_inc,
+                      int32_t* g_slot, float* g_val, int32_t* s_slot,
+                      int32_t* s_reg, uint8_t* s_rho, int32_t* h_slot,
+                      float* h_val, float* h_wt, int32_t* bounds,
+                      uint32_t* counts_out) {
+  auto* mr = (MultiRing*)h;
+  Ring* r = mr->rings[ring].get();
+  {
+    std::lock_guard<std::mutex> lk(r->stage_mu);
+    vt_emit_sharded(&r->parser, c_slot, c_inc, g_slot, g_val, s_slot,
+                    s_reg, s_rho, h_slot, h_val, h_wt, bounds, counts_out);
+  }
+  {
+    std::lock_guard<std::mutex> lk(r->mu);
+    r->stalled.store(false, std::memory_order_release);
+  }
+  r->space_cv.notify_all();
+}
+
+// Swap-boundary quiesce: after vrm_pause returns no worker is inside a
+// parse and none will enter one until vrm_resume, so the caller can emit
+// every ring and reset the shared tables without racing staged rows
+// against a cleared key space.
+void vrm_pause(void* h) {
+  auto* mr = (MultiRing*)h;
+  mr->pause.store(true, std::memory_order_release);
+  for (auto& r : mr->rings) {
+    // barrier: any in-flight parse (which checks pause under stage_mu)
+    // completes before we proceed
+    std::lock_guard<std::mutex> lk(r->stage_mu);
+  }
+}
+
+void vrm_resume(void* h) {
+  auto* mr = (MultiRing*)h;
+  mr->pause.store(false, std::memory_order_release);
+  for (auto& r : mr->rings) {
+    { std::lock_guard<std::mutex> lk(r->mu); }
+    r->space_cv.notify_all();
+    r->cv.notify_all();
+  }
+}
+
+// Flush boundary: reset the master tables and every ring's key-replica
+// cache. Caller must hold the quiesce (vrm_pause) and have emitted all
+// rings first.
+void vrm_reset(void* h) {
+  auto* mr = (MultiRing*)h;
+  vt_reset(mr->master);
+  for (auto& r : mr->rings) r->parser.local_cache.clear();
+}
+
+// Per-ring counter snapshot: [0]=datagrams, [1]=ring_dropped,
+// [2]=ring depth, [3]=toolong (vr_counters layout).
+void vrm_counters(void* h, int ring, uint64_t* out) {
+  auto* mr = (MultiRing*)h;
+  Ring* r = mr->rings[ring].get();
+  std::lock_guard<std::mutex> lk(r->mu);
+  out[0] = r->datagrams;
+  out[1] = r->ring_dropped;
+  out[2] = (uint64_t)r->ring.size();
+  out[3] = r->toolong;
+}
+
+// Per-ring deep telemetry (vr_stats layout): [0]=ring depth, [1]=depth
+// high-water, [2]=parse batches (datagrams parsed), [3]=staging stalls,
+// [4]=emit calls, [5]=emit ns, [6]=datagrams received, [7]=ring_dropped.
+void vrm_ring_stats(void* h, int ring, uint64_t* out) {
+  auto* mr = (MultiRing*)h;
+  Ring* r = mr->rings[ring].get();
+  {
+    std::lock_guard<std::mutex> lk(r->mu);
+    out[0] = (uint64_t)r->ring.size();
+    out[1] = r->ring_highwater;
+    out[2] = r->parse_batches;
+    out[3] = r->stalls;
+    out[6] = r->datagrams;
+    out[7] = r->ring_dropped;
+  }
+  out[4] = r->parser.emit_packed_calls.load(std::memory_order_relaxed);
+  out[5] = r->parser.emit_packed_ns.load(std::memory_order_relaxed);
+}
+
+// Push controller admission knobs to every ring. The aggregate token rate
+// and burst split evenly across rings so the host-level admit rate matches
+// the single-ring contract while each ring buckets independently off-GIL.
+void vrm_admission_set(void* h, int enabled, int state, double rate,
+                       double burst, const char* tags, int tags_len) {
+  auto* mr = (MultiRing*)h;
+  double n = (double)mr->rings.size();
+  double rr = rate > 0.0 ? rate / n : rate;
+  double bb = burst > 0.0 ? burst / n : burst;
+  for (auto& r : mr->rings) {
+    std::lock_guard<std::mutex> lk(r->mu);
+    apply_admission(r->adm, enabled, state, rr, bb, tags, tags_len);
+  }
+}
+
+// Drain-and-reset ring i's exact per-class admission deltas
+// (vr_admission_counters layout). Callers must fold across ALL rings.
+void vrm_admission_counters(void* h, int ring, uint64_t* out) {
+  auto* mr = (MultiRing*)h;
+  Ring* r = mr->rings[ring].get();
+  std::lock_guard<std::mutex> lk(r->mu);
+  for (int i = 0; i < 3; i++) {
+    out[i] = r->adm.admitted[i];
+    out[3 + i] = r->adm.shed[i];
+    r->adm.admitted[i] = 0;
+    r->adm.shed[i] = 0;
+  }
+}
+
+// Engine-wide parse stats summed over ring parsers + master (vt_stats
+// layout: processed, parse_errors, table drops).
+void vrm_stats(void* h, uint64_t* out) {
+  auto* mr = (MultiRing*)h;
+  uint64_t pr = 0, pe = 0;
+  for (auto& r : mr->rings) {
+    pr += r->parser.processed.load(std::memory_order_relaxed);
+    pe += r->parser.parse_errors.load(std::memory_order_relaxed);
+  }
+  vt_stats(mr->master, out);
+  out[0] += pr;
+  out[1] += pe;
+}
+
+void vrm_stop(void* h) {
+  auto* mr = (MultiRing*)h;
+  mr->stop.store(true);
+  for (auto& r : mr->rings) {
+    { std::lock_guard<std::mutex> lk(r->mu); }
+    r->cv.notify_all();
+    r->space_cv.notify_all();
+  }
+  { std::lock_guard<std::mutex> lk(mr->wait_mu); }
+  mr->wait_cv.notify_all();
+  for (auto& r : mr->rings) {
+    if (r->reader.joinable()) r->reader.join();
+    if (r->worker.joinable()) r->worker.join();
+    if (r->fd >= 0) close(r->fd);
+  }
+  delete mr;
 }
 
 }  // extern "C"

@@ -145,6 +145,26 @@ class NativeAggregator(Aggregator):
         self.table = NativeKeyTable(spec, self.eng, n_shards)
         self._alloc_packed_buffers()
 
+    def _alloc_ring_arenas(self, n_rings: int):
+        """Per-ring staging plan: two (rings, words) i32 arenas — one row
+        per ring in the exact packed layout — double-buffered like the
+        single-ring pair. Every ring's emit lands in its own row and the
+        WHOLE arena crosses host->device as one donated transfer per step
+        (ingest_step_packed_rings), so R rings cost one h2d RTT, not R.
+        Row sentinels and per-row prev counts follow the vt_emit_packed
+        incremental-restore contract per ring."""
+        from veneur_tpu.aggregation.step import packed_layout
+        layout, words = packed_layout(self._pk_sizes)
+        self._rg_bufs = []
+        self._rg_prev = []
+        for _ in range(2):
+            arena = np.zeros((n_rings, words), np.int32)
+            for r in range(n_rings):
+                self._init_packed_sentinels(arena[r], layout, self.spec)
+            self._rg_bufs.append(arena)
+            self._rg_prev.append(np.zeros((n_rings, 4), np.uint32))
+        self._rg_idx = 0
+
     def _alloc_packed_buffers(self):
         """Two flat i32 host buffers in the exact pack_batch device layout,
         plus the lane word-offsets vt_emit_packed writes at. The native
@@ -304,16 +324,81 @@ class NativeAggregator(Aggregator):
 
     # -- native UDP reader group ---------------------------------------------
     def readers_start(self, fds, max_len: int = 65536,
-                      ring_cap: int = 65536) -> None:
-        self.eng.readers_start(fds, max_len=max_len, ring_cap=ring_cap)
+                      ring_cap: int = 65536, n_rings: int = 1,
+                      pin_cores=None) -> None:
+        """Start the native readers. n_rings == 1 keeps the proven
+        single-ring vr_* engine (N reader threads -> one ring -> this
+        thread's pump); n_rings > 1 starts the multi-ring vrm_* engine:
+        one ring + parser + packed arena row per reader core, fds
+        distributed round-robin across rings (each SO_REUSEPORT fd owns
+        its ring), optional sched_affinity pinning per ring."""
+        if n_rings <= 1:
+            self.eng.readers_start(fds, max_len=max_len, ring_cap=ring_cap)
+            return
+        # every fd must own a ring (vrm readers are 1:1 with rings) — a
+        # multi-address bind with more sockets than configured rings
+        # grows the ring count rather than orphaning listeners
+        n_rings = max(n_rings, len(fds) if fds else 0)
+        self.rings_start(n_rings, fds=fds, max_len=max_len,
+                         ring_cap=ring_cap, pin_cores=pin_cores)
+
+    def rings_start(self, n_rings: int, fds=None, max_len: int = 65536,
+                    ring_cap: int = 65536, pin_cores=None) -> None:
+        """Multi-ring engine start (fd-less rings accept rings_inject only
+        — bench/test entry). Allocates the per-ring arena pair."""
+        self.eng.rings_start(n_rings, fds=fds, max_len=max_len,
+                             ring_cap=ring_cap, pin_cores=pin_cores)
+        self._alloc_ring_arenas(n_rings)
+
+    def _emit_rings(self) -> bool:
+        """Drain every ring's staging into the current arena's rows and
+        run ONE device step over the whole arena. Returns False (no step)
+        when all rings were empty — the common idle poll. The compact
+        control word rides row 0 only."""
+        import time
+
+        from veneur_tpu.aggregation.step import ingest_step_packed_rings
+        from veneur_tpu.observability import jaxruntime
+        from veneur_tpu.server.aggregator import _SYNC_EVERY
+        idx = self._rg_idx
+        arena = self._rg_bufs[idx]
+        prev = self._rg_prev[idx]
+        total = 0
+        for r in range(self.eng.n_rings):
+            counts = self.eng.rings_emit(r, arena[r], self._pk_offs,
+                                         prev[r])
+            total += counts[0] + counts[1] + counts[2] + counts[3]
+        if total == 0:
+            return False
+        self._rg_idx = 1 - idx
+        self._steps += 1
+        self.steps_total += 1
+        arena[0, 0] = 1 if self._steps % self.compact_every == 0 else 0
+        self.h2d_bytes += arena.nbytes
+        t0 = time.perf_counter_ns()
+        self.state = ingest_step_packed_rings(
+            self.state, arena, spec=self.spec, sizes=self._pk_sizes)
+        dispatch_dt = time.perf_counter_ns() - t0
+        self.dispatch_ns += dispatch_dt
+        if self.steps_total % _SYNC_EVERY == 0:
+            self.step_ns += dispatch_dt + jaxruntime.sync_and_time(
+                self.state)
+            self.steps_synced += 1
+        return True
 
     def pump(self, max_wait_ms: int, max_emits: int = 8) -> List[bytes]:
-        """Drain the C++ datagram ring into staging (GIL released while
+        """Drain the C++ datagram ring(s) into staging (GIL released while
         idle), emitting device batches whenever a lane fills. Bounded:
         under sustained overload an unbounded drain would never return to
         the pipeline dispatch loop and flush requests (which ride
         packet_queue) would starve — exactly when operators most need the
         flush. Returns escalated event/service-check lines."""
+        if self.eng.n_rings:
+            self.eng.rings_wait(max_wait_ms)
+            for _ in range(max_emits):
+                if not self._emit_rings():
+                    break
+            return self.eng.drain_specials()
         full, st = self.eng.pump(max_wait_ms)
         for _ in range(max_emits):
             if not full:
@@ -330,8 +415,15 @@ class NativeAggregator(Aggregator):
 
     def ring_stats(self) -> dict:
         """Deep ring/emit telemetry (vr_stats): depth, high-water, pump
-        batches/stalls, emit_packed call/ns totals. Any thread."""
+        batches/stalls, emit_packed call/ns totals. Any thread. In
+        multi-ring mode this is the EXACT cross-ring aggregate (sums;
+        high-water is the per-ring max)."""
         return self.eng.ring_stats()
+
+    def ring_stats_per_ring(self) -> List[dict]:
+        """Per-ring telemetry rows ([] outside multi-ring mode) — the
+        `ring=<i>`-labeled collector family reads these."""
+        return self.eng.ring_stats_per_ring()
 
     def admission_set(self, enabled: bool, state: int, rate: float,
                       burst: float, high_tags) -> None:
@@ -372,6 +464,15 @@ class NativeAggregator(Aggregator):
 
     # -- flush ---------------------------------------------------------------
     def swap(self):
+        rings = bool(self.eng.n_rings)
+        if rings:
+            # quiesce: no ring worker parses between here and resume, so
+            # staged rows can't race the table reset below. Datagrams
+            # queued (or parked mid-parse on a lane stop) during the pause
+            # are parsed after resume and land in the NEXT interval —
+            # the same boundary semantics as the single-ring pump queue.
+            self.eng.rings_pause()
+            self._emit_rings()
         self._emit_native()
         detached = self.table
         detached.finalize()
@@ -380,6 +481,8 @@ class NativeAggregator(Aggregator):
         # native engine keeps the slot space, so re-wrap it post-reset
         self.eng.reset()
         self.table = NativeKeyTable(self.spec, self.eng, self.n_shards)
+        if rings:
+            self.eng.rings_resume()
         return state, detached
 
 
@@ -394,12 +497,15 @@ class NativeShardedAggregator(ShardedAggregator):
     each other."""
 
     def __init__(self, spec: TableSpec, bspec: BatchSpec = BatchSpec(),
-                 n_shards: int = 2, compact_every: int = 8):
+                 n_shards: int = 2, compact_every: int = 8,
+                 preshard: bool = False):
         super().__init__(spec, bspec, n_shards, compact_every)
         self.eng = NativeIngest(spec, bspec, n_shards)
         self.table = NativeKeyTable(spec, self.eng, n_shards)
         self._py_processed = 0
         self._py_dropped = 0
+        self.preshard = preshard
+        self._ps_bounds = np.zeros(4 * (n_shards + 1), np.int32)
         self._alloc_emit_buffers()
 
     def _alloc_emit_buffers(self):
@@ -457,7 +563,60 @@ class NativeShardedAggregator(ShardedAggregator):
                                  sorter=order)
         return order, lo[order], bounds
 
+    def _native_lanes(self):
+        return (self._c_slot, self._c_inc, self._g_slot, self._g_val,
+                self._s_slot, self._s_reg, self._s_rho, self._h_slot,
+                self._h_val, self._h_wt)
+
+    def _stage_presharded(self, nc, ng, ns, nh):
+        """Bulk-copy a pre-sharded emit (vt_emit_sharded contract: rows
+        grouped by owner shard, slots already shard-local, per-kind shard
+        bounds in self._ps_bounds) into the per-shard batchers. Contiguous
+        slices only — the argsort/searchsorted of _split_shards and the
+        local-slot subtraction both happened in C++ during the one pass
+        the emit copy already makes."""
+        b = self._ps_bounds
+        S = self.n_shards
+        if nc:
+            at = b[0:S + 1]
+            for i in range(S):
+                if at[i + 1] > at[i]:
+                    self.batchers[i].add_counters_bulk(
+                        self._c_slot[at[i]:at[i + 1]],
+                        self._c_inc[at[i]:at[i + 1]])
+        if ng:
+            at = b[S + 1:2 * (S + 1)]
+            for i in range(S):
+                if at[i + 1] > at[i]:
+                    self.batchers[i].add_gauges_bulk(
+                        self._g_slot[at[i]:at[i + 1]],
+                        self._g_val[at[i]:at[i + 1]])
+        if ns:
+            at = b[2 * (S + 1):3 * (S + 1)]
+            for i in range(S):
+                if at[i + 1] > at[i]:
+                    self.batchers[i].add_sets_bulk(
+                        self._s_slot[at[i]:at[i + 1]],
+                        self._s_reg[at[i]:at[i + 1]],
+                        self._s_rho[at[i]:at[i + 1]])
+        if nh:
+            at = b[3 * (S + 1):4 * (S + 1)]
+            for i in range(S):
+                if at[i + 1] > at[i]:
+                    self.batchers[i].add_histos_bulk(
+                        self._h_slot[at[i]:at[i + 1]],
+                        self._h_val[at[i]:at[i + 1]],
+                        self._h_wt[at[i]:at[i + 1]])
+
+    def _emit_presharded(self):
+        nc, ng, ns, nh = self.eng.emit_sharded(self._native_lanes(),
+                                               self._ps_bounds)
+        if nc + ng + ns + nh:
+            self._stage_presharded(nc, ng, ns, nh)
+
     def _emit_native(self):
+        if self.preshard:
+            return self._emit_presharded()
         nc, ng, ns, nh = self.eng.emit_into(
             (self._c_slot, self._c_inc, self._g_slot, self._g_val,
              self._s_slot, self._s_reg, self._s_rho, self._h_slot,
@@ -502,11 +661,64 @@ class NativeShardedAggregator(ShardedAggregator):
                         lo[at[i]:at[i + 1]], val[at[i]:at[i + 1]],
                         wt[at[i]:at[i + 1]])
 
+    # -- multi-ring reader group (sharded) -----------------------------------
+    # Ring staging drains through the pre-sharded emit ONLY (vrm exposes
+    # the packed and pre-sharded drains per ring; flush output is
+    # byte-identical to the _split_shards path either way — pinned by
+    # tests/test_native_preshard.py).
+    readers_start = NativeAggregator.readers_start
+    admission_set = NativeAggregator.admission_set
+    admission_drain = NativeAggregator.admission_drain
+    reader_counters = NativeAggregator.reader_counters
+    ring_stats = NativeAggregator.ring_stats
+    ring_stats_per_ring = NativeAggregator.ring_stats_per_ring
+    readers_stop = NativeAggregator.readers_stop
+
+    def rings_start(self, n_rings: int, fds=None, max_len: int = 65536,
+                    ring_cap: int = 65536, pin_cores=None) -> None:
+        self.eng.rings_start(n_rings, fds=fds, max_len=max_len,
+                             ring_cap=ring_cap, pin_cores=pin_cores)
+
+    def _emit_rings(self) -> bool:
+        emitted = False
+        for r in range(self.eng.n_rings):
+            nc, ng, ns, nh = self.eng.rings_emit_sharded(
+                r, self._native_lanes(), self._ps_bounds)
+            if nc + ng + ns + nh:
+                self._stage_presharded(nc, ng, ns, nh)
+                emitted = True
+        return emitted
+
+    def pump(self, max_wait_ms: int, max_emits: int = 8) -> List[bytes]:
+        """Multi-ring drain into the per-shard batchers (see
+        NativeAggregator.pump for the bounding rationale)."""
+        if self.eng.n_rings:
+            self.eng.rings_wait(max_wait_ms)
+            for _ in range(max_emits):
+                if not self._emit_rings():
+                    break
+            return self.eng.drain_specials()
+        full, _st = self.eng.pump(max_wait_ms)
+        for _ in range(max_emits):
+            if not full:
+                break
+            self._emit_native()
+            full, _st = self.eng.pump(0)
+        if full:
+            self._emit_native()
+        return self.eng.drain_specials()
+
     def swap(self):
+        rings = bool(self.eng.n_rings)
+        if rings:
+            self.eng.rings_pause()
+            self._emit_rings()
         self._emit_native()
         detached = self.table
         detached.finalize()
         state, _ = super().swap()
         self.eng.reset()
         self.table = NativeKeyTable(self.spec, self.eng, self.n_shards)
+        if rings:
+            self.eng.rings_resume()
         return state, detached

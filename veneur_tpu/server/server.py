@@ -204,7 +204,8 @@ class Server:
             if cfg.native_ingest and _native_available():
                 from veneur_tpu.server.native_aggregator import (
                     NativeShardedAggregator)
-                self.aggregator = NativeShardedAggregator(**agg_args)
+                self.aggregator = NativeShardedAggregator(
+                    preshard=cfg.native_preshard_enabled, **agg_args)
                 self._native = True
             else:
                 from veneur_tpu.server.sharded_aggregator import (
@@ -647,6 +648,38 @@ class Server:
                        self._ring_stats().get("emit_packed_ns", 0)),
                    kind="counter",
                    help="wall time inside C++ vt_emit_packed")
+        # per-ring family (multi-ring engine only; empty single-ring).
+        # The unlabeled veneur.ring.* names above stay the EXACT
+        # cross-ring aggregates — sums, with depth_highwater as the
+        # per-ring max — so dashboards keyed on them keep working.
+        M.callback("veneur.ring.per_ring_depth",
+                   lambda: self._collect_per_ring("ring_depth"),
+                   labelnames=("ring",),
+                   help="parsed datagrams waiting, per native ring")
+        M.callback("veneur.ring.per_ring_depth_highwater",
+                   lambda: self._collect_per_ring("ring_highwater"),
+                   labelnames=("ring",),
+                   help="deepest each native ring has been since start")
+        M.callback("veneur.ring.per_ring_datagrams_total",
+                   lambda: self._collect_per_ring("datagrams"),
+                   kind="counter", labelnames=("ring",),
+                   help="datagrams accepted per native ring")
+        M.callback("veneur.ring.per_ring_dropped_total",
+                   lambda: self._collect_per_ring("ring_dropped"),
+                   kind="counter", labelnames=("ring",),
+                   help="ring-overflow drops per native ring")
+        M.callback("veneur.ring.per_ring_parse_batches_total",
+                   lambda: self._collect_per_ring("pump_batches"),
+                   kind="counter", labelnames=("ring",),
+                   help="datagram parse batches per ring worker")
+        M.callback("veneur.ring.per_ring_stalls_total",
+                   lambda: self._collect_per_ring("pump_stalls"),
+                   kind="counter", labelnames=("ring",),
+                   help="lane-full parser stalls per native ring")
+        M.callback("veneur.ring.per_ring_emit_packed_total",
+                   lambda: self._collect_per_ring("emit_packed_calls"),
+                   kind="counter", labelnames=("ring",),
+                   help="packed arena-row emits per native ring")
         M.callback("veneur.jax.compiles_total", jaxruntime.compiles_total,
                    kind="counter",
                    help="XLA backend compiles observed, process-wide")
@@ -746,6 +779,16 @@ class Server:
         engine (collectors then read their zero defaults)."""
         fn = getattr(self.aggregator, "ring_stats", None)
         return fn() if fn is not None else {}
+
+    def _collect_per_ring(self, key: str):
+        """Labeled sample list for one per-ring stat: [((ring,), v)].
+        Empty (no exposition rows) outside multi-ring mode. Allocation
+        happens at collection cadence only — never on the ingest path."""
+        fn = getattr(self.aggregator, "ring_stats_per_ring", None)
+        if fn is None:
+            return []
+        return [((str(i),), float(st.get(key, 0)))
+                for i, st in enumerate(fn())]
 
     def _poll_ring_telemetry(self) -> None:
         """Flush-interval poll: turn the cumulative C++ emit counters
@@ -1185,6 +1228,14 @@ class Server:
         limit = self.cfg.metric_max_length or 65536
         bufsize = limit + 1
         sock.settimeout(0.5)  # lets readers observe shutdown and release fd
+        # Several reader threads (one per bound socket) share the fold
+        # counters with the shutdown fold and the property readers. The
+        # fold is batched per recv-loop iteration: one blocking recv,
+        # then drain whatever else the kernel already has (bounded), then
+        # ONE lock acquisition for the whole batch — at num_readers > 1
+        # the per-datagram acquisition made the shared lock the hot
+        # loop's serialization point.
+        batch_cap = 64
         while not self._shutdown.is_set():
             try:
                 data = sock.recv(bufsize)
@@ -1192,21 +1243,30 @@ class Server:
                 continue
             except OSError:
                 return
-            # several reader threads (one per bound socket) share these
-            # counters with the shutdown fold and the property readers;
-            # one lock acquisition per datagram covers both increments
-            toolong = len(data) > limit
-            with self._reader_fold_lock:
-                self._packets_received += 1
-                if toolong:
-                    self._packets_toolong_py += 1
-            if toolong:
-                continue
+            batch = [data]
+            sock.setblocking(False)
             try:
-                self.packet_queue.put(data, timeout=1.0)
-            except queue.Full:
-                with self._reader_fold_lock:
-                    self._packets_dropped_py += 1  # backpressure drop, counted
+                while len(batch) < batch_cap:
+                    batch.append(sock.recv(bufsize))
+            except OSError:
+                pass  # EAGAIN: kernel queue drained (or socket closing —
+                #       the next blocking recv surfaces a real error)
+            finally:
+                sock.settimeout(0.5)
+            received = len(batch)
+            toolong = dropped = 0
+            for data in batch:
+                if len(data) > limit:
+                    toolong += 1
+                    continue
+                try:
+                    self.packet_queue.put(data, timeout=1.0)
+                except queue.Full:
+                    dropped += 1  # backpressure drop, counted
+            with self._reader_fold_lock:
+                self._packets_received += received
+                self._packets_toolong_py += toolong
+                self._packets_dropped_py += dropped
 
     @property
     def packets_received(self) -> int:
@@ -1550,13 +1610,19 @@ class Server:
         # dropped 31% of BASELINE config 1's replay)
         use_native_readers = (self._native and self.cfg.native_udp_readers
                               and hasattr(self.aggregator, "readers_start"))
+        # multi-ring scale-out: with reader_rings > 1 each ring owns its
+        # SO_REUSEPORT socket, so the bind fan-out follows reader_rings
+        # (kernel flow-hashes datagrams across the group; one fd -> one
+        # ring -> one parser core, no cross-core handoff)
+        n_rings = max(1, self.cfg.reader_rings) if use_native_readers else 1
+        udp_fanout = max(1, self.cfg.num_readers, n_rings)
         native_reader_fds = []
         for addr in self.cfg.statsd_listen_addresses:
             kind, target = resolve_addr(addr)
             if kind == "udp":
-                for reader_i in range(max(1, self.cfg.num_readers)):
+                for reader_i in range(udp_fanout):
                     sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-                    if self.cfg.num_readers > 1 and hasattr(
+                    if udp_fanout > 1 and hasattr(
                             socket, "SO_REUSEPORT"):
                         sock.setsockopt(socket.SOL_SOCKET,
                                         socket.SO_REUSEPORT, 1)
@@ -1626,7 +1692,9 @@ class Server:
             # the same guard as the Python reader / the reference
             self.aggregator.readers_start(
                 native_reader_fds,
-                max_len=(self.cfg.metric_max_length or 65536) + 1)
+                max_len=(self.cfg.metric_max_length or 65536) + 1,
+                n_rings=n_rings,
+                pin_cores=list(self.cfg.reader_pin_cores) or None)
             self._native_readers_active = True
             # arm ring admission from the first datagram — the poller's
             # first tick is up to poll_interval away
