@@ -282,7 +282,8 @@ def main():
                  "--only", "pipeline_pump",
                  "--only", "pipeline_pump_mc",
                  "--only", "telemetry_overhead",
-                 "--only", "telemetry_scrape"],
+                 "--only", "telemetry_scrape",
+                 "--only", "query_serve"],
                 capture_output=True, text=True, timeout=micro_t,
                 cwd=here, env=cache_env(force_cpu=True))
             host = {}
@@ -308,7 +309,14 @@ def main():
                                   "ops_per_sec_1ring", "n_rings",
                                   "host_cores", "scaling_x",
                                   "accounting_exact",
-                                  "gate_ge_2p5x_armed", "gate_ge_2p5x_ok"):
+                                  "gate_ge_2p5x_armed", "gate_ge_2p5x_ok",
+                                  "p99_ms", "launches", "avg_batch",
+                                  "flush_p99_ms_base",
+                                  "flush_p99_ms_storm",
+                                  "interference_ok",
+                                  "gate_100k_10ms_armed",
+                                  "gate_ge_100k_ok",
+                                  "gate_p99_lt_10ms_ok"):
                         if extra in row:
                             host[f"{row['bench']}_{extra}"] = row[extra]
                 elif "skipped" in row:

@@ -1,0 +1,365 @@
+"""Query tier tests: packed-HLL value-exactness, swap-boundary
+consistency, query-vs-flush exactness per metric kind on every backend,
+the batched HTTP endpoint, and the shared shutdown/503 gate."""
+
+import json
+import threading
+import time
+import types
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from veneur_tpu.server.server import Server
+from veneur_tpu.sinks.debug import DebugMetricSink
+from tests.test_server import (_send_udp, _wait_processed, by_name,
+                               small_config)
+
+
+def _query_cfg(**kw):
+    defaults = dict(http_address="127.0.0.1:0", query_enabled=True)
+    defaults.update(kw)
+    return small_config(**defaults)
+
+
+def _post(srv, path, data=None, timeout=60.0):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.http_port}{path}", data=data,
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, resp.read()
+
+
+def _query(srv, body, timeout=60.0):
+    _, raw = _post(srv, "/query", json.dumps(body).encode(), timeout)
+    return json.loads(raw)
+
+
+def _matches(out, i=0):
+    return out["results"][i]["matches"]
+
+
+# -- satellite: packed 6-bit HLL estimator is value-exact vs dense -----------
+
+def test_estimate_packed_rows_value_exact_vs_dense():
+    """The fused lane-extraction estimator over 6-bit packed rows must be
+    bitwise equal to `estimate` over the unpacked dense u8 table — this
+    is what makes query-tier cardinalities equal flush exports."""
+    import jax.numpy as jnp
+    from veneur_tpu.ops import hll
+
+    rng = np.random.default_rng(7)
+    m = hll.num_registers()
+    regs = rng.integers(0, 48, size=(4, m)).astype(np.uint8)
+    regs[0] = 0          # linear-counting branch (all-zero registers)
+    regs[1, ::3] = 0     # mixed: some zeros, raw-vs-linear crossover
+    dense = np.asarray(hll.estimate(jnp.asarray(regs)))
+    packed = hll.pack_registers(jnp.asarray(regs))
+    fused = np.asarray(hll.estimate_packed_rows(packed))
+    np.testing.assert_array_equal(fused, dense)
+    # estimate() on a packed table must delegate to the same fused path
+    np.testing.assert_array_equal(np.asarray(hll.estimate(packed)), dense)
+
+
+# -- swap-boundary consistency ------------------------------------------------
+
+def test_query_read_your_writes():
+    """Everything admitted to the pipeline before the query's snapshot
+    is visible: FIFO ordering on the packet queue, no sampling."""
+    srv = Server(_query_cfg(), metric_sinks=[DebugMetricSink()])
+    srv.start()
+    try:
+        _send_udp(srv.local_addr(), [b"ryw.hits:1|c"] * 7)
+        _wait_processed(srv, 7)
+        out = _query(srv, {"name": "ryw.hits", "kinds": ["counter"]})
+        assert _matches(out)[0]["value"] == 7.0
+    finally:
+        srv.shutdown()
+
+
+def test_query_sees_fresh_interval_after_swap():
+    """Reads never leak the detached interval: after a swap the query
+    answers from the new table only."""
+    srv = Server(_query_cfg(), metric_sinks=[DebugMetricSink()])
+    srv.start()
+    try:
+        _send_udp(srv.local_addr(), [b"swp.c:5|c"])
+        _wait_processed(srv, 1)
+        out = _query(srv, {"name": "swp.c", "kinds": ["counter"]})
+        assert _matches(out)[0]["value"] == 5.0
+        assert srv.trigger_flush()
+        _send_udp(srv.local_addr(), [b"swp.c:2|c"])
+        _wait_processed(srv, 2)
+        out = _query(srv, {"name": "swp.c", "kinds": ["counter"]})
+        assert _matches(out)[0]["value"] == 2.0
+    finally:
+        srv.shutdown()
+
+
+@pytest.mark.parametrize("shards", [1, 8])
+def test_swap_boundary_no_torn_reads(shards):
+    """Two counters always written in the same datagram (one pipeline
+    item) must never disagree in a query response, even while flush
+    swaps race the reads. A torn read — snapshot straddling the swap, or
+    seeing one write of the pair — would show va != vb."""
+    srv = Server(_query_cfg(tpu_n_shards=shards),
+                 metric_sinks=[DebugMetricSink()])
+    srv.start()
+    stop = threading.Event()
+
+    def writer():
+        while not stop.is_set():
+            _send_udp(srv.local_addr(), [b"pair.a:1|c", b"pair.b:1|c"])
+            time.sleep(0.001)
+
+    def flusher():
+        while not stop.is_set():
+            srv.trigger_flush()
+            time.sleep(0.02)
+
+    threads = [threading.Thread(target=writer),
+               threading.Thread(target=flusher)]
+    for t in threads:
+        t.start()
+    try:
+        nonzero = 0
+        deadline = time.time() + 60
+        while time.time() < deadline and nonzero < 5:
+            try:
+                out = _query(srv, {"queries": [
+                    {"name": "pair.a", "kinds": ["counter"]},
+                    {"name": "pair.b", "kinds": ["counter"]}]})
+            except urllib.error.HTTPError as e:
+                if e.code == 503:   # shed under load: fine, consistency
+                    continue        # is what's under test, not latency
+                raise
+            ma, mb = _matches(out, 0), _matches(out, 1)
+            va = ma[0]["value"] if ma else 0.0
+            vb = mb[0]["value"] if mb else 0.0
+            assert va == vb, f"torn read: pair.a={va} pair.b={vb}"
+            if va > 0:
+                nonzero += 1
+        assert nonzero >= 5, "reads never observed live writes"
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+        srv.shutdown()
+
+
+# -- acceptance: query answers equal the next flush's exports ----------------
+
+def _feed_kinds(addr):
+    lines = ([b"vx.count:2|c", b"vx.count:3|c", b"vx.gauge:7.5|g|#env:prod"]
+             + [b"vx.set:u%d|s" % i for i in range(32)]
+             + [b"vx.timer:%d|ms" % v for v in (10, 20, 30, 40, 50)])
+    _send_udp(addr, lines)
+    return len(lines)
+
+
+def _assert_query_equals_flush(srv, sink, suffix=b""):
+    out = _query(srv, {"queries": [
+        {"name": "vx.count", "kinds": ["counter"]},
+        {"name": "vx.gauge", "kinds": ["gauge"]},
+        {"name": "vx.set", "kinds": ["set"]},
+        {"name": "vx.timer", "kinds": ["timer"], "quantiles": [0.5, 0.99]},
+    ]})
+    q_count = _matches(out, 0)[0]
+    q_gauge = _matches(out, 1)[0]
+    q_set = _matches(out, 2)[0]
+    q_timer = _matches(out, 3)[0]
+    sink.flushed.clear()
+    assert srv.trigger_flush()
+    m = by_name(sink.flushed)
+    assert q_count["value"] == m["vx.count"].value
+    assert q_gauge["value"] == m["vx.gauge"].value
+    assert q_gauge["tags"] == ["env:prod"]
+    assert q_set["estimate"] == m["vx.set"].value
+    assert q_timer["quantiles"]["0.5"] == m["vx.timer.50percentile"].value
+    assert q_timer["quantiles"]["0.99"] == m["vx.timer.99percentile"].value
+    if "vx.timer.max" in m:
+        assert q_timer["max"] == m["vx.timer.max"].value
+        assert q_timer["count"] == m["vx.timer.count"].value
+
+
+@pytest.mark.parametrize("shards", [1, 8])
+def test_query_value_exact_vs_flush(shards):
+    """Frozen table: POST /query answers must equal what the very next
+    flush exports, per metric kind, bit for bit — both sides run the
+    same jitted flush program over the same resident state."""
+    sink = DebugMetricSink()
+    srv = Server(_query_cfg(tpu_n_shards=shards), metric_sinks=[sink])
+    srv.start()
+    try:
+        n = _feed_kinds(srv.local_addr())
+        _wait_processed(srv, n)
+        _assert_query_equals_flush(srv, sink)
+    finally:
+        srv.shutdown()
+
+
+def test_query_value_exact_vs_flush_collective():
+    """Same exactness on a collective-attached topology: a local server
+    absorbs into the co-located global tier; querying the global tier
+    matches the global tier's next flush."""
+    from veneur_tpu.collective.tier import CollectiveGlobalTier
+
+    gsink = DebugMetricSink()
+    gsrv = Server(_query_cfg(collective_enabled=True, collective_group="q1",
+                             tpu_n_shards=4, tpu_n_replicas=2),
+                  metric_sinks=[gsink])
+    assert isinstance(gsrv.aggregator, CollectiveGlobalTier)
+    gsrv.start()
+    lsrv = Server(small_config(collective_attach="q1"),
+                  metric_sinks=[DebugMetricSink()])
+    try:
+        lsrv.start()
+        lines = ([b"vx.count:2|c|#veneurglobalonly",
+                  b"vx.count:3|c|#veneurglobalonly"]
+                 + [b"vx.set:u%d|s" % i for i in range(32)]
+                 + [b"vx.timer:%d|ms" % v for v in (10, 20, 30, 40, 50)])
+        _send_udp(lsrv.local_addr(), lines)
+        _wait_processed(lsrv, len(lines))
+        lsrv.trigger_flush()
+        assert gsrv.aggregator.absorbed_rows > 0
+        out = _query(gsrv, {"queries": [
+            {"name": "vx.count", "kinds": ["counter"]},
+            {"name": "vx.set", "kinds": ["set"]},
+            {"name": "vx.timer", "kinds": ["timer"], "quantiles": [0.5]},
+        ]})
+        q_count = _matches(out, 0)[0]
+        q_set = _matches(out, 1)[0]
+        q_timer = _matches(out, 2)[0]
+        gsink.flushed.clear()
+        assert gsrv.trigger_flush()
+        m = by_name(gsink.flushed)
+        assert q_count["value"] == m["vx.count"].value == 5.0
+        assert q_set["estimate"] == m["vx.set"].value
+        assert q_timer["quantiles"]["0.5"] == m["vx.timer.50percentile"].value
+    finally:
+        lsrv.shutdown()
+        gsrv.shutdown()
+
+
+# -- name resolution ----------------------------------------------------------
+
+def test_query_prefix_and_wildcard_resolution():
+    srv = Server(_query_cfg(), metric_sinks=[DebugMetricSink()])
+    srv.start()
+    try:
+        _send_udp(srv.local_addr(), [
+            b"api.get.ms:10|ms", b"api.put.ms:20|ms", b"db.get.ms:30|ms"])
+        _wait_processed(srv, 3)
+        out = _query(srv, {"queries": [
+            {"prefix": "api."},
+            {"match": "*.get.ms"},
+            {"name": "api.get.ms"}]})
+        assert sorted(m["name"] for m in _matches(out, 0)) == [
+            "api.get.ms", "api.put.ms"]
+        assert sorted(m["name"] for m in _matches(out, 1)) == [
+            "api.get.ms", "db.get.ms"]
+        assert [m["name"] for m in _matches(out, 2)] == ["api.get.ms"]
+    finally:
+        srv.shutdown()
+
+
+# -- HTTP endpoint: errors, shedding, the shared gate ------------------------
+
+def test_query_endpoint_404_when_disabled():
+    srv = Server(small_config(http_address="127.0.0.1:0"),
+                 metric_sinks=[DebugMetricSink()])
+    srv.start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(srv, "/query", b"{}")
+        assert ei.value.code == 404
+    finally:
+        srv.shutdown()
+
+
+def test_query_endpoint_errors_and_shed_accounting():
+    from veneur_tpu.reliability.overload import CRITICAL
+
+    srv = Server(_query_cfg(), metric_sinks=[DebugMetricSink()])
+    srv.start()
+    try:
+        for bad in (b"", b"{not json", b'{"name": "x", "prefix": "y"}',
+                    b'{"name": "x", "quantiles": [1.5]}'):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(srv, "/query", bad)
+            assert ei.value.code == 400, bad
+        # shed at CRITICAL: 503 with exact drop accounting
+        base = srv._c_query_shed.value()
+        srv._overload = types.SimpleNamespace(state=CRITICAL)
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(srv, "/query", b'{"name": "x"}')
+            assert ei.value.code == 503
+        finally:
+            srv._overload = None
+        assert srv._c_query_shed.value() == base + 1
+    finally:
+        srv.shutdown()
+
+
+def test_httpapi_single_shutdown_gate():
+    """Regression for the shared-gate fix: exactly ONE shutdown/503 gate
+    helper exists and every read endpoint routes through it."""
+    import inspect
+
+    from veneur_tpu.server import httpapi
+
+    src = inspect.getsource(httpapi)
+    assert src.count("def _shutdown_gate") == 1
+    assert src.count("self._shutdown_gate()") >= 4  # healthz/readyz/stats/query
+
+
+def test_shutdown_gate_behavior_all_endpoints():
+    srv = Server(_query_cfg(), metric_sinks=[DebugMetricSink()])
+    srv.start()
+    try:
+        srv._shutdown.set()
+        for path, data in [("/healthz", None), ("/readyz", None),
+                           ("/stats", None), ("/query", b"{}")]:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(srv, path, data)
+            assert ei.value.code == 503, path
+    finally:
+        srv._shutdown.clear()
+        srv.shutdown()
+
+
+# -- satellite: one-shot CLI client ------------------------------------------
+
+def test_cli_query_one_shot(capsys):
+    from veneur_tpu.cli import query as cli_query
+
+    srv = Server(_query_cfg(), metric_sinks=[DebugMetricSink()])
+    srv.start()
+    try:
+        _send_udp(srv.local_addr(), [b"cli.hits:4|c"])
+        _wait_processed(srv, 1)
+        url = f"http://127.0.0.1:{srv.http_port}/query"
+        rc = cli_query.main(["cli.hits", "--kind", "counter", "--url", url])
+        assert not rc
+        text = capsys.readouterr().out
+        assert "cli.hits" in text and "4" in text
+    finally:
+        srv.shutdown()
+
+
+# -- metrics registration -----------------------------------------------------
+
+def test_query_metrics_registered():
+    srv = Server(_query_cfg(), metric_sinks=[DebugMetricSink()])
+    srv.start()
+    try:
+        _send_udp(srv.local_addr(), [b"mr.c:1|c"])
+        _wait_processed(srv, 1)
+        _query(srv, {"name": "mr.c"})
+        assert srv._c_query_requests.value() >= 1.0
+        assert srv._c_query_batched.value() >= 1.0
+    finally:
+        srv.shutdown()

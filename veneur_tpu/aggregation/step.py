@@ -556,6 +556,46 @@ def pack_flush_inputs(perc, idx_arrays):
                                   for i in idx_arrays])
 
 
+def pack_query_inputs(spec, need, union_qs):
+    """Host side: the query tier's gather plan -> the flush program's
+    packed input buffer + static shape args (n_q, buckets, qcol).
+
+    Same wire layout as `pack_flush_inputs`, but shaped for ad-hoc
+    reads instead of a full-table flush: quantiles pad to the next
+    power of two (min 4) so arbitrary per-query quantile vectors hit a
+    handful of `flush_live_in_packed` specializations instead of
+    recompiling per distinct count, and each kind's slot gather pads
+    with `pad_bucket` exactly like the flush tiling — which is what
+    keeps query reads running the flush's own jitted program (and
+    therefore value-exact against the next flush's exports).
+
+    `need` maps table name -> live slot list in flush-table order
+    (counter, gauge, status, set, histo); `union_qs` is the batch's
+    union quantile set. Returns (inputs, n_q, buckets, qcol) where
+    qcol maps quantile value -> column in the padded vector.
+    """
+    import numpy as np
+    caps = (spec.counter_capacity, spec.gauge_capacity,
+            spec.status_capacity, spec.set_capacity, spec.histo_capacity)
+    qs = sorted(union_qs) or [0.5]
+    n_q = 4
+    while n_q < len(qs):
+        n_q <<= 1
+    qcol = {v: i for i, v in enumerate(qs)}
+    qs_padded = qs + [0.5] * (n_q - len(qs))
+    buckets, idx_arrays = [], []
+    for slots, cap in zip(need, caps):
+        b = min(pad_bucket(len(slots), cap), FLUSH_BLOCK_ROWS)
+        if len(slots) > b:
+            raise ValueError("query gather exceeds one flush block")
+        arr = np.zeros(b, np.int32)
+        arr[:len(slots)] = slots
+        buckets.append(b)
+        idx_arrays.append(arr)
+    return (pack_flush_inputs(qs_padded, idx_arrays), n_q,
+            tuple(buckets), qcol)
+
+
 def _flush_live_in_packed_core(state, flat, *, spec, n_q: int,
                                buckets: tuple, want_raw: bool = False):
     qs = jax.lax.bitcast_convert_type(flat[:n_q], jnp.float32)

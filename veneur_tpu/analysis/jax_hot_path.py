@@ -67,13 +67,14 @@ HOT_FUNCS: Dict[str, List[str]] = {
         "_emit_native", "feed", "pump", "_split_shards"],
     "veneur_tpu/aggregation/step.py": ["pack_batch"],
     "veneur_tpu/server/aggregator.py": [
-        "_on_batch", "_flush_hll_imports", "swap"],
+        "_on_batch", "_flush_hll_imports", "swap", "query_snapshot"],
     "veneur_tpu/server/sharded_aggregator.py": [
         "_dispatch_row", "_on_shard_batch", "_emit_all",
-        "_apply_hll_imports", "swap"],
+        "_apply_hll_imports", "swap", "query_snapshot"],
     "veneur_tpu/collective/tier.py": [
         "_dispatch_row", "_dispatch_routed", "_on_stage_batch",
-        "absorb_raw", "swap"],
+        "absorb_raw", "swap", "query_snapshot"],
+    "veneur_tpu/query/engine.py": ["_launch", "_launch_on_pipeline"],
 }
 
 # named jit wrappers that MUST donate their state argument: dropping

@@ -355,6 +355,28 @@ class CollectiveGlobalTier(ShardedAggregator):
             self._routed_steps = 0
             return state, table
 
+    # -- query tier ---------------------------------------------------------
+    def query_snapshot(self):
+        """Absorb-staged routed rows are part of 'admitted before the
+        snapshot' too: fold them under the absorb lock (the same mutual
+        exclusion swap() takes against forwarding threads), then
+        snapshot as a sharded backend."""
+        with self._absorb_lock:
+            self._emit_absorbed()
+            return super().query_snapshot()
+
+    def query_flat_state(self, state):
+        """R > 1: replica-merge the mesh first (the flush's own ICI
+        collectives — register max for HLL, the mergeable reductions
+        elsewhere) so reads see the mesh-global sketches, then flatten
+        the shard axis like the sharded backend."""
+        if self.n_replicas == 1:
+            return super().query_flat_state(state)
+        import jax
+        merged = self._merge(state)
+        return jax.tree.map(lambda x: x.reshape((-1,) + x.shape[2:]),
+                            merged)
+
     def compute_flush(self, state, table, percentiles,
                       want_raw: bool = False):
         t_flush = time.perf_counter_ns()

@@ -344,6 +344,15 @@ class Config:
     collective_enabled: bool = False
     collective_group: str = "default"
     collective_attach: str = ""
+    # on-device query tier (veneur_tpu/query/): serve live quantile /
+    # cardinality / counter reads from resident device state via
+    # POST /query on the http API. Off by default — it spins up a
+    # batcher thread and piggybacks snapshot requests on the ingest
+    # pipeline queue. query_max_batch caps queries coalesced into one
+    # device launch; query_timeout_ms is the coalescing window.
+    query_enabled: bool = False
+    query_max_batch: int = 64
+    query_timeout_ms: float = 2.0
 
     def parse_interval(self) -> float:
         return parse_duration(self.interval)

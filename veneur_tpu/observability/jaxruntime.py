@@ -98,6 +98,33 @@ def sync_and_time(token) -> int:
     return time.perf_counter_ns() - t0
 
 
+class SampledSync:
+    """Sampled device-sync bookkeeping for dispatch sites that launch
+    many small programs (the query tier's batched reads): every
+    `every`-th token is synced through sync_and_time() so `sync_ns`
+    means device time, while the other N-1 launches pay only enqueue
+    cost. Same cadence contract as the aggregators' `_SYNC_EVERY`
+    sampling — one shared shape for the vtlint timer-sync rule."""
+
+    def __init__(self, every: int = 64) -> None:
+        self.every = max(1, int(every))
+        self.count = 0
+        self.synced = 0
+        self.sync_ns = 0
+
+    def tick(self, token) -> int:
+        """Count one launch; on the sampling edge, block on `token` and
+        accumulate the wait. Returns the sampled nanoseconds (0 when
+        this launch was not sampled)."""
+        self.count += 1
+        if self.count % self.every:
+            return 0
+        dt = sync_and_time(token)
+        self.synced += 1
+        self.sync_ns += dt
+        return dt
+
+
 # -- HBM accounting -----------------------------------------------------------
 
 def hbm_stats() -> dict:

@@ -461,13 +461,55 @@ def estimate(registers, *, precision: int = DEFAULT_PRECISION):
     vendored lib uses the LogLog-Beta variant; both sit inside the ~0.8%
     standard error at p=14, which is what the tests assert.
     """
-    m = num_registers(precision)
     if registers.dtype != jnp.uint8:     # 6-bit packed i32 table
-        registers = unpack_registers(registers, precision=precision)
+        # fused lane-extraction path: no dense u8 register staging —
+        # value-exact vs the dense math below (tests/test_query.py), so
+        # flush exports and query-tier reads agree on every backend
+        return estimate_packed_rows(registers, precision=precision)
+    m = num_registers(precision)
     regs = registers.astype(jnp.float32)
     inv = jnp.sum(jnp.exp2(-regs), axis=-1)
     raw = _alpha(m) * m * m / inv
     zeros = jnp.sum((registers == 0).astype(jnp.float32), axis=-1)
+    lin = m * jnp.log(m / jnp.maximum(zeros, 1.0))
+    use_lin = (raw <= 2.5 * m) & (zeros > 0)
+    return jnp.where(use_lin, lin, raw)
+
+
+@partial(jax.jit, static_argnames=("precision",))
+def estimate_packed_rows(words, *, precision: int = DEFAULT_PRECISION):
+    """Cardinality estimate straight from 6-bit packed i32 rows [..., W].
+
+    The lane shift/mask table (the 16-register/3-word group layout of
+    `unpack_registers`) feeds the harmonic estimator directly, so the
+    whole thing is one fused device program over the packed words — no
+    dense u8[..., 2^p] register array is ever staged as a separate pass,
+    and nothing crosses to the host. The register values, the f32
+    conversion and the reduction layout are identical to running
+    `estimate` on the unpacked table, so the result is value-exact vs
+    the dense path (tests/test_query.py pins this) — which is also what
+    keeps query-tier cardinalities equal to what the flush would export.
+    """
+    m = num_registers(precision)
+    w = packed_words(precision)
+    assert words.shape[-1] == w
+    g = _group16(words, 3)
+    w0, w1, w2 = g[..., 0], g[..., 1], g[..., 2]
+    lanes = [
+        w0 & 0x3F, (w0 >> 6) & 0x3F, (w0 >> 12) & 0x3F, (w0 >> 18) & 0x3F,
+        (w0 >> 24) & 0x3F,
+        ((w0 >> 30) & 0x3) | ((w1 & 0xF) << 2),
+        (w1 >> 4) & 0x3F, (w1 >> 10) & 0x3F, (w1 >> 16) & 0x3F,
+        (w1 >> 22) & 0x3F,
+        ((w1 >> 28) & 0xF) | ((w2 & 0x3) << 4),
+        (w2 >> 2) & 0x3F, (w2 >> 8) & 0x3F, (w2 >> 14) & 0x3F,
+        (w2 >> 20) & 0x3F, (w2 >> 26) & 0x3F,
+    ]
+    regs_i = jnp.stack(lanes, axis=-1).reshape(words.shape[:-1] + (m,))
+    regs = regs_i.astype(jnp.float32)
+    inv = jnp.sum(jnp.exp2(-regs), axis=-1)
+    raw = _alpha(m) * m * m / inv
+    zeros = jnp.sum((regs_i == 0).astype(jnp.float32), axis=-1)
     lin = m * jnp.log(m / jnp.maximum(zeros, 1.0))
     use_lin = (raw <= 2.5 * m) & (zeros > 0)
     return jnp.where(use_lin, lin, raw)

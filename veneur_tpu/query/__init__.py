@@ -1,0 +1,33 @@
+"""On-device query tier: serve live percentiles, cardinalities and
+counters straight from resident device state (ROADMAP item 3 — the read
+side of the metrics store).
+
+The write path exports once per flush interval; dashboards read many
+times in between. This package answers those reads from the SAME state
+the next flush will export, with zero flush-path interference:
+
+- `snapshot` — the consistent read-snapshot discipline. Query-tier
+  requests ride the pipeline's packet queue (FIFO with ingest and
+  FlushRequest): a SnapshotRequest pins a coherent
+  (table-prefix, set_shift) naming view between batches, and a
+  PipelineCall later dispatches the device gather from the pipeline
+  thread itself — before any donating ingest step can invalidate the
+  live state buffers. Read-your-writes holds for anything admitted to
+  the queue before the query's snapshot; torn reads across the
+  double-buffer swap are impossible by construction (an intervening
+  swap is detected by table identity and the batch retries).
+- `nameindex` — sorted-name resolution (exact / prefix / wildcard)
+  over a snapshot's key-table prefix, built lazily on the query worker
+  thread — never on the ingest hot path.
+- `engine` — the batching engine: concurrent HTTP queries coalesce
+  into ONE snapshot and ONE device launch through the exact flush
+  program (`flush_live_in_packed`), which is what makes query answers
+  value-exact vs the flush path on every backend.
+"""
+
+from veneur_tpu.query.engine import QueryEngine, QueryError, parse_request
+from veneur_tpu.query.snapshot import (PipelineCall, PipelineRequest,
+                                       QuerySnapshot, SnapshotRequest)
+
+__all__ = ["PipelineCall", "PipelineRequest", "QueryEngine", "QueryError",
+           "QuerySnapshot", "SnapshotRequest", "parse_request"]

@@ -1,0 +1,526 @@
+"""Batching query engine: many concurrent reads, one device launch.
+
+Dashboard reads arrive as independent HTTP requests; the engine
+coalesces everything that shows up within a `query_timeout_ms` window
+(capped at `query_max_batch` queries) into ONE pipeline snapshot and
+ONE device launch. The launch IS the flush program —
+`flush_live_in_packed`, the same jitted executable `compute_flush`
+tiles over — fed with the union of the batch's quantile vectors and
+the per-kind slot gathers the batch resolved. Running the identical
+program on the identical captured state is what makes query answers
+value-exact vs what the next flush would export:
+
+- histogram/timer quantiles go through the Pallas quantile kernel on
+  TPU (ops/pallas_digest.py) and the XLA vmap fallback on CPU, exactly
+  as the flush does;
+- HLL cardinalities come from the 6-bit packed i32 rows entirely on
+  device (ops/hll.estimate_packed_rows — no host unpack);
+- counters and histogram count/sum/recip scalars leave the device as
+  two-float (hi, lo) pairs and are folded in float64 by
+  combine_flush_scalars, the flush's own residual fold;
+- live-interval set estimates are scaled by 2^active_set_shift here,
+  mirroring the latched-shift correction server._do_flush applies.
+
+Sharded backends flatten their [replica, shard, rows] state views with
+free reshapes (global slot = shard·per_shard + local IS the flat
+index), so a gather touches only the owner shard's rows; a
+collective-attached tier with >1 replicas runs its ICI register-max
+merge first so reads see the mesh-global sketches.
+
+A batch takes TWO pipeline-queue visits (see query/snapshot.py for
+the donation rationale): SnapshotRequest pins the interval's naming
+view, the engine resolves names to slots off-thread, then a
+PipelineCall dispatches `_launch` FROM the pipeline thread — enqueued
+in FIFO order before any later donating ingest step, so the live
+state buffers are still valid when the gather reads them. Only the
+async dispatch (~µs) runs on the pipeline thread; compilation of the
+query's bucket shape is a one-time cost per shape, and host
+materialization, unpacking, and response assembly all happen on the
+engine's own thread. An intervening swap() between the two visits is
+detected by table identity and the batch retries against the fresh
+interval, so a response never mixes two table versions.
+
+The dispatch site is on the vtlint jax-hot-path/timer-sync scan
+lists: launch cost is recorded under `dispatch_ns` (enqueue-only by
+naming convention) and device completion is sampled through the ONE
+sanctioned sync point, `observability/jaxruntime.sync_and_time`,
+every `_SYNC_EVERY` launches — on the engine thread, never the
+pipeline's.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue as queue_mod
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from veneur_tpu.observability import jaxruntime
+from veneur_tpu.query.nameindex import NameIndex
+from veneur_tpu.query.snapshot import (COUNT_TABLES, PipelineCall,
+                                       SnapshotRequest)
+
+log = logging.getLogger("veneur_tpu.query")
+
+_SYNC_EVERY = 64            # sampled device-sync cadence (1 in N launches)
+_SNAPSHOT_TIMEOUT_S = 30.0  # pipeline round-trip budget (CPU CI: a
+                            # flush storm can hold the queue for seconds)
+_SUBMIT_TIMEOUT_S = 30.0    # end-to-end budget an HTTP thread waits
+_MAX_MATCHES = 1024         # per-query resolution cap (truncated flag set)
+_MAX_QUANTILES = 64         # per-query quantile-vector cap
+
+KINDS = ("counter", "gauge", "status", "set", "histogram", "timer")
+_KIND_TABLE = {"counter": "counter", "gauge": "gauge", "status": "status",
+               "set": "set", "histogram": "histo", "timer": "histo"}
+_DEFAULT_QS = (0.5, 0.9, 0.99)
+
+
+class QueryError(ValueError):
+    """Client error in a /query request body (HTTP 400)."""
+
+
+class _IntervalRolled(Exception):
+    """swap() ran between the naming snapshot and the launch visit;
+    the batch retries against the fresh interval."""
+
+
+def _parse_one(q) -> dict:
+    if not isinstance(q, dict):
+        raise QueryError("each query must be a JSON object")
+    modes = [k for k in ("name", "prefix", "match") if k in q]
+    if len(modes) != 1:
+        raise QueryError(
+            "each query needs exactly one of name/prefix/match")
+    mode = modes[0]
+    arg = q[mode]
+    if not isinstance(arg, str):
+        raise QueryError(f"{mode} must be a string")
+    kinds = q.get("kinds")
+    if kinds is None and "kind" in q:
+        kinds = [q["kind"]]
+    if kinds is not None:
+        if (not isinstance(kinds, (list, tuple)) or not kinds
+                or any(k not in KINDS for k in kinds)):
+            raise QueryError(f"kind(s) must be drawn from {KINDS}")
+        kinds = tuple(kinds)
+    qs = q.get("quantiles")
+    if qs is not None:
+        if not isinstance(qs, (list, tuple)) or not qs \
+                or len(qs) > _MAX_QUANTILES:
+            raise QueryError(
+                f"quantiles must be a list of 1..{_MAX_QUANTILES} floats")
+        try:
+            qs = tuple(sorted({float(v) for v in qs}))
+        except (TypeError, ValueError):
+            raise QueryError("quantiles must be numbers")
+        if any(not (0.0 <= v <= 1.0) for v in qs):
+            raise QueryError("quantiles must lie in [0, 1]")
+    tags = q.get("tags")
+    if tags is not None:
+        if not isinstance(tags, (list, tuple)) \
+                or any(not isinstance(t, str) for t in tags):
+            raise QueryError("tags must be a list of strings")
+        tags = tuple(tags)
+    return {"mode": mode, "arg": arg, "kinds": kinds,
+            "quantiles": qs, "tags": tags}
+
+
+def parse_request(body, max_queries: int) -> List[dict]:
+    """POST /query body -> validated query list. Accepts
+    {"queries": [...]} or a single bare query object."""
+    if isinstance(body, dict) and "queries" in body:
+        raw = body["queries"]
+        if not isinstance(raw, list):
+            raise QueryError("queries must be a list")
+    elif isinstance(body, dict) and body:
+        raw = [body]
+    else:
+        raise QueryError("empty query request")
+    if not raw:
+        raise QueryError("empty query request")
+    if len(raw) > max_queries:
+        raise QueryError(f"too many queries in one request "
+                         f"(max {max_queries})")
+    return [_parse_one(q) for q in raw]
+
+
+class _Item:
+    """One HTTP request's parsed queries + its completion slot."""
+
+    __slots__ = ("queries", "done", "result", "error")
+
+    def __init__(self, queries: List[dict]) -> None:
+        self.queries = queries
+        self.done = threading.Event()
+        self.result: Optional[dict] = None
+        self.error: Optional[Exception] = None
+
+
+class QueryEngine:
+    """Leader thread that batches, snapshots, launches and assembles."""
+
+    def __init__(self, server, *, max_batch: int = 64,
+                 timeout_ms: float = 2.0, requests=None, batched=None,
+                 duration=None) -> None:
+        self._server = server
+        self.spec = server.aggregator.spec           # TOTAL capacities
+        self.max_batch = max(1, int(max_batch))
+        self.timeout_s = max(0.0, float(timeout_ms)) / 1000.0
+        self._c_requests = requests
+        self._c_batched = batched
+        self._t_duration = duration
+        self._queue: "queue_mod.Queue[Optional[_Item]]" = queue_mod.Queue()
+        self._stop = threading.Event()
+        self._sync = jaxruntime.SampledSync(_SYNC_EVERY)
+        self.dispatch_ns = 0
+        self.launches_total = 0
+        # one name index per (table identity, counts): a dashboard
+        # polling the same interval pays the sort once
+        self._index: Optional[NameIndex] = None
+        self._index_key: Optional[tuple] = None
+        self._index_table = None
+        self._thread = threading.Thread(
+            target=self._serve_loop, name="query-batcher", daemon=True)
+        self._thread.start()
+
+    # -- public API ----------------------------------------------------------
+    def submit(self, body, timeout: float = _SUBMIT_TIMEOUT_S) -> dict:
+        """Parse, join the current batch, wait for the leader. Raises
+        QueryError (400) on a bad body, TimeoutError/RuntimeError (503)
+        when the pipeline or device cannot serve."""
+        queries = parse_request(body, self.max_batch)
+        if self._c_requests is not None:
+            self._c_requests.inc(len(queries))
+        if self._stop.is_set():
+            raise RuntimeError("query engine stopped")
+        item = _Item(queries)
+        self._queue.put(item)
+        if not item.done.wait(timeout):
+            raise TimeoutError("query timed out")
+        if item.error is not None:
+            raise item.error
+        assert item.result is not None
+        return item.result
+
+    def close(self) -> None:
+        self._stop.set()
+        self._queue.put(None)
+        self._thread.join(timeout=5.0)
+        # wake anything still parked (shutdown race)
+        while True:
+            try:
+                it = self._queue.get_nowait()
+            except queue_mod.Empty:
+                break
+            if it is not None:
+                it.error = RuntimeError("query engine stopped")
+                it.done.set()
+
+    # -- batching loop -------------------------------------------------------
+    def _serve_loop(self) -> None:
+        while True:
+            item = self._queue.get()
+            if item is None:
+                if self._stop.is_set():
+                    return
+                continue
+            batch = [item]
+            total = len(item.queries)
+            deadline = time.monotonic() + self.timeout_s
+            while total < self.max_batch:
+                rem = deadline - time.monotonic()
+                if rem <= 0:
+                    break
+                try:
+                    nxt = self._queue.get(timeout=rem)
+                except queue_mod.Empty:
+                    break
+                if nxt is None:
+                    break
+                batch.append(nxt)
+                total += len(nxt.queries)
+            try:
+                self._execute(batch, total)
+            except Exception as e:  # noqa: BLE001 — waiters must wake
+                log.exception("query batch failed")
+                for it in batch:
+                    if not it.done.is_set():
+                        it.error = e
+                        it.done.set()
+            if self._stop.is_set():
+                return
+
+    # -- snapshot + index ----------------------------------------------------
+    def _snapshot(self):
+        req = SnapshotRequest()
+        try:
+            self._server.packet_queue.put(req, timeout=1.0)
+        except queue_mod.Full:
+            raise RuntimeError("pipeline backlogged; snapshot not scheduled")
+        if not req.wait(_SNAPSHOT_TIMEOUT_S):
+            raise RuntimeError("snapshot timed out")
+        if not req.ok:
+            raise RuntimeError(req.detail or "snapshot failed")
+        return req.snapshot
+
+    def _index_for(self, snap) -> NameIndex:
+        key = (id(snap.table),
+               tuple(snap.counts[t] for t in COUNT_TABLES))
+        if self._index is not None and self._index_key == key:
+            return self._index
+        idx = NameIndex(snap.metas, snap.counts)
+        # hold the table reference so the id() cache key stays unique
+        self._index, self._index_key, self._index_table = idx, key, snap.table
+        return idx
+
+    # -- resolution ----------------------------------------------------------
+    def _resolve(self, index: NameIndex, q: dict) -> List[tuple]:
+        if q["kinds"] is not None:
+            tables = list(dict.fromkeys(
+                _KIND_TABLE[k] for k in q["kinds"]))
+        else:
+            tables = list(COUNT_TABLES)
+        out = []
+        for tname in tables:
+            if q["mode"] == "name":
+                ent = index.exact(tname, q["arg"])
+            elif q["mode"] == "prefix":
+                ent = index.prefix(tname, q["arg"])
+            else:
+                ent = index.match(tname, q["arg"])
+            for pos, slot, meta in ent:
+                if q["kinds"] is not None and tname == "histo" \
+                        and meta.kind not in q["kinds"]:
+                    continue
+                if q["tags"] is not None \
+                        and tuple(meta.tags) != q["tags"]:
+                    continue
+                out.append((tname, pos, slot, meta))
+        return out
+
+    # -- device launch -------------------------------------------------------
+    def _launch(self, state, packed_inputs, n_q: int, buckets: tuple):
+        """The query tier's ONE device dispatch (vtlint jax-hot-path +
+        timer-sync covered): enqueue cost lands in dispatch_ns; the
+        sampled completion sync runs later on the ENGINE thread."""
+        from veneur_tpu.aggregation.step import flush_live_in_packed
+        t0 = time.perf_counter_ns()
+        out = flush_live_in_packed(state, packed_inputs, spec=self.spec,
+                                   n_q=n_q, buckets=buckets)
+        self.dispatch_ns += time.perf_counter_ns() - t0
+        self.launches_total += 1
+        return out
+
+    def _launch_on_pipeline(self, aggregator, table, packed_inputs,
+                            n_q: int, buckets: tuple):
+        """Visit #2 body, pipeline-thread-only: re-drain staging,
+        verify the interval the slots were resolved against is still
+        live (swap() installs a fresh table object), and dispatch the
+        gather while the state buffers are guaranteed undonated.
+        Returns (device output, live set_shift)."""
+        if aggregator.table is not table:
+            raise _IntervalRolled()
+        state, _table, set_shift = aggregator.query_snapshot()
+        flat = aggregator.query_flat_state(state)
+        return self._launch(flat, packed_inputs, n_q, buckets), \
+            int(set_shift)
+
+    # -- batch execution -----------------------------------------------------
+    def _execute(self, batch: List[_Item], total: int) -> None:
+        t0 = time.perf_counter_ns()
+        plans = res = None
+        qcol: dict = {}
+        set_shift = 0
+        for _attempt in range(2):
+            try:
+                plans, res, qcol, set_shift = self._plan_and_evaluate(batch)
+                break
+            except _IntervalRolled:
+                # swap() landed between the two pipeline visits: the
+                # resolved slots belong to the detached interval.
+                # Retry once against the fresh table, then escalate
+                continue
+        else:
+            # a flush storm keeps landing swaps inside the two-visit
+            # window (manual trigger_flush loops; a timer interval
+            # can't): fall back to ONE atomic pipeline visit that
+            # snapshots, resolves and dispatches with no gap to roll
+            # into. Costs index/resolution time on the pipeline thread,
+            # so it is the escalation path, never the default.
+            plans, res, qcol, set_shift = self._evaluate_atomic(batch)
+        dur = time.perf_counter_ns() - t0
+        for item, per_q in plans:
+            results = []
+            for rows, truncated, q in per_q:
+                matches = [self._render(tname, r, meta, q, res, qcol)
+                           for tname, r, meta in rows]
+                entry = {"matches": matches}
+                if truncated:
+                    entry["truncated"] = True
+                results.append(entry)
+            item.result = {"results": results, "batched": total,
+                           "set_shift": set_shift}
+            if self._t_duration is not None:
+                self._t_duration.observe(dur)
+            item.done.set()
+
+    def _plan(self, index: NameIndex, batch: List[_Item]):
+        """Resolve every query in the batch against one name index:
+        per-item render plans, the deduped per-table slot gathers, and
+        the union quantile vector."""
+        need: Dict[str, List[int]] = {t: [] for t in COUNT_TABLES}
+        rowof: Dict[Tuple[str, int], int] = {}
+        plans = []   # [(item, [(rows, truncated, q), ...])]
+        union_qs = set()
+        for item in batch:
+            per_q = []
+            for q in item.queries:
+                ms = self._resolve(index, q)
+                truncated = len(ms) > _MAX_MATCHES
+                if truncated:
+                    ms = ms[:_MAX_MATCHES]
+                rows = []
+                histo_hit = False
+                for tname, pos, slot, meta in ms:
+                    key = (tname, pos)
+                    r = rowof.get(key)
+                    if r is None:
+                        r = len(need[tname])
+                        rowof[key] = r
+                        need[tname].append(slot)
+                    rows.append((tname, r, meta))
+                    histo_hit = histo_hit or tname == "histo"
+                if histo_hit:
+                    union_qs.update(q["quantiles"] or _DEFAULT_QS)
+                per_q.append((rows, truncated, q))
+            plans.append((item, per_q))
+        return plans, need, union_qs
+
+    def _build_inputs(self, need, union_qs):
+        """Slot gathers + union quantiles -> the flush program's packed
+        input buffer and static shape arguments (layout knowledge lives
+        with the flush program in aggregation/step.py)."""
+        from veneur_tpu.aggregation.step import pack_query_inputs
+        return pack_query_inputs(
+            self.spec, [need[t] for t in COUNT_TABLES], union_qs)
+
+    def _materialize(self, packed, n_q, buckets, set_shift):
+        """ENGINE-thread finish: sampled device sync, host transfer,
+        unpack, residual fold, live set-shift correction."""
+        from veneur_tpu.aggregation.step import (combine_flush_scalars,
+                                                 flush_live_shapes,
+                                                 unpack_flush)
+        self._sync.tick(packed)
+        out = unpack_flush(
+            np.asarray(packed),
+            flush_live_shapes(self.spec, *buckets, n_q))
+        if self._c_batched is not None:
+            self._c_batched.inc()
+        res = combine_flush_scalars(out)
+        # live-interval set estimates: the degrade ladder's sampling
+        # shift has not been latched yet, so apply 2^active_set_shift
+        # here — the same correction server._do_flush applies post-swap
+        if set_shift:
+            res = dict(res)
+            res["set_estimate"] = (res["set_estimate"]
+                                   * float(1 << set_shift))
+        return res
+
+    def _plan_and_evaluate(self, batch: List[_Item]):
+        """Two-visit default: snapshot + off-thread resolution, then a
+        pipeline-dispatched launch (if anything matched)."""
+        snap = self._snapshot()
+        index = self._index_for(snap)
+        plans, need, union_qs = self._plan(index, batch)
+        if not any(need[t] for t in COUNT_TABLES):
+            return plans, None, {}, snap.set_shift
+        inputs, n_q, buckets, qcol = self._build_inputs(need, union_qs)
+        call = PipelineCall(lambda agg: self._launch_on_pipeline(
+            agg, snap.table, inputs, n_q, buckets))
+        self._pipeline_put(call)
+        if not call.wait(_SNAPSHOT_TIMEOUT_S):
+            raise RuntimeError("query launch timed out")
+        if not call.ok:
+            if isinstance(call.exc, _IntervalRolled):
+                raise call.exc
+            raise RuntimeError(call.detail or "query launch failed")
+        packed, set_shift = call.result
+        res = self._materialize(packed, n_q, buckets, set_shift)
+        return plans, res, qcol, set_shift
+
+    def _evaluate_atomic(self, batch: List[_Item]):
+        """Escalation path: snapshot, resolution, and launch dispatch
+        in ONE pipeline visit — immune to interval rolls because swap()
+        runs on the same thread and cannot interleave."""
+        from veneur_tpu.query.snapshot import _META_KIND, QuerySnapshot
+
+        def fn(agg):
+            state, table, set_shift = agg.query_snapshot()
+            metas = {t: table.get_meta(_META_KIND[t])
+                     for t in COUNT_TABLES}
+            counts = {t: len(metas[t]) for t in COUNT_TABLES}
+            snap = QuerySnapshot(table=table, metas=metas, counts=counts,
+                                 set_shift=int(set_shift))
+            index = self._index_for(snap)
+            plans, need, union_qs = self._plan(index, batch)
+            if not any(need[t] for t in COUNT_TABLES):
+                return plans, None, None, snap.set_shift
+            inputs, n_q, buckets, qcol = self._build_inputs(
+                need, union_qs)
+            flat = agg.query_flat_state(state)
+            packed = self._launch(flat, inputs, n_q, buckets)
+            return plans, packed, (n_q, buckets, qcol), snap.set_shift
+
+        call = PipelineCall(fn)
+        self._pipeline_put(call)
+        if not call.wait(_SNAPSHOT_TIMEOUT_S):
+            raise RuntimeError("query launch timed out")
+        if not call.ok:
+            raise RuntimeError(call.detail or "query launch failed")
+        plans, packed, shape, set_shift = call.result
+        if packed is None:
+            return plans, None, {}, set_shift
+        n_q, buckets, qcol = shape
+        res = self._materialize(packed, n_q, buckets, set_shift)
+        return plans, res, qcol, set_shift
+
+    def _pipeline_put(self, item) -> None:
+        try:
+            self._server.packet_queue.put(item, timeout=1.0)
+        except queue_mod.Full:
+            raise RuntimeError("pipeline backlogged; query not scheduled")
+
+    # -- response assembly ---------------------------------------------------
+    @staticmethod
+    def _f(v):
+        v = float(v)
+        return v if np.isfinite(v) else None
+
+    def _render(self, tname: str, r: int, meta, q: dict, res, qcol) -> dict:
+        out = {"name": meta.name, "kind": meta.kind,
+               "tags": list(meta.tags)}
+        if tname == "counter":
+            out["value"] = self._f(res["counter"][r])
+        elif tname == "gauge":
+            out["value"] = self._f(res["gauge"][r])
+        elif tname == "status":
+            out["value"] = self._f(res["status"][r])
+            out["message"] = getattr(meta, "message", "") or ""
+        elif tname == "set":
+            out["estimate"] = self._f(res["set_estimate"][r])
+        else:
+            qs = q["quantiles"] or _DEFAULT_QS
+            out["quantiles"] = {str(float(v)):
+                                self._f(res["histo_quantiles"][r, qcol[v]])
+                                for v in qs}
+            out["median"] = self._f(res["histo_median"][r])
+            out["min"] = self._f(res["histo_min"][r])
+            out["max"] = self._f(res["histo_max"][r])
+            out["count"] = self._f(res["histo_count"][r])
+            out["sum"] = self._f(res["histo_sum"][r])
+            out["avg"] = self._f(res["histo_avg"][r])
+            out["hmean"] = self._f(res["histo_hmean"][r])
+        return out

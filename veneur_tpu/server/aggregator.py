@@ -409,6 +409,27 @@ class Aggregator:
         self._latch_degrade()
         return state, table
 
+    # -- query tier ---------------------------------------------------------
+    def query_snapshot(self):
+        """Pipeline-thread-only: a coherent read view of the LIVE
+        interval for the query tier (veneur_tpu/query/) — swap()'s
+        staging drain (batcher emit + packed-HLL import fold) WITHOUT
+        the detach. Every sample admitted before this call is folded
+        into the returned state; JAX immutability makes the returned
+        reference a frozen snapshot while ingest keeps replacing
+        self.state underneath. Returns (state, table, active_set_shift)
+        — the LIVE shift, because the latched-shift correction the
+        flush applies has not happened yet for this interval."""
+        self.batcher.emit()
+        while self._hll_slots:
+            self._flush_hll_imports()
+        return self.state, self.table, self.active_set_shift
+
+    def query_flat_state(self, state):
+        """Query-tier state view with flat [rows, ...] leading dims;
+        the single-device layout already is one."""
+        return state
+
     def compute_flush(self, state, table, percentiles: List[float],
                       want_raw: bool = False
                       ) -> Tuple[Dict[str, np.ndarray], KeyTable]:

@@ -92,12 +92,25 @@ def start_http_server(server, address) -> "http.server.ThreadingHTTPServer":
             self.end_headers()
             self.wfile.write(body)
 
+        def _shutdown_gate(self) -> bool:
+            """The ONE shutdown gate for every stateful endpoint
+            (/stats, /healthz, /readyz, /query, /debug/profile): a
+            tearing-down server must answer 503, not hang — these
+            handlers read aggregator/device state that shutdown is
+            concurrently draining. Returns True when it replied."""
+            if server._shutdown.is_set():
+                self._reply(503, b"shutting down")
+                return True
+            return False
+
         def do_GET(self):
             if self.path == "/healthcheck":
                 self._reply(200, b"ok")
             elif self.path == "/healthz":
                 # liveness: restart-worthy failures only (README
                 # §Overload & health) — a SHEDDING server is still live
+                if self._shutdown_gate():
+                    return
                 from veneur_tpu.server.health import check_live
                 ok, detail = check_live(server)
                 self._reply(200 if ok else 503,
@@ -105,6 +118,8 @@ def start_http_server(server, address) -> "http.server.ThreadingHTTPServer":
                             "application/json")
             elif self.path == "/readyz":
                 # readiness: should peers send NEW traffic here?
+                if self._shutdown_gate():
+                    return
                 from veneur_tpu.server.health import check_ready
                 ok, detail = check_ready(server)
                 self._reply(200 if ok else 503,
@@ -119,11 +134,7 @@ def start_http_server(server, address) -> "http.server.ThreadingHTTPServer":
             elif self.path == "/builddate":
                 self._reply(200, BUILD_DATE.encode())
             elif self.path == "/stats":
-                # a tearing-down server must answer, not hang: the
-                # registry collectors read aggregator/device state that
-                # shutdown is concurrently draining
-                if server._shutdown.is_set():
-                    self._reply(503, b"shutting down")
+                if self._shutdown_gate():
                     return
                 body = json.dumps({
                     "packets_received": server.packets_received,
@@ -182,8 +193,7 @@ def start_http_server(server, address) -> "http.server.ThreadingHTTPServer":
                 if parsed.path != "/debug/profile":
                     self._reply(404, b"not found")
                     return
-                if server._shutdown.is_set():
-                    self._reply(503, b"shutting down")
+                if self._shutdown_gate():
                     return
                 if not getattr(server.cfg, "profile_capture_enabled",
                                False):
@@ -235,10 +245,57 @@ def start_http_server(server, address) -> "http.server.ThreadingHTTPServer":
                     self._handle_import()
                 finally:
                     req_span.client_finish(server.trace_client)
+            elif self.path == "/query":
+                self._handle_query()
             elif self.path == "/quitquitquit" and server.cfg.http_quit:
                 self._quit()
             else:
                 self._reply(404, b"not found")
+
+        def _handle_query(self):
+            """Batched read API (README §Query tier): answer quantile /
+            cardinality / counter reads from resident device state.
+            Ordering mirrors /import: shutdown gate first, then the
+            config gate (an unaware deployment exposes nothing), then
+            the CRITICAL shed — reads are the FIRST load to drop when
+            the flush path is fighting for the device."""
+            if self._shutdown_gate():
+                return
+            engine = server.query_engine
+            if engine is None:
+                self._reply(404, b"query_enabled is off")
+                return
+            if server._overload is not None:
+                from veneur_tpu.reliability.overload import CRITICAL
+                if server._overload.state >= CRITICAL:
+                    # exact drop accounting: one inc per refused request
+                    server._c_query_shed.inc()
+                    self._reply(503, b"overloaded: query shed")
+                    return
+            length = int(self.headers.get("Content-Length", "0"))
+            body = self.rfile.read(length)
+            if not body.strip():
+                self._reply(400, b"Received empty /query request")
+                return
+            try:
+                req = json.loads(body)
+            except ValueError:
+                self._reply(400, b"bad JSON body")
+                return
+            from veneur_tpu.query import QueryError
+            try:
+                out = engine.submit(req)
+            except QueryError as e:
+                self._reply(400, str(e).encode())
+                return
+            except (TimeoutError, RuntimeError) as e:
+                # batcher backlogged / pipeline wedged: tell the
+                # dashboard to back off, same contract as import shed
+                server._c_query_shed.inc()
+                self._reply(503, str(e).encode())
+                return
+            self._reply(200, json.dumps(out).encode(),
+                        "application/json")
 
         def _import_error(self, cause: str) -> None:
             """README §Monitoring: veneur.import.request_error_total

@@ -485,6 +485,16 @@ class NativeAggregator(Aggregator):
             self.eng.rings_resume()
         return state, detached
 
+    def query_snapshot(self):
+        """Live snapshot: emit natively staged rows first. Rings are NOT
+        paused — nothing resets here, so datagrams parsed after this
+        instant simply land after the snapshot (the ring-path analogue
+        of packet-queue FIFO ordering)."""
+        if self.eng.n_rings:
+            self._emit_rings()
+        self._emit_native()
+        return super().query_snapshot()
+
 
 class NativeShardedAggregator(ShardedAggregator):
     """Mesh-sharded backend fed by the C++ parse/key/stage engine.
@@ -722,3 +732,11 @@ class NativeShardedAggregator(ShardedAggregator):
         if rings:
             self.eng.rings_resume()
         return state, detached
+
+    def query_snapshot(self):
+        """See NativeAggregator.query_snapshot — same discipline over
+        the per-shard staging batchers."""
+        if self.eng.n_rings:
+            self._emit_rings()
+        self._emit_native()
+        return super().query_snapshot()

@@ -37,6 +37,7 @@ from veneur_tpu.samplers import parser, ssf_samples
 from veneur_tpu.samplers.intermetric import InterMetric
 from veneur_tpu.sinks.base import ResilientSink, dispatch_flush
 from veneur_tpu.trace.client import report_one
+from veneur_tpu.query.snapshot import PipelineRequest
 from veneur_tpu.server.aggregator import Aggregator
 from veneur_tpu.server.flusher import generate_intermetrics
 
@@ -393,6 +394,24 @@ class Server:
         self._c_tcp_idle_closed = M.counter(
             "veneur.tcp.idle_closed_total",
             "TCP statsd connections closed at the idle deadline")
+        # on-device query tier (veneur_tpu/query/) — registered even
+        # with the tier off so the inventory is stable
+        self._c_query_requests = M.counter(
+            "veneur.query.requests_total",
+            "individual queries accepted by POST /query (one request "
+            "body may carry several)")
+        self._c_query_batched = M.counter(
+            "veneur.query.batched_launches_total",
+            "device launches the query batcher coalesced concurrent "
+            "reads into")
+        self._c_query_shed = M.counter(
+            "veneur.query.shed_total",
+            "queries shed with 503: overload CRITICAL or shutdown "
+            "(exact drop accounting — one inc per refused request)")
+        self._t_query = M.timer(
+            "veneur.query.duration_ns",
+            "end-to-end batched query service time: snapshot round-trip "
+            "+ device launch + response assembly")
         jaxruntime.install()
         # h2d_bytes high-water at the last flush report, for per-interval
         # byte tags on the flush trace (flush worker thread only)
@@ -555,6 +574,17 @@ class Server:
         self.grpc_port = None
         self._httpd = None
         self.http_port = None
+        # -- on-device query tier (veneur_tpu/query/) ---------------------
+        # Off by default: no batcher thread, POST /query answers 404.
+        self.query_engine = None
+        if cfg.query_enabled:
+            from veneur_tpu.query import QueryEngine
+            self.query_engine = QueryEngine(
+                self, max_batch=cfg.query_max_batch,
+                timeout_ms=cfg.query_timeout_ms,
+                requests=self._c_query_requests,
+                batched=self._c_query_batched,
+                duration=self._t_query)
         # last: every attribute a collector closes over now exists
         self._register_collectors()
 
@@ -1070,12 +1100,18 @@ class Server:
             self._c_internal_errors.inc()
             log.exception("pipeline item failed (server continues); "
                           "item=%r", type(item).__name__)
-            if isinstance(item, FlushRequest):
+            if isinstance(item, (FlushRequest, PipelineRequest)):
                 item.finish(False, f"internal error: {e}")
 
     def _dispatch_item_inner(self, item):
         if isinstance(item, FlushRequest):
             self._handle_flush_request(item)
+        elif isinstance(item, PipelineRequest):
+            # query-tier snapshot/launch visits: FIFO position in this
+            # queue is exactly the read-your-writes boundary, and a
+            # launch dispatched here precedes any later donating ingest
+            # step (veneur_tpu/query/snapshot.py)
+            item.run(self.aggregator)
         elif isinstance(item, _ImportBytes):
             t0 = time.perf_counter_ns()
             n, errs = self.aggregator.import_pb_bytes(bytes(item))
@@ -2990,6 +3026,11 @@ class Server:
             self._httpd.server_close()  # release the listening fd
         if self._grpc_server is not None:
             self._grpc_server.stop(grace=1.0)
+        if self.query_engine is not None:
+            # before _STOP: the batcher thread enqueues snapshot/launch
+            # requests on packet_queue; one racing in behind _STOP
+            # would never run
+            self.query_engine.close()
         self.packet_queue.put(_STOP)
         # drain order matters: the pipeline thread may still enqueue a final
         # flush job; only after it exits is it safe to stop the flush worker

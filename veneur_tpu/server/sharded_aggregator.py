@@ -360,6 +360,25 @@ class ShardedAggregator(Aggregator):
         self._latch_degrade()
         return state, table
 
+    # -- query tier ---------------------------------------------------------
+    def query_snapshot(self):
+        """Pipeline-thread-only live-interval snapshot (see
+        Aggregator.query_snapshot): drain every shard's staging batcher
+        and the packed-HLL import queue, then capture references."""
+        self._emit_all()
+        self._apply_hll_imports()
+        return self.state, self.table, self.active_set_shift
+
+    def query_flat_state(self, state):
+        """[R=1, S, rows, ...] -> flat [S*rows, ...] views (free
+        reshapes, no copy): the KeyTable's global slot numbers ARE flat
+        indices into the shard-major layout by construction (slot =
+        shard * per_shard + local), so a query gather addresses — and
+        moves — only the owner shard's rows."""
+        import jax
+        return jax.tree.map(lambda x: x.reshape((-1,) + x.shape[3:]),
+                            state)
+
     def compute_flush(self, state, table, percentiles,
                       want_raw: bool = False):
         import jax.numpy as jnp
