@@ -392,10 +392,11 @@ def main():
             # ingest, 4: global merge, 9: exactly-once under ack loss):
             # under the wall-clock guard the TAIL gets truncated, never
             # the head
-            out["e2e"] = e2e.main(configs=[2, 1, 4, 9, 10, 11, 3, 5, 6, 7, 8],
-                                  scale=scale,
-                                  force_cpu=on_cpu, on_result=on_result,
-                                  deadline=T0 + guard - 45.0)
+            out["e2e"] = e2e.main(
+                configs=[2, 1, 4, 9, 10, 11, 12, 3, 5, 6, 7, 8],
+                scale=scale,
+                force_cpu=on_cpu, on_result=on_result,
+                deadline=T0 + guard - 45.0)
             cfg2 = next((r for r in out["e2e"] if r.get("config") == 2), None)
             if cfg2 and "samples_per_sec" in cfg2:
                 out["e2e_samples_per_sec"] = cfg2["samples_per_sec"]
@@ -423,6 +424,15 @@ def main():
                     - cfg4["merged_p99_err_max"]
                 cfg11["p99_err_delta_vs_config4"] = round(delta, 5)
                 cfg11["p99_within_config4_bound"] = delta <= 2e-3
+            # config 12 headline: the resize transition bound — the
+            # slowest steady-state swap-to-transfer-done wall time, the
+            # number README §Elasticity promises stays under one flush
+            # interval
+            cfg12 = next((r for r in out["e2e"] if r.get("config") == 12),
+                         None)
+            if cfg12 and cfg12.get("transition_seconds"):
+                out["e2e_reshard_transition_seconds"] = max(
+                    cfg12["transition_seconds"])
         except Exception as e:  # bench must still print its line
             out["e2e_error"] = f"{type(e).__name__}: {e}"
 

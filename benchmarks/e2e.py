@@ -1535,12 +1535,208 @@ def config11_collective_merge(scale=1.0):
         glob.shutdown()
 
 
+def config12_elastic_resize(scale=1.0):
+    """Elastic live resharding under fire (README §Elasticity): resize
+    the mesh 4→8→2 while producers keep feeding and the query tier keeps
+    answering. Three passes over the SAME seeded storm: a static 4-shard
+    reference, an elastic pass with a forced receiver crash mid-transfer
+    (cycle 0 — absorbs the resize-path compiles AND proves epoch-replay
+    recovery), and a steady-state elastic pass whose swap-to-done
+    transition times gate the one-flush-interval bound. Acceptance, all
+    booleans: final counters byte-exact vs static (timers 1e-6), every
+    packet accounted (sent == admitted + shed, exact), the crash pass
+    recovers with replays counted and duplicates suppressed (no
+    double-count — exactness is the proof), queries stay 200 throughout,
+    and the steady transitions fit one production flush interval. The
+    two wall-clock gates — transition bound and query-200 — arm on TPU
+    only: on the CPU smoke the resize's compute_flush pays fresh XLA
+    size-bucket compiles (tens of seconds) inside the measured window,
+    which stalls the pipeline past the query snapshot deadline too; both
+    raw measurements are reported either way."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    import jax
+
+    from veneur_tpu.reliability.faults import FAULTS, RESHARD_FOLD
+    from veneur_tpu.sinks.debug import DebugMetricSink
+
+    n_counter = max(64, int(2048 * scale))
+    n_timer = max(32, int(512 * scale))
+    n_set_names = max(8, int(64 * scale))
+    set_members = 40
+    interval_s = 10.0     # the production flush cadence the bound gates
+
+    caps = dict(tpu_counter_capacity=1 << 13, tpu_gauge_capacity=256,
+                tpu_set_capacity=1 << 10, tpu_histo_capacity=1 << 10,
+                tpu_batch_counter=1 << 13, tpu_batch_histo=1 << 13,
+                tpu_batch_set=1 << 12)
+
+    def build_segment(seg):
+        rng = np.random.default_rng(1200 + seg)
+        per, payloads, lines = 100, [], []
+
+        def put(ln):
+            lines.append(ln)
+            if len(lines) >= per:
+                payloads.append(b"\n".join(lines))
+                del lines[:]
+
+        for i in range(n_counter):
+            put(b"el.c%d:%d|c" % (i, 10007 + 3 * i + seg))
+        put(b"el.g:%d|g" % (10 + seg))
+        for v in rng.integers(1, 100000, n_timer):
+            put(b"el.t:%d|ms" % v)
+        for s in range(n_set_names):
+            for j in range(set_members):
+                put(b"el.s%d:m%d-%d|s" % (s, seg, j))
+        if lines:
+            payloads.append(b"\n".join(lines))
+        samples = n_counter + 1 + n_timer + n_set_names * set_members
+        return payloads, samples
+
+    segments = [build_segment(s) for s in range(3)]
+
+    def run_pass(elastic, crash=False, tag=""):
+        sink = DebugMetricSink()
+        srv = _mk_server([sink], native_ingest=False, tpu_n_shards=4,
+                         overload_enabled=True,
+                         http_address="127.0.0.1:0", query_enabled=True,
+                         reshard_enabled=elastic,
+                         reshard_transfer_timeout_s=WARM_TIMEOUT, **caps)
+        summaries, q_codes, q_stale = [], [], 0
+        try:
+            _warm(srv, [b"el.c0:0|c", b"el.t:1|ms", b"el.s0:w|s"],
+                  sinks=[sink])
+            ov = srv._overload
+            adm0, shed0 = dict(ov.admitted), dict(ov.shed)
+            sent_pkts = 0
+            port = srv.http_port
+            q_stop = threading.Event()
+
+            def poll_queries():
+                nonlocal q_stale
+                body = _json.dumps({"name": "el.c0"}).encode()
+                while not q_stop.is_set():
+                    req = urllib.request.Request(
+                        f"http://127.0.0.1:{port}/query", data=body,
+                        headers={"Content-Type": "application/json"})
+                    try:
+                        with urllib.request.urlopen(req, timeout=30) as r:
+                            q_codes.append(r.status)
+                            if _json.loads(r.read()).get("stale_bounded"):
+                                q_stale += 1
+                    except urllib.error.HTTPError as e:
+                        q_codes.append(e.code)
+                    except OSError:
+                        q_codes.append(-1)   # transport-level failure
+                    q_stop.wait(0.1)
+
+            poller = threading.Thread(target=poll_queries, daemon=True)
+            poller.start()
+            processed0 = srv.aggregator.processed
+            want = processed0
+            for seg, (payloads, samples) in enumerate(segments):
+                # the resize runs while this segment's packets are still
+                # landing: the feeder thread races the swap + transfer
+                feeder = threading.Thread(
+                    target=_feed_queue, args=(srv, payloads), daemon=True)
+                feeder.start()
+                sent_pkts += len(payloads)
+                if elastic and seg < 2:
+                    if crash and seg == 1:
+                        FAULTS.arm(RESHARD_FOLD, error=True, times=1)
+                    phase(f"resize{tag}_{seg}")
+                    summaries.append(srv.trigger_reshard(
+                        (8, 2)[seg], timeout=WARM_TIMEOUT))
+                feeder.join()
+                want += samples
+                _drain(srv, want)
+            phase(f"final_flush{tag}")
+            _flush_checked(srv, timeout=WARM_TIMEOUT)
+            q_stop.set()
+            poller.join()
+            adm = sum(ov.admitted.values()) - sum(adm0.values())
+            shed_d = {k: v - shed0.get(k, 0) for k, v in ov.shed.items()}
+            shed_d.pop("flush", None)
+            shed = sum(shed_d.values())
+            rows = {m.name: m.value for m in sink.flushed
+                    if not m.name.startswith(("veneur.", "ssf.", "warm."))}
+            return {
+                "rows": rows, "summaries": summaries,
+                "accounting_exact": adm + shed == sent_pkts,
+                "shed": shed,
+                "query_codes": q_codes, "query_stale": q_stale,
+            }
+        finally:
+            FAULTS.reset()
+            srv.shutdown()
+
+    def rows_equal(ref, got):
+        if set(ref) != set(got):
+            return False
+        for name, want in ref.items():
+            if ".t." in name and "percentile" in name:
+                if abs(got[name] - want) > 1e-6 * max(1.0, abs(want)):
+                    return False
+            elif got[name] != want:
+                return False
+        return True
+
+    phase("static_reference")
+    static = run_pass(elastic=False, tag="_static")
+
+    phase("elastic_crash")       # cycle 0: compiles + crash recovery
+    crashed = run_pass(elastic=True, crash=True, tag="_crash")
+
+    phase("elastic_steady")      # cycle 1: timed transitions
+    steady = run_pass(elastic=True, tag="_steady")
+
+    crash_sums = crashed["summaries"]
+    steady_sums = steady["summaries"]
+    transitions = [s["duration_ns"] / 1e9 for s in steady_sums]
+    all_q = static["query_codes"] + crashed["query_codes"] \
+        + steady["query_codes"]
+    non200 = sum(1 for c in all_q if c != 200)
+    moved = sum(s["rows_moved"] for s in steady_sums)
+    on_tpu = jax.default_backend() == "tpu"
+    return {
+        "config": 12, "name": "elastic_resize",
+        "resize_plan": [s["plan"] for s in steady_sums],
+        "storm_samples": 3 * segments[0][1],
+        "rows_flushed": len(static["rows"]),
+        "rows_moved": int(moved),
+        "moved_any": moved > 0,
+        "steady_byte_exact": rows_equal(static["rows"], steady["rows"]),
+        "crash_byte_exact": rows_equal(static["rows"], crashed["rows"]),
+        "accounting_exact": bool(static["accounting_exact"]
+                                 and crashed["accounting_exact"]
+                                 and steady["accounting_exact"]),
+        "shed_packets": static["shed"] + crashed["shed"] + steady["shed"],
+        "crash_replayed": crash_sums[1]["replays"] >= 1,
+        "crash_dup_suppressed": crash_sums[1]["dup_suppressed"] >= 1,
+        "crash_recovered": not any(s["failed"] for s in crash_sums),
+        "query_probes": len(all_q),
+        "query_non200_probes": non200,
+        "query_stale_bounded_observed": crashed["query_stale"]
+        + steady["query_stale"],
+        "transition_seconds": [round(t, 3) for t in transitions],
+        "on_chip_gate_transition_armed": on_tpu,
+        "query_all_200": (bool(all_q) and non200 == 0) if on_tpu
+        else None,
+        "transition_within_interval": (bool(transitions)
+                                       and max(transitions) <= interval_s)
+        if on_tpu else None,
+    }
+
+
 CONFIGS = {1: config1_counter_replay, 2: config2_zipf_timers,
            3: config3_set_cardinality, 4: config4_global_merge,
            5: config5_span_firehose, 6: config6_cardinality_stress,
            7: config7_checkpoint_restore, 8: config8_overload_storm,
            9: config9_duplicate_storm, 10: config10_wire_to_flush_firehose,
-           11: config11_collective_merge}
+           11: config11_collective_merge, 12: config12_elastic_resize}
 
 # Per-config subprocess budget: backend init + first XLA compiles of the
 # config's size buckets (~tens of seconds each on the tunneled chip) +

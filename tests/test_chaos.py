@@ -22,7 +22,7 @@ import pytest
 from tests.test_server import (_send_udp, _wait_processed, _wait_until,
                                by_name, small_config)
 from veneur_tpu.reliability.faults import (FAULTS, FLUSH_WORKER,
-                                           SINK_FLUSH)
+                                           RESHARD_FOLD, SINK_FLUSH)
 from veneur_tpu.reliability.policy import OPEN
 from veneur_tpu.server.server import Server
 from veneur_tpu.sinks.base import MetricSink
@@ -414,3 +414,73 @@ def test_kill_restart_ack_loss_global_counters_byte_exact(backend_kw,
     finally:
         local2.shutdown()
         glob.shutdown()
+
+
+# -- elastic resharding chaos (veneur_tpu/reshard/) --------------------------
+
+def _elastic_run(resizes, crash_on=()):
+    """Feed three _kr parts with live resizes interleaved between them,
+    flush once at the end; returns (flushed metric map, resize
+    summaries, accounting tuple)."""
+    sink = DebugMetricSink()
+    # interval long enough that no periodic flush lands mid-drill: the
+    # only flush is the final trigger_flush, so the sink sees one total
+    srv = Server(small_config(reshard_enabled=True, native_ingest=False,
+                              tpu_n_shards=4, overload_enabled=True,
+                              interval="600s"),
+                 metric_sinks=[sink])
+    srv.start()
+    summaries = []
+    try:
+        sent = 0
+        for i in range(3):          # one datagram per part
+            _kr_feed(srv, i, (i + 1) * _KR_PER_PART)
+            sent += 1
+            if i < len(resizes):    # resize while later parts still come
+                if i in crash_on:
+                    FAULTS.arm(RESHARD_FOLD, error=True, times=1)
+                summaries.append(
+                    srv.trigger_reshard(resizes[i], timeout=300))
+        assert srv.trigger_flush(timeout=300)
+        admitted = srv._overload.admitted_total
+        shed = sum(n for _tags, n in srv._overload.shed_snapshot())
+    finally:
+        srv.shutdown()
+    return by_name(m for m in sink.flushed
+                   if not m.name.startswith(("veneur.", "ssf."))), \
+        summaries, (sent, admitted, shed)
+
+
+@pytest.mark.slow
+def test_elastic_resize_under_fire():
+    """The resize drill: grow 4->8 and shrink 8->2 with traffic landing
+    before, between, and after the swaps. The final flush must be
+    byte-exact against a static 4-shard run of the same seeded feed,
+    every admitted sample accounted (sent == admitted + shed, shed == 0
+    here), and the coordinator's books balanced."""
+    ref, _, (r_sent, r_adm, r_shed) = _elastic_run([])
+    got, summaries, (sent, admitted, shed) = _elastic_run([8, 2])
+    assert sent == admitted + shed and shed == 0
+    assert (sent, admitted, shed) == (r_sent, r_adm, r_shed)
+    _kr_assert_equal(ref, got)
+    for s in summaries:
+        assert not s["failed"] and s["replays"] == 0
+        assert s["rows_moved"] > 0
+
+
+@pytest.mark.slow
+def test_elastic_resize_receiver_crash_mid_transfer():
+    """A fold fault (receiver dies after folding a migration unit,
+    before progress is recorded) during the growth step: the epoch
+    replay must suppress the folded unit as DUPLICATE and the final
+    flush stays byte-exact — no double-count, no loss."""
+    ref, _, _ = _elastic_run([])
+    got, summaries, (sent, admitted, shed) = _elastic_run(
+        [8, 2], crash_on={0})
+    assert sent == admitted + shed and shed == 0
+    _kr_assert_equal(ref, got)
+    crashed, clean = summaries
+    assert not crashed["failed"]
+    assert crashed["replays"] == 1 and crashed["dup_suppressed"] >= 1
+    assert not clean["failed"] and clean["replays"] == 0
+    assert FAULTS.fired(RESHARD_FOLD) == 1

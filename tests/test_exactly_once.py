@@ -80,6 +80,57 @@ def test_window_epochs_are_independent_streams():
     assert w.observe(Envelope(SID, 0, 0)) == DUPLICATE
 
 
+def test_window_migration_epoch_replay_is_duplicate():
+    """Satellite: reshard migration units ride the same dedup machinery.
+    Each resize attempt is its own epoch and the unit seq is the
+    destination shard id; when the receiver crashes after folding a unit
+    but before recording progress, the coordinator replays the WHOLE
+    epoch — already-folded units must come back DUPLICATE (suppressed),
+    never FRESH (double-fold)."""
+    mover = mint_source_id()
+    w = DedupWindow(window=256)
+    # resize attempt #0 folds shards 0..3, crashes after shard 1
+    for dest in (0, 1):
+        assert w.observe(Envelope(mover, 0, dest)) == FRESH
+    # full-epoch replay: folded units suppressed, the rest proceed
+    assert w.observe(Envelope(mover, 0, 0)) == DUPLICATE
+    assert w.observe(Envelope(mover, 0, 1)) == DUPLICATE
+    for dest in (2, 3):
+        assert w.observe(Envelope(mover, 0, dest)) == FRESH
+    # a NEW resize gets a NEW epoch: the same seqs are fresh again
+    for dest in (0, 1, 2, 3):
+        assert w.observe(Envelope(mover, 1, dest)) == FRESH
+
+
+@pytest.mark.slow
+def test_reshard_coordinator_bumps_epoch_per_resize():
+    """The live coordinator mints one source id for its lifetime and
+    bumps the epoch on every resize ATTEMPT (replays within an attempt
+    reuse it — that is what makes replay-after-crash deduplicatable)."""
+    from tests.test_server import small_config
+    from veneur_tpu.reliability.faults import RESHARD_FOLD
+
+    srv = Server(small_config(reshard_enabled=True, interval="600s",
+                              native_ingest=False, tpu_n_shards=4),
+                 metric_sinks=[DebugMetricSink()])
+    srv.start()
+    try:
+        _send_udp(srv.local_addr(), [b"ep.c:1|c", b"ep.g:2|g"])
+        _wait_processed(srv, 2)
+        assert srv.reshard._epoch == -1
+        s1 = srv.trigger_reshard(8, timeout=300)
+        assert srv.reshard._epoch == 0 and s1["epoch"] == 0
+        # crash mid-transfer: the replay stays inside epoch 1
+        FAULTS.arm(RESHARD_FOLD, error=True, times=1)
+        s2 = srv.trigger_reshard(2, timeout=300)
+        assert srv.reshard._epoch == 1 and s2["epoch"] == 1
+        assert s2["replays"] == 1 and s2["dup_suppressed"] >= 1
+        assert not s2["failed"]
+    finally:
+        FAULTS.reset()
+        srv.shutdown()
+
+
 def test_window_rejects_oversized_skip():
     w = DedupWindow(window=4, max_skip=16)
     with pytest.raises(EnvelopeError):

@@ -188,6 +188,85 @@ def test_roundtrip_across_backends(tmp_path):
     _assert_equivalent(ref_map, _result_map(*dst.flush(PERC)))
 
 
+@pytest.mark.slow
+def test_restore_onto_smaller_mesh(tmp_path):
+    """A snapshot written by an 8-shard mesh restores onto a 2-shard
+    mesh: fold_snapshot re-derives every row's owner from its routing
+    digest on the CURRENT topology, so the writer's layout never
+    constrains the restoring fleet (elastic shrink after a crash)."""
+    from veneur_tpu.server.sharded_aggregator import ShardedAggregator
+    spec = SPECS["medium"]
+    ref = ShardedAggregator(spec, BSPEC, n_shards=2)
+    _feed(ref, 0)
+    _feed(ref, 1)
+    ref_map = _result_map(*ref.flush(PERC))
+
+    big = _mk_agg("sharded", spec)           # 8 shards
+    _feed(big, 0)
+    snap = _snapshot_of(big, spec, agg_kind="sharded", n_shards=8)
+    d = tmp_path / "shrink"
+    d.mkdir()
+    encode_to_dir(str(d), snap)
+    loaded = load_dir(str(d))
+    assert loaded["n_shards"] == 8           # provenance preserved
+
+    small = ShardedAggregator(spec, BSPEC, n_shards=2)
+    folded = fold_snapshot(small, loaded)
+    assert folded == sum(len(v) for v in loaded["tables"].values())
+    _feed(small, 1)
+    _assert_equivalent(ref_map, _result_map(*small.flush(PERC)))
+
+
+@pytest.mark.slow
+def test_restore_onto_odd_shard_count(tmp_path):
+    """Shard counts are not constrained to powers of two: a snapshot
+    folds onto a 3-shard mesh when the capacities divide."""
+    from veneur_tpu.server.sharded_aggregator import ShardedAggregator
+    spec = TableSpec(counter_capacity=96, gauge_capacity=48,
+                     status_capacity=12, set_capacity=12,
+                     histo_capacity=48)
+    ref = ShardedAggregator(spec, BSPEC, n_shards=3)
+    _feed(ref, 0, n_timer=60)
+    _feed(ref, 1, n_timer=60)
+    ref_map = _result_map(*ref.flush(PERC))
+
+    src = Aggregator(spec, BSPEC)
+    _feed(src, 0, n_timer=60)
+    snap = _snapshot_of(src, spec, agg_kind="single", n_shards=1)
+    d = tmp_path / "odd"
+    d.mkdir()
+    encode_to_dir(str(d), snap)
+
+    dst = ShardedAggregator(spec, BSPEC, n_shards=3)
+    fold_snapshot(dst, load_dir(str(d)))
+    _feed(dst, 1, n_timer=60)
+    _assert_equivalent(ref_map, _result_map(*dst.flush(PERC)))
+
+
+def test_shard_capacity_divisibility_guard():
+    """A mesh whose capacities do not divide by the shard count must be
+    rejected up front (per_shard_spec), not fail during slot routing —
+    this is the same guard trigger_reshard() leans on to refuse a resize
+    to an incompatible topology."""
+    from veneur_tpu.server.sharded_aggregator import (ShardedAggregator,
+                                                      per_shard_spec)
+    spec = SPECS["medium"]          # status/set caps 16: 16 % 3 != 0
+    with pytest.raises(ValueError, match="positive multiple"):
+        per_shard_spec(spec, 3)
+    with pytest.raises(ValueError, match="positive multiple"):
+        ShardedAggregator(spec, BSPEC, n_shards=3)
+    # and a count larger than a capacity is "positive multiple" too
+    with pytest.raises(ValueError, match="positive multiple"):
+        per_shard_spec(spec, 32)
+    # the divisible counts pass and partition exactly
+    per3 = per_shard_spec(TableSpec(counter_capacity=96,
+                                    gauge_capacity=48,
+                                    status_capacity=12,
+                                    set_capacity=12,
+                                    histo_capacity=48), 3)
+    assert per3.counter_capacity == 32 and per3.set_capacity == 4
+
+
 # -- codec: rejection + quarantine ------------------------------------------
 
 def _write_ckpt(root: pathlib.Path, seq: int, snap) -> pathlib.Path:

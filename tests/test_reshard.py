@@ -1,0 +1,368 @@
+"""Elastic live resharding (veneur_tpu/reshard/): plan math, live
+resize equivalence against a static same-traffic run, crash-replay
+exactly-once, readyz phase pinning, guard rails, stale-bounded query
+marking, the HTTP control endpoint, and the proxy ring-rebuild
+regression (satellite 2)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tests.test_server import (_send_udp, _wait_processed, by_name,
+                               small_config)
+from veneur_tpu.collective.keytable import route_digest
+from veneur_tpu.persistence import fold_snapshot
+from veneur_tpu.reliability.faults import FAULTS, RESHARD_FOLD
+from veneur_tpu.reshard import ReshardError, ReshardPlan, key_moved, \
+    partition_units
+from veneur_tpu.reshard.plan import moved_fraction
+from veneur_tpu.server.server import Server
+from veneur_tpu.sinks.debug import DebugMetricSink
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    FAULTS.reset()
+    yield
+    FAULTS.reset()
+
+
+def _reshard_cfg(**kw):
+    defaults = dict(reshard_enabled=True, interval="600s")
+    defaults.update(kw)
+    return small_config(**defaults)
+
+
+# -- plan math ---------------------------------------------------------------
+
+def test_key_moved_is_pure_residue_math():
+    for d in (0, 1, 7, 12345, 0xFFFFFFFF):
+        for old_n, new_n in ((1, 4), (4, 8), (8, 2), (3, 5)):
+            assert key_moved(d, old_n, new_n) == \
+                ((d % old_n) != (d % new_n))
+            assert not key_moved(d, old_n, old_n)
+
+
+def test_moved_fraction_known_values():
+    # doubling: every odd residue of the larger modulus moves
+    assert moved_fraction(4, 8) == 0.5
+    # shrink 8->2: residues agree only when r%8 == r%2, i.e. r in {0,1,
+    # 8k alignment} — 2 of every 8
+    assert moved_fraction(8, 2) == 0.75
+    assert moved_fraction(2, 2) == 0.0
+    # coprime counts move almost everything but never 100%
+    assert 0.0 < moved_fraction(3, 5) < 1.0
+
+
+def test_plan_validates_counts():
+    with pytest.raises(ValueError):
+        ReshardPlan(0, 4)
+    with pytest.raises(ValueError):
+        ReshardPlan(4, -1)
+    assert ReshardPlan(4, 8).signature == "4->8"
+
+
+def _mini_snapshot():
+    """A hand-built two-kind snapshot in the persistence schema: three
+    counters and one gauge with known digests."""
+    import numpy as np
+    entries = []
+    for name in ("a", "b", "c"):
+        entries.append([name, [], 0, "", "", False, "counter", ""])
+    gauges = [["g1", ["k:v"], 0, "", "", False, "gauge", "k:v"]]
+    return {
+        "agg_kind": "single", "n_shards": 4,
+        "spec": {"hll_precision": 14},
+        "interval_ts": 1.0, "created_at": 1.0, "hostname": "t",
+        "tables": {"counter": entries, "gauge": gauges, "status": [],
+                   "set": [], "histo": []},
+        "arrays": {"counter": np.asarray([1.0, 2.0, 3.0]),
+                   "gauge": np.asarray([7.0], np.float32),
+                   "status": np.zeros(0, np.float32),
+                   "hll": np.zeros((0, 2), np.int32),
+                   "h_mean": np.zeros(0, np.float32),
+                   "h_weight": np.zeros(0, np.float32),
+                   "h_min": np.zeros(0, np.float32),
+                   "h_max": np.zeros(0, np.float32),
+                   "h_recip": np.zeros(0, np.float64)},
+    }
+
+
+def test_partition_units_routes_every_row_once():
+    snap = _mini_snapshot()
+    plan = ReshardPlan(4, 8)
+    units = partition_units(snap, plan)
+    total = sum(u["rows"] for u in units)
+    assert total == 4   # 3 counters + 1 gauge, each in exactly one unit
+    moved = sum(u["rows_moved"] for u in units)
+    # rows_moved counts ONLY rows whose owner changed under the plan
+    expect_moved = sum(
+        1 for name in ("a", "b", "c")
+        if key_moved(route_digest("counter", name, ""), 4, 8))
+    expect_moved += sum(
+        1 for _ in ("g1",)
+        if key_moved(route_digest("gauge", "g1", "k:v"), 4, 8))
+    assert moved == expect_moved
+    for u in units:
+        # unit seq is the destination shard: every row in the unit must
+        # route there under the NEW map
+        for kind, entries in u["tables"].items():
+            for e in entries:
+                d = route_digest(e[6], e[0], e[7] or ",".join(e[1]))
+                assert d % 8 == u["dest_shard"]
+        # the unit is a well-formed mini-snapshot: schema keys intact
+        for key in ("spec", "tables", "arrays", "agg_kind", "n_shards"):
+            assert key in u
+
+
+# -- live resize equivalence -------------------------------------------------
+
+def _feed_a(srv):
+    _send_udp(srv.local_addr(),
+              [f"rs.c{i % 6}:1|c".encode() for i in range(24)]
+              + [b"rs.g:5|g", b"rs.t:10|ms", b"rs.t:90|ms"]
+              + [f"rs.s:m{i}|s".encode() for i in range(10)])
+    _wait_processed(srv, 37)
+
+
+def _feed_b(srv, already):
+    _send_udp(srv.local_addr(),
+              [f"rs.c{i % 6}:2|c".encode() for i in range(12)]
+              + [b"rs.t:50|ms"]
+              + [f"rs.s:m{i}|s".encode() for i in range(5, 15)])
+    _wait_processed(srv, already + 23)
+
+
+def _run_resize(backend_kw, resizes, crash=False):
+    sink = DebugMetricSink()
+    srv = Server(_reshard_cfg(**backend_kw), metric_sinks=[sink])
+    srv.start()
+    summaries = []
+    try:
+        _feed_a(srv)
+        for n in resizes:
+            if crash:
+                FAULTS.arm(RESHARD_FOLD, error=True, times=1)
+            summaries.append(srv.trigger_reshard(n, timeout=300))
+        _feed_b(srv, 37)
+        assert srv.trigger_flush(timeout=300)
+    finally:
+        srv.shutdown()
+    rows = by_name(m for m in sink.flushed
+                   if not m.name.startswith(("veneur.", "ssf.")))
+    return rows, summaries, srv
+
+
+def _assert_same_rows(ref, got):
+    assert set(ref) == set(got)
+    for name in ref:
+        assert got[name].value == ref[name].value, name
+        assert got[name].tags == ref[name].tags, name
+
+
+@pytest.mark.parametrize("backend_kw",
+                         [{"native_ingest": False, "tpu_n_shards": 4}],
+                         ids=["python-sharded"])
+@pytest.mark.slow
+def test_live_resize_grow_shrink_equivalence(backend_kw):
+    """Resize 4->8->2 between two traffic phases: the final flush must
+    equal a static 4-shard run of the same traffic, and the coordinator
+    accounting must balance (every drained row folded exactly once)."""
+    ref, _, _ = _run_resize(backend_kw, [])
+    got, summaries, srv = _run_resize(backend_kw, [8, 2])
+    _assert_same_rows(ref, got)
+    for s in summaries:
+        assert not s["failed"]
+        assert s["dup_suppressed"] == 0
+        assert 0 < s["rows_moved"] <= s["rows_folded"]
+    assert srv.reshard.moves_total == 2
+    assert srv.reshard.failed_total == 0
+    assert srv._c_reshard_moves.value() == 2
+    assert srv._c_reshard_rows_moved.value() == \
+        sum(s["rows_moved"] for s in summaries)
+
+
+@pytest.mark.slow
+def test_live_resize_native_with_crash_replay():
+    """Native backend, engine reused across the rebuild; a fold fault
+    injected mid-transfer (receiver dies after folding, before progress
+    is recorded) forces an epoch replay — the replayed unit must come
+    back DUPLICATE (suppressed), and the flush must still be byte-exact
+    vs a static run: exactly-once, no double-count."""
+    ref, _, _ = _run_resize({"tpu_n_shards": 2}, [])
+    got, summaries, srv = _run_resize({"tpu_n_shards": 2}, [4],
+                                      crash=True)
+    assert srv._native, "native engine expected on this box"
+    _assert_same_rows(ref, got)
+    (s,) = summaries
+    assert not s["failed"]
+    assert s["replays"] == 1
+    assert s["dup_suppressed"] >= 1
+    assert FAULTS.fired(RESHARD_FOLD) == 1
+
+
+# -- readyz phase (satellite 1) ----------------------------------------------
+
+def test_readyz_phase_field_pins_lifecycle():
+    from veneur_tpu.server.health import check_ready
+    srv = Server(_reshard_cfg(overload_enabled=True),
+                 metric_sinks=[DebugMetricSink()])
+    try:
+        ok, detail = check_ready(srv)
+        assert ok and detail["phase"] == "ready"
+        # resharding: ready-but-announcing — ok stays True, phase flips
+        srv._resharding = True
+        ok, detail = check_ready(srv)
+        assert ok and detail["phase"] == "resharding"
+        srv._resharding = False
+        srv._overload.enter_resharding()
+        ok, detail = check_ready(srv)
+        assert ok and detail["phase"] == "resharding"
+        srv._overload.exit_resharding()
+        # restoring wins over everything and is NOT ready
+        srv._restore_complete = False
+        ok, detail = check_ready(srv)
+        assert not ok and detail["phase"] == "restoring"
+        srv._restore_complete = True
+        # draining wins over resharding (shutdown abandons a move)
+        srv._resharding = True
+        srv._shutdown.set()
+        _, detail = check_ready(srv)
+        assert detail["phase"] == "draining"
+    finally:
+        srv._shutdown.set()
+
+
+# -- guard rails -------------------------------------------------------------
+
+def test_resize_guard_rails():
+    srv = Server(_reshard_cfg(tpu_n_shards=4, native_ingest=False),
+                 metric_sinks=[DebugMetricSink()])
+    srv.start()
+    try:
+        with pytest.raises(ReshardError):       # no-op resize
+            srv.trigger_reshard(4)
+        with pytest.raises(ReshardError):       # 16 % 3 != 0 (set cap)
+            srv.trigger_reshard(3)
+        with pytest.raises(ReshardError):
+            srv.trigger_reshard(0)
+        assert srv.reshard.failed_total >= 1
+        assert srv.reshard.moves_total == 0
+    finally:
+        srv.shutdown()
+
+
+def test_reshard_disabled_has_no_coordinator():
+    srv = Server(small_config(), metric_sinks=[DebugMetricSink()])
+    try:
+        assert srv.reshard is None
+        assert srv.reshard_active is False
+        with pytest.raises(ReshardError):
+            srv.trigger_reshard(2)
+    finally:
+        srv._shutdown.set()
+
+
+# -- stale-bounded queries (query tier keeps answering) ----------------------
+
+def test_query_marked_stale_bounded_during_transfer():
+    from veneur_tpu.reshard.coordinator import _Transfer
+    sink = DebugMetricSink()
+    srv = Server(_reshard_cfg(query_enabled=True), metric_sinks=[sink])
+    srv.start()
+    try:
+        _send_udp(srv.local_addr(), [b"sq.c:3|c"])
+        _wait_processed(srv, 1)
+        out = srv.query_engine.submit({"name": "sq.c"})
+        assert "stale_bounded" not in out
+        # pin an in-flight transfer: reads stay served, marked, counted
+        srv.reshard._transfer = _Transfer(2, 0)
+        out = srv.query_engine.submit({"name": "sq.c"})
+        assert out["stale_bounded"] is True
+        assert out["results"][0]["matches"][0]["value"] == 3.0
+        assert srv._c_reshard_stale.value() == 1
+    finally:
+        srv.reshard._transfer = None
+        srv.shutdown()
+
+
+# -- HTTP control endpoint ---------------------------------------------------
+
+def _post_raw(port, path, data):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=data,
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=300) as resp:
+        return resp.status, resp.read()
+
+
+def test_post_reshard_endpoint():
+    sink = DebugMetricSink()
+    srv = Server(_reshard_cfg(http_address="127.0.0.1:0",
+                              native_ingest=False),
+                 metric_sinks=[sink])
+    srv.start()
+    try:
+        _send_udp(srv.local_addr(), [b"hr.c:1|c"])
+        _wait_processed(srv, 1)
+        code, raw = _post_raw(srv.http_port, "/reshard",
+                              json.dumps({"n_shards": 2}).encode())
+        assert code == 200
+        out = json.loads(raw)
+        assert out["plan"] == "1->2" and not out["failed"]
+        assert srv.aggregator.n_shards == 2
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post_raw(srv.http_port, "/reshard", b"not json")
+        assert ei.value.code == 400
+    finally:
+        srv.shutdown()
+
+
+def test_post_reshard_404_when_off():
+    srv = Server(small_config(http_address="127.0.0.1:0"),
+                 metric_sinks=[DebugMetricSink()])
+    srv.start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post_raw(srv.http_port, "/reshard",
+                      json.dumps({"n_shards": 2}).encode())
+        assert ei.value.code == 404
+    finally:
+        srv.shutdown()
+
+
+# -- proxy ring-rebuild regression (satellite 2) -----------------------------
+
+def test_proxy_ring_rebuilt_only_on_membership_change():
+    """refresh() used to rebuild the HashRing (and invalidate the
+    derived routing-ring cache keyed by id(base)) on EVERY poll; it must
+    rebuild only when the membership signature changes."""
+    from veneur_tpu.forward.proxysrv import ProxyServer
+
+    class Disc:
+        def __init__(self, dests):
+            self.dests = dests
+
+        def get_destinations_for_service(self, service):
+            return list(self.dests)
+
+    d = Disc(["b:1", "a:1"])
+    p = ProxyServer(d)
+    assert p.ring_rebuilds == 1          # the constructor's refresh()
+    ring0 = p._ring
+    for _ in range(5):
+        p.refresh()                      # same membership, any order
+        d.dests = ["a:1", "b:1"]
+    assert p.ring_rebuilds == 1
+    assert p._ring is ring0              # id(base) stable => cache warm
+    d.dests = ["a:1", "b:1", "c:1"]      # join
+    p.refresh()
+    assert p.ring_rebuilds == 2 and p._ring is not ring0
+    d.dests = ["a:1", "b:1", "c:1"]
+    p.refresh()
+    assert p.ring_rebuilds == 2
+    d.dests = ["a:1", "c:1"]             # leave
+    p.refresh()
+    assert p.ring_rebuilds == 3

@@ -26,7 +26,8 @@ from veneur_tpu.analysis import (PASSES, ambiguous_paths, accounting_flow,
                                  bare_except, drop_accounting,
                                  hot_path_alloc, jax_hot_path,
                                  lock_discipline, metric_names,
-                                 run_passes, snapshot_schema, timer_sync)
+                                 reshard_quiesce, run_passes,
+                                 snapshot_schema, timer_sync)
 from veneur_tpu.analysis.core import (Project, filter_suppressed,
                                       reasonless_suppressions)
 
@@ -383,6 +384,44 @@ CASES = [
                 return eng.vrm_counters(r)
         """},
     ),
+    (
+        "reshard-quiesce",
+        lambda p: reshard_quiesce.run(p, roots=["veneur_tpu"]),
+        # positive: a shard-map mutator called (and .n_shards mutated)
+        # outside the documented swap-boundary helper
+        {"veneur_tpu/srv.py": """
+            class Agg:
+                def resize(self, eng, n):
+                    eng.shard_map_set(n)
+                    self.n_shards = n
+
+            class Proxy:
+                def poll(self, ring):
+                    self._ring = ring
+        """},
+        # negative: the helper itself, construction-time n_shards, and
+        # the proxy's own refresh() (its documented ring swap site)
+        {"veneur_tpu/reshard/quiesce.py": """
+            def shard_map_swap(aggregator, new_n_shards):
+                eng = getattr(aggregator, "eng", None)
+                if eng is not None:
+                    eng.shard_map_set(int(new_n_shards))
+                return aggregator.swap()
+        """,
+         "veneur_tpu/forward/proxysrv.py": """
+            class ProxyServer:
+                def __init__(self):
+                    self._ring = None
+
+                def refresh(self, dests):
+                    self._ring = tuple(dests)
+        """,
+         "veneur_tpu/srv.py": """
+            class Agg:
+                def __init__(self, n_shards):
+                    self.n_shards = n_shards
+        """},
+    ),
 ]
 
 _IDS = [c[0] for c in CASES]
@@ -518,12 +557,12 @@ def test_run_passes_json_schema_stability(tmp_path):
         {"name", "doc", "findings", "runtime_s"}]
 
 
-def test_registry_covers_all_ten_passes():
+def test_registry_covers_all_eleven_passes():
     assert list(PASSES) == [
         "hot-path-alloc", "drop-accounting", "ambiguous-paths",
         "bare-except", "metric-names", "snapshot-schema",
         "jax-hot-path", "lock-discipline", "accounting-flow",
-        "timer-sync"]
+        "timer-sync", "reshard-quiesce"]
     for name, mod in PASSES.items():
         assert mod.NAME == name and mod.DOC
 

@@ -25,7 +25,8 @@ from veneur_tpu.analysis import (ambiguous_paths, accounting_flow,
                                  bare_except, drop_accounting,
                                  hot_path_alloc, jax_hot_path,
                                  lock_discipline, metric_names,
-                                 snapshot_schema, timer_sync)
+                                 reshard_quiesce, snapshot_schema,
+                                 timer_sync)
 from veneur_tpu.analysis.core import (REPO, Finding, Project,
                                       filter_suppressed,
                                       reasonless_suppressions)
@@ -45,6 +46,7 @@ PASSES = {
         lock_discipline,
         accounting_flow,
         timer_sync,
+        reshard_quiesce,
     )
 }
 

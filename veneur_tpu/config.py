@@ -353,6 +353,18 @@ class Config:
     query_enabled: bool = False
     query_max_batch: int = 64
     query_timeout_ms: float = 2.0
+    # elastic live resharding (veneur_tpu/reshard/): grow/shrink the
+    # shard mesh without a restart or flush gap. Off by default — the
+    # coordinator object exists only when enabled, and the collective
+    # tier (which manages its own mesh) always wins over this.
+    # transfer_timeout_s bounds the whole move (drain visit, unit build,
+    # and the fold completion a mid-move flush performs);
+    # max_parallel_shards caps migration units folded per pipeline
+    # visit, so transfer folds interleave with ingest instead of
+    # monopolizing the pipeline thread.
+    reshard_enabled: bool = False
+    reshard_transfer_timeout_s: float = 10.0
+    reshard_max_parallel_shards: int = 4
 
     def parse_interval(self) -> float:
         return parse_duration(self.interval)

@@ -144,6 +144,12 @@ class ProxyServer:
         self._stats_thread = None
         self._stats_sock = None
         self._stats_last: Dict[tuple, int] = {}
+        # ring rebuilds actually performed by refresh() — membership
+        # changes only, not polls (the regression guard for the
+        # rebuild-every-poll bug: a stable fleet must not churn the ring
+        # object, which would also invalidate the derived routing-ring
+        # cache keyed by id(base))
+        self.ring_rebuilds = 0
         self.refresh()
 
     # -- ring maintenance ---------------------------------------------------
@@ -162,13 +168,20 @@ class ProxyServer:
             self._probe_ready()
             return
         with self._lock:
-            self._ring = HashRing(dests, self.replicas)
-            for dest in list(self._conns):
-                if dest not in self._ring.destinations:
-                    self._conns.pop(dest).close()
-            for dest in list(self._breakers):
-                if dest not in self._ring.destinations:
-                    del self._breakers[dest]
+            # rebuild only on a membership change: HashRing stores
+            # sorted(set(...)), so comparing against that canonical form
+            # is the membership signature. A stable fleet keeps the SAME
+            # ring object across polls — which also keeps the derived
+            # routing-ring cache (keyed by id(base)) warm.
+            if sorted(set(dests)) != list(self._ring.destinations):
+                self._ring = HashRing(dests, self.replicas)
+                self.ring_rebuilds += 1
+                for dest in list(self._conns):
+                    if dest not in self._ring.destinations:
+                        self._conns.pop(dest).close()
+                for dest in list(self._breakers):
+                    if dest not in self._ring.destinations:
+                        del self._breakers[dest]
         self._probe_ready()
 
     def _probe_ready(self) -> None:

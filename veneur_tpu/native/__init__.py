@@ -91,6 +91,7 @@ def _build_and_load():
             ctypes.c_int, ctypes.c_char_p, ctypes.c_int, ctypes.c_uint32,
             ctypes.POINTER(ctypes.c_int)]
         lib.vt_reset.argtypes = [ctypes.c_void_p]
+        lib.vt_shard_map_set.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
         lib.vt_stats.argtypes = [ctypes.c_void_p,
                                  ctypes.POINTER(ctypes.c_uint64)]
         lib.vr_start.restype = ctypes.c_void_p
@@ -156,6 +157,7 @@ def _build_and_load():
         lib.vrm_pause.argtypes = [ctypes.c_void_p]
         lib.vrm_resume.argtypes = [ctypes.c_void_p]
         lib.vrm_reset.argtypes = [ctypes.c_void_p]
+        lib.vrm_shard_map_set.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
         lib.vrm_counters.argtypes = [ctypes.c_void_p, ctypes.c_int,
                                      ctypes.POINTER(ctypes.c_uint64)]
         lib.vrm_ring_stats.argtypes = [ctypes.c_void_p, ctypes.c_int,
@@ -413,6 +415,17 @@ class NativeIngest:
             _lib.vrm_reset(r)
         else:
             _lib.vt_reset(self._h)
+
+    def shard_map_set(self, n_shards: int):
+        """Stage a shard-map change; it takes effect at the next reset()
+        (i.e. inside the swap quiesce), never immediately. Only
+        veneur_tpu/reshard/quiesce.py may call this — vtlint's
+        reshard-quiesce pass enforces the boundary."""
+        r = getattr(self, "_rings", None)
+        if r:
+            _lib.vrm_shard_map_set(r, int(n_shards))
+        else:
+            _lib.vt_shard_map_set(self._h, int(n_shards))
 
     def stats(self) -> dict:
         s = (ctypes.c_uint64 * 3)()

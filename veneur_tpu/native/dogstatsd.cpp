@@ -187,6 +187,11 @@ struct Parser {
   // prefixed with the kind byte like Python's ("timer", name, tags) keys)
   KindTable counters, gauges, sets, histos;
   int hll_precision = 14;
+  // staged shard-map change (live resharding): set under a unique
+  // key_mu lock by vt_shard_map_set, applied by vt_reset at the next
+  // buffer-swap boundary so no packed batch ever straddles two maps.
+  // 0 = nothing staged.
+  uint32_t pending_shards = 0;
 
   // Multi-ring sharing: ring parsers keep their own staging lanes and
   // scratch but route every key-table/new-key/special access to the
@@ -778,6 +783,9 @@ int32_t vt_slot_for(void* hp, int kind, int scope, const char* name,
 }
 
 // Flush boundary: clear key maps (state is flush-scoped, worker.go:498).
+// A staged shard map (vt_shard_map_set) is applied HERE — tables are
+// empty at this instant, so re-deriving per_shard/next_free under the
+// new count re-keys nothing and no packed batch straddles two maps.
 void vt_reset(void* hp) {
   auto* p = (Parser*)hp;
   std::unique_lock<std::shared_mutex> lk(p->key_mu);
@@ -786,6 +794,24 @@ void vt_reset(void* hp) {
   p->sets.reset();
   p->histos.reset();
   p->new_keys.clear();
+  if (p->pending_shards) {
+    uint32_t n = p->pending_shards;
+    p->pending_shards = 0;
+    p->counters.init(p->counters.capacity, n);
+    p->gauges.init(p->gauges.capacity, n);
+    p->sets.init(p->sets.capacity, n);
+    p->histos.init(p->histos.capacity, n);
+  }
+}
+
+// Stage a new shard count for the tables (all tables share n_shards).
+// Takes effect at the next vt_reset — i.e. inside the caller's swap
+// quiesce — never immediately. The swap-boundary sequencing lives in
+// veneur_tpu/reshard/quiesce.py; call it from there only.
+void vt_shard_map_set(void* hp, uint32_t n_shards) {
+  auto* p = (Parser*)hp;
+  std::unique_lock<std::shared_mutex> lk(p->key_mu);
+  p->pending_shards = n_shards ? n_shards : 1;
 }
 
 // Batch FNV-1a 64 over concatenated byte strings (offsets has n+1
@@ -1883,6 +1909,15 @@ void vrm_reset(void* h) {
   auto* mr = (MultiRing*)h;
   vt_reset(mr->master);
   for (auto& r : mr->rings) r->parser.local_cache.clear();
+}
+
+// Multi-ring shard-map staging: the rings route every table access to
+// the master, so staging on the master covers all of them. Applied by
+// the vrm_reset inside the next swap quiesce (ring local caches are
+// cleared there too, so no ring can hit an old-map slot afterwards).
+void vrm_shard_map_set(void* h, uint32_t n_shards) {
+  auto* mr = (MultiRing*)h;
+  vt_shard_map_set(mr->master, n_shards);
 }
 
 // Per-ring counter snapshot: [0]=datagrams, [1]=ring_dropped,

@@ -164,7 +164,7 @@ class QueryEngine:
 
     def __init__(self, server, *, max_batch: int = 64,
                  timeout_ms: float = 2.0, requests=None, batched=None,
-                 duration=None) -> None:
+                 duration=None, stale_reads=None) -> None:
         self._server = server
         self.spec = server.aggregator.spec           # TOTAL capacities
         self.max_batch = max(1, int(max_batch))
@@ -172,6 +172,7 @@ class QueryEngine:
         self._c_requests = requests
         self._c_batched = batched
         self._t_duration = duration
+        self._c_stale_reads = stale_reads
         self._queue: "queue_mod.Queue[Optional[_Item]]" = queue_mod.Queue()
         self._stop = threading.Event()
         self._sync = jaxruntime.SampledSync(_SYNC_EVERY)
@@ -352,6 +353,14 @@ class QueryEngine:
             # so it is the escalation path, never the default.
             plans, res, qcol, set_shift = self._evaluate_atomic(batch)
         dur = time.perf_counter_ns() - t0
+        # stale-bounded availability during a live reshard: the serving
+        # table answers before all moved rows folded, so rows in flight
+        # may be missing for at most one flush interval. The answer is
+        # still served (availability wins); it is MARKED so consumers
+        # and the chaos drill can pin the guarantee.
+        stale = bool(getattr(self._server, "reshard_active", False))
+        if stale and self._c_stale_reads is not None:
+            self._c_stale_reads.inc(len(batch))
         for item, per_q in plans:
             results = []
             for rows, truncated, q in per_q:
@@ -363,6 +372,8 @@ class QueryEngine:
                 results.append(entry)
             item.result = {"results": results, "batched": total,
                            "set_shift": set_shift}
+            if stale:
+                item.result["stale_bounded"] = True
             if self._t_duration is not None:
                 self._t_duration.observe(dur)
             item.done.set()

@@ -40,6 +40,11 @@ PROXY_FORWARD = "proxy.forward"
 SINK_FLUSH = "sink.flush"
 FLUSH_WORKER = "flush.worker"
 CHECKPOINT_WRITE = "checkpoint.write"
+# injected AFTER a migration unit folded into the receiving aggregator
+# but BEFORE the coordinator records its progress — the mid-move receiver
+# crash: the whole migration epoch replays under the ORIGINAL seqs and
+# the dedup window must answer DUPLICATE for everything already folded.
+RESHARD_FOLD = "reshard.fold"
 
 
 class InjectedFault(RuntimeError):

@@ -154,6 +154,12 @@ class OverloadController:
         self.shed: Dict[str, int] = {}
         self.degraded_flushes = 0
         self.state = HEALTHY
+        # RESHARDING is a sub-state orthogonal to the pressure ladder:
+        # ready-but-announcing. /readyz stays ok (peers keep sending —
+        # the whole point of LIVE resharding), but health exposes it as
+        # the machine-readable phase so the proxy prober and dashboards
+        # can tell "moving shards" from "broken".
+        self.resharding = False
         self.pressure = 0.0
         self.last_signals: Dict[str, float] = {}
         self.state_since = clock()
@@ -210,6 +216,13 @@ class OverloadController:
         del self.transitions[:-256]
         self.state = to
         self.state_since = now
+
+    # -- resharding sub-state ------------------------------------------------
+    def enter_resharding(self) -> None:
+        self.resharding = True
+
+    def exit_resharding(self) -> None:
+        self.resharding = False
 
     # -- admission -----------------------------------------------------------
     def _bucket_allow(self, key: str) -> bool:

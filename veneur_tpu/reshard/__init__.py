@@ -1,0 +1,23 @@
+"""Elastic live resharding: grow and shrink the mesh under fire.
+
+The routing digest (collective/keytable.py `route_digest`, byte-identical
+in the C++ preshard path) is deterministic, so the set of keys that move
+when the shard count changes is computable from (old_n, new_n) alone —
+no coordination, no key enumeration on the wire. The pieces:
+
+- plan.py         the pure math: which keys move, how rows partition
+                  into per-destination-shard migration units.
+- quiesce.py      THE sanctioned swap-boundary helper for shard-map
+                  mutation (vtlint's reshard-quiesce pass rejects any
+                  other call site).
+- coordinator.py  the live protocol: drain the old mesh at a flush
+                  boundary, rebuild the serving aggregator on the new
+                  shard map, and replay the drained rows through the
+                  normal fold path under exactly-once envelopes.
+"""
+
+from veneur_tpu.reshard.coordinator import ReshardCoordinator, ReshardError
+from veneur_tpu.reshard.plan import ReshardPlan, key_moved, partition_units
+
+__all__ = ["ReshardCoordinator", "ReshardError", "ReshardPlan",
+           "key_moved", "partition_units"]

@@ -1,0 +1,138 @@
+"""Reshard planning: the deterministic moved-key math and the snapshot
+partitioner that turns one drained interval into per-destination-shard
+migration units.
+
+Shard routing is `route_digest(kind, name, joined_tags) % n_shards`
+(collective/keytable.py, persistence/restore.py, and the C++ KindTable
+all use the identical recipe), so whether a key moves under a resize is
+a pure function of its digest and the two shard counts — the moved set
+needs no enumeration protocol between peers, only (old_n, new_n).
+
+A migration unit is a mini-snapshot in the exact persistence/snapshot.py
+schema, restricted to the rows one DESTINATION shard will own under the
+new map. Units are numbered by destination shard, which makes the
+exactly-once envelope seq deterministic: a crashed transfer replays the
+SAME (epoch, seq) per unit and the receiver's DedupWindow suppresses
+every unit that already folded (see coordinator.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from math import gcd
+from typing import Dict, List
+
+import numpy as np
+
+from veneur_tpu.collective.keytable import route_digest
+
+# snapshot table name -> array keys paired with it (persistence/snapshot.py)
+_KIND_ARRAYS = {"counter": ("counter",), "gauge": ("gauge",),
+                "status": ("status",), "set": ("hll",),
+                "histo": ("h_mean", "h_weight", "h_min", "h_max",
+                          "h_recip")}
+
+
+def key_moved(digest: int, old_n: int, new_n: int) -> bool:
+    """True iff a key with this routing digest changes owner shard when
+    the shard count goes old_n -> new_n."""
+    return (digest % old_n) != (digest % new_n)
+
+
+def moved_fraction(old_n: int, new_n: int) -> float:
+    """Exact fraction of the digest space that changes owner, computed
+    over one period of the joint residue cycle lcm(old_n, new_n). (The
+    u32 digest space is not an exact multiple of the lcm, but the edge
+    partial cycle is ~lcm/2^32 — negligible and direction-free.)"""
+    if old_n == new_n:
+        return 0.0
+    period = old_n * new_n // gcd(old_n, new_n)
+    moved = sum(1 for r in range(period) if r % old_n != r % new_n)
+    return moved / period
+
+
+@dataclasses.dataclass(frozen=True)
+class ReshardPlan:
+    """One resize: old_n -> new_n. `signature` keys logs/metrics and the
+    dedup stream so two plans never alias."""
+    old_n: int
+    new_n: int
+
+    def __post_init__(self):
+        if self.old_n < 1 or self.new_n < 1:
+            raise ValueError(f"shard counts must be >= 1 "
+                             f"({self.old_n} -> {self.new_n})")
+
+    @property
+    def signature(self) -> str:
+        return f"{self.old_n}->{self.new_n}"
+
+    def dest_shard(self, digest: int) -> int:
+        return digest % self.new_n
+
+    def moved(self, digest: int) -> bool:
+        return key_moved(digest, self.old_n, self.new_n)
+
+
+def _row_digest(entry) -> int:
+    """Digest for one snapshot table row (the snapshot schema's
+    8-field entry list). `actual_kind` disambiguates histogram vs timer
+    — they share a table but are distinct key identities."""
+    name, tags, _scope, _host, _msg, _imp, actual_kind, joined = entry
+    if joined is None:
+        joined = ",".join(tags)
+    return route_digest(actual_kind, name, joined)
+
+
+def partition_units(snap: dict, plan: ReshardPlan) -> List[dict]:
+    """Split a drained interval's snapshot into per-destination-shard
+    migration units (empty shards get no unit, but unit seq == dest
+    shard id stays stable either way via the `dest_shard` field).
+
+    Every live row re-enters the new mesh — the rebuilt aggregator
+    starts empty — but rows whose owner is unchanged are counted apart
+    from genuinely moved rows (`rows_moved`), which is what
+    veneur.reshard.rows_moved_total reports: the cross-owner traffic a
+    real fleet would put on the wire."""
+    arrays = snap["arrays"]
+    tables = snap["tables"]
+    # destination shard -> {table kind: [row index]}
+    by_dest: Dict[int, Dict[str, List[int]]] = {}
+    moved_rows: Dict[int, int] = {}
+    for kind, entries in tables.items():
+        for i, entry in enumerate(entries):
+            d = _row_digest(entry)
+            dest = plan.dest_shard(d)
+            by_dest.setdefault(dest, {}).setdefault(kind, []).append(i)
+            if plan.moved(d):
+                moved_rows[dest] = moved_rows.get(dest, 0) + 1
+    units: List[dict] = []
+    for dest in sorted(by_dest):
+        sel = by_dest[dest]
+        u_tables = {kind: [tables[kind][i] for i in sel.get(kind, ())]
+                    for kind in tables}
+        u_arrays = {}
+        for kind, arr_keys in _KIND_ARRAYS.items():
+            idx = np.asarray(sel.get(kind, ()), np.int64)
+            for ak in arr_keys:
+                src = np.asarray(arrays[ak])
+                u_arrays[ak] = (src[idx] if len(idx)
+                                else src[:0])
+        units.append({
+            "agg_kind": snap.get("agg_kind", "single"),
+            "n_shards": int(snap.get("n_shards", plan.old_n)),
+            "spec": snap["spec"],
+            "interval_ts": snap.get("interval_ts", 0),
+            "created_at": snap.get("created_at", 0),
+            "hostname": snap.get("hostname", ""),
+            "tables": u_tables,
+            "arrays": u_arrays,
+            "spill": b"",
+            "spill_entries": 0,
+            "forward": None,
+            # reshard-unit bookkeeping (not part of the persisted schema)
+            "dest_shard": dest,
+            "rows": sum(len(v) for v in u_tables.values()),
+            "rows_moved": moved_rows.get(dest, 0),
+        })
+    return units

@@ -1,0 +1,37 @@
+"""The documented swap-boundary helper for shard-map mutation.
+
+Changing the shard map while packets are in flight is only safe at a
+buffer-swap boundary: the native engine's staged rows were keyed under
+the OLD map (slot = shard*per_shard + local at parse time), so they must
+be emitted and the interval detached before the map changes, and no
+packed batch may straddle two maps. `shard_map_swap` is the ONE place
+that sequencing lives:
+
+1. stage the pending shard count on the C++ engine (`shard_map_set`
+   marks it; nothing changes yet — parsing continues under the old map);
+2. run the aggregator's normal `swap()`, which pauses the reader rings,
+   emits every staged row under the old map, detaches the interval, and
+   calls `eng.reset()` — the reset applies the pending map atomically
+   inside the quiesce, then the rings resume parsing under the new map.
+
+Pure-Python backends have no engine; for them the swap alone IS the
+boundary (the new aggregator object carries the new layout).
+
+vtlint's `reshard-quiesce` pass (analysis/reshard_quiesce.py) rejects
+any shard-map mutation outside this module, so the sequencing above
+cannot be bypassed by accident.
+"""
+
+from __future__ import annotations
+
+
+def shard_map_swap(aggregator, new_n_shards: int):
+    """Detach the current interval at a flush boundary and re-learn the
+    shard map without a pipeline restart. Returns the detached
+    (state, table) pair exactly like `aggregator.swap()`."""
+    eng = getattr(aggregator, "eng", None)
+    if eng is not None:
+        # staged only: applied inside eng.reset() during the swap below,
+        # while the rings are paused and staging is drained
+        eng.shard_map_set(int(new_n_shards))
+    return aggregator.swap()

@@ -61,6 +61,26 @@ def check_live(server) -> Tuple[bool, Dict]:
     }
 
 
+def ready_phase(server) -> str:
+    """Machine-readable lifecycle phase for /readyz consumers that need
+    to distinguish "joining"/"moving" from "broken": a restoring or
+    resharding server is doing planned work (dashboards should not page)
+    while a draining one is leaving the ring on purpose. Exactly one of
+    `restoring | resharding | draining | ready`, in that precedence —
+    restore wins because a restoring server is not yet serving at all,
+    and drain wins over reshard because shutdown abandons any move."""
+    if not bool(getattr(server, "_restore_complete", True)):
+        return "restoring"
+    shutdown = getattr(server, "_shutdown", None)
+    if shutdown is not None and shutdown.is_set():
+        return "draining"
+    ov = getattr(server, "_overload", None)
+    if bool(getattr(server, "_resharding", False)) or (
+            ov is not None and getattr(ov, "resharding", False)):
+        return "resharding"
+    return "ready"
+
+
 def check_ready(server) -> Tuple[bool, Dict]:
     ov = getattr(server, "_overload", None)
     state = ov.state if ov is not None else 0
@@ -71,6 +91,9 @@ def check_ready(server) -> Tuple[bool, Dict]:
     ok = state_ok and restored and forward_ok
     return ok, {
         "ready": ok,
+        # resharding is ready-but-announcing: ok stays True (peers keep
+        # sending — the move is live), only the phase flips
+        "phase": ready_phase(server),
         "overload_state": STATE_NAMES.get(state, str(state)),
         "overload_pressure": round(ov.pressure, 4) if ov is not None
         else 0.0,

@@ -247,6 +247,8 @@ def start_http_server(server, address) -> "http.server.ThreadingHTTPServer":
                     req_span.client_finish(server.trace_client)
             elif self.path == "/query":
                 self._handle_query()
+            elif self.path == "/reshard":
+                self._handle_reshard()
             elif self.path == "/quitquitquit" and server.cfg.http_quit:
                 self._quit()
             else:
@@ -293,6 +295,41 @@ def start_http_server(server, address) -> "http.server.ThreadingHTTPServer":
                 # dashboard to back off, same contract as import shed
                 server._c_query_shed.inc()
                 self._reply(503, str(e).encode())
+                return
+            self._reply(200, json.dumps(out).encode(),
+                        "application/json")
+
+        def _handle_reshard(self):
+            """POST /reshard {"n_shards": N}: start a live mesh resize.
+            Same gate ordering as /query: shutdown first, then the
+            config gate (an unaware deployment exposes nothing). 409
+            when a move is already running — the coordinator is
+            single-flight by design, so concurrent operators get a
+            clean conflict instead of a queued surprise."""
+            if self._shutdown_gate():
+                return
+            if server.reshard is None:
+                self._reply(404, b"reshard_enabled is off")
+                return
+            length = int(self.headers.get("Content-Length", "0"))
+            body = self.rfile.read(length)
+            try:
+                req = json.loads(body)
+                n = int(req["n_shards"])
+                timeout = req.get("timeout_s")
+                if timeout is not None:
+                    timeout = float(timeout)
+            except (ValueError, KeyError, TypeError):
+                self._reply(400, b'want JSON body {"n_shards": N}')
+                return
+            from veneur_tpu.reshard import ReshardError
+            if server.reshard.active:
+                self._reply(409, b"a reshard is already in progress")
+                return
+            try:
+                out = server.trigger_reshard(n, timeout=timeout)
+            except ReshardError as e:
+                self._reply(400, str(e).encode())
                 return
             self._reply(200, json.dumps(out).encode(),
                         "application/json")

@@ -139,9 +139,14 @@ class NativeKeyTable:
 
 class NativeAggregator(Aggregator):
     def __init__(self, spec: TableSpec, bspec: BatchSpec = BatchSpec(),
-                 n_shards: int = 1, compact_every: int = 8):
+                 n_shards: int = 1, compact_every: int = 8, engine=None):
         super().__init__(spec, bspec, n_shards, compact_every)
-        self.eng = NativeIngest(spec, bspec, n_shards)
+        # live resharding passes the OLD aggregator's engine: the C++
+        # reader rings/sockets keep feeding the same handle across the
+        # rebuild (its staged shard map was applied by the reset inside
+        # the drain swap), so ingest never restarts
+        self.eng = engine if engine is not None \
+            else NativeIngest(spec, bspec, n_shards)
         self.table = NativeKeyTable(spec, self.eng, n_shards)
         self._alloc_packed_buffers()
 
@@ -508,9 +513,11 @@ class NativeShardedAggregator(ShardedAggregator):
 
     def __init__(self, spec: TableSpec, bspec: BatchSpec = BatchSpec(),
                  n_shards: int = 2, compact_every: int = 8,
-                 preshard: bool = False):
+                 preshard: bool = False, engine=None):
         super().__init__(spec, bspec, n_shards, compact_every)
-        self.eng = NativeIngest(spec, bspec, n_shards)
+        # engine reuse across a live reshard — see NativeAggregator
+        self.eng = engine if engine is not None \
+            else NativeIngest(spec, bspec, n_shards)
         self.table = NativeKeyTable(spec, self.eng, n_shards)
         self._py_processed = 0
         self._py_dropped = 0

@@ -198,26 +198,8 @@ class Server:
                 n_replicas=n_replicas, **agg_args)
             collective_tier.register(cfg.collective_group, self.aggregator)
             self._collective_registered = cfg.collective_group
-        elif n_shards > 1:
-            # device scale-out: sharded mesh backend (parallel/sharded.py);
-            # C++ staging composes with the mesh when native_ingest is on
-            agg_args["n_shards"] = n_shards
-            if cfg.native_ingest and _native_available():
-                from veneur_tpu.server.native_aggregator import (
-                    NativeShardedAggregator)
-                self.aggregator = NativeShardedAggregator(
-                    preshard=cfg.native_preshard_enabled, **agg_args)
-                self._native = True
-            else:
-                from veneur_tpu.server.sharded_aggregator import (
-                    ShardedAggregator)
-                self.aggregator = ShardedAggregator(**agg_args)
-        elif cfg.native_ingest and _native_available():
-            from veneur_tpu.server.native_aggregator import NativeAggregator
-            self.aggregator = NativeAggregator(**agg_args)
-            self._native = True
         else:
-            self.aggregator = Aggregator(**agg_args)
+            self.aggregator, self._native = self._make_aggregator(n_shards)
         self.metric_sinks = list(metric_sinks or [])
         self.span_sinks = list(span_sinks or [])
         self.plugins = list(plugins or [])
@@ -412,6 +394,27 @@ class Server:
             "veneur.query.duration_ns",
             "end-to-end batched query service time: snapshot round-trip "
             "+ device launch + response assembly")
+        # elastic live resharding (veneur_tpu/reshard/) — registered even
+        # with the feature off so the inventory is stable
+        self._c_reshard_moves = M.counter(
+            "veneur.reshard.moves_total",
+            "live mesh resizes completed (drain + transfer + cutover)")
+        self._c_reshard_rows_moved = M.counter(
+            "veneur.reshard.rows_moved_total",
+            "rows whose owner shard changed under a resize and were "
+            "folded into the new mesh exactly once")
+        self._c_reshard_failed = M.counter(
+            "veneur.reshard.failed_total",
+            "resizes abandoned: transfer timeout, fold failure after "
+            "replays, or invalid target")
+        self._c_reshard_stale = M.counter(
+            "veneur.reshard.stale_reads_total",
+            "queries answered during a transfer from the serving table "
+            "before all moved rows folded (stale-bounded by one flush "
+            "interval)")
+        self._t_reshard = M.timer(
+            "veneur.reshard.duration_ns",
+            "one live resize end to end: drain swap through final fold")
         jaxruntime.install()
         # h2d_bytes high-water at the last flush report, for per-interval
         # byte tags on the flush trace (flush worker thread only)
@@ -518,6 +521,16 @@ class Server:
                 set_shift=cfg.overload_set_shift,
                 shed_priority_tags=cfg.shed_priority_tags)
 
+        # -- elastic live resharding (veneur_tpu/reshard/) ----------------
+        # Off by default: no coordinator, and the flush-path gate is a
+        # single `is not None` check. The collective tier manages its own
+        # mesh layout, so the two are mutually exclusive.
+        self._resharding = False
+        self.reshard = None
+        if cfg.reshard_enabled and not cfg.collective_enabled:
+            from veneur_tpu.reshard import ReshardCoordinator
+            self.reshard = ReshardCoordinator(self)
+
         # -- TCP statsd hardening -----------------------------------------
         # live-connection accounting for tcp_max_connections; the idle
         # deadline lives in _tcp_conn
@@ -584,9 +597,47 @@ class Server:
                 timeout_ms=cfg.query_timeout_ms,
                 requests=self._c_query_requests,
                 batched=self._c_query_batched,
-                duration=self._t_query)
+                duration=self._t_query,
+                stale_reads=self._c_reshard_stale)
         # last: every attribute a collector closes over now exists
         self._register_collectors()
+
+    def _make_aggregator(self, n_shards: int, engine=None):
+        """Build the single-process backend for `n_shards` from the
+        current config. Returns (aggregator, is_native). Used at startup
+        and by the reshard coordinator's drain phase — which passes the
+        OLD aggregator's C++ engine so reader rings/sockets keep feeding
+        the same handle across the rebuild (the staged shard map was
+        applied inside the drain swap). The collective tier has its own
+        construction path in __init__ and does not resize live."""
+        cfg = self.cfg
+        agg_args = dict(
+            spec=spec_from_config(cfg),
+            bspec=BatchSpec(counter=cfg.tpu_batch_counter,
+                            gauge=cfg.tpu_batch_gauge,
+                            status=cfg.tpu_batch_status,
+                            set=cfg.tpu_batch_set,
+                            histo=cfg.tpu_batch_histo),
+            n_shards=max(1, int(n_shards)),
+            compact_every=cfg.tpu_compact_every)
+        native = cfg.native_ingest and (engine is not None
+                                        or _native_available())
+        if agg_args["n_shards"] > 1:
+            # device scale-out: sharded mesh backend (parallel/sharded.py);
+            # C++ staging composes with the mesh when native_ingest is on
+            if native:
+                from veneur_tpu.server.native_aggregator import (
+                    NativeShardedAggregator)
+                return NativeShardedAggregator(
+                    preshard=cfg.native_preshard_enabled, engine=engine,
+                    **agg_args), True
+            from veneur_tpu.server.sharded_aggregator import (
+                ShardedAggregator)
+            return ShardedAggregator(**agg_args), False
+        if native:
+            from veneur_tpu.server.native_aggregator import NativeAggregator
+            return NativeAggregator(engine=engine, **agg_args), True
+        return Aggregator(**agg_args), False
 
     def _register_collectors(self) -> None:
         """Read-through registry collectors for values owned elsewhere:
@@ -1168,6 +1219,15 @@ class Server:
                         "(state retained)")
             req.finish(False, "deferred: flush worker backlogged")
             return
+        # A flush landing mid-reshard completes the remaining migration
+        # folds synchronously FIRST (we are on the pipeline thread, so
+        # folding here races nothing): flush output then covers the whole
+        # drained interval, and the transition is bounded at one flush
+        # boundary by construction.
+        if self.reshard is not None and self.reshard.active:
+            self.reshard.complete_pending_folds(
+                self.aggregator,
+                float(self.cfg.reshard_transfer_timeout_s))
         now = time.time()
         self.last_flush = now
         # the ingest-drain phase: how long the interval's device state
@@ -1974,6 +2034,25 @@ class Server:
         if not ok:
             log.warning("manual flush did not complete: %s", req.detail)
         return ok
+
+    @property
+    def reshard_active(self) -> bool:
+        return self.reshard is not None and self.reshard.active
+
+    def trigger_reshard(self, new_n_shards: int, wait: bool = True,
+                        timeout: Optional[float] = None):
+        """Resize the mesh live to `new_n_shards` (veneur_tpu/reshard/).
+        With wait=True blocks until the transfer completed and returns
+        its summary dict; with wait=False returns the live transfer
+        handle (observe via .done / .summary()). Raises ReshardError
+        when the feature is off, a move is already in progress, the
+        target is invalid, or the transfer failed."""
+        if self.reshard is None:
+            from veneur_tpu.reshard import ReshardError
+            raise ReshardError("resharding is disabled "
+                               "(reshard_enabled: false)")
+        return self.reshard.resize(new_n_shards, wait=wait,
+                                   timeout_s=timeout)
 
     def _checkpoint_interval(self, flush_arrays, table, raw, ts) -> None:
         """Assemble this interval's snapshot from the flush outputs and
