@@ -406,6 +406,10 @@ def config4_global_merge(scale=1.0):
         _warm(glob, [b"warm.c:1|c", b"warm.t:1.0|ms"], sinks=[sink])
         client = ForwardClient(f"127.0.0.1:{glob.grpc_port}")
         n_metrics = sum(len(e) for e in exports)
+        flush_seconds = []    # steady-state flush walls (cycle 0's
+        # flush pays the size-bucket compile and is excluded); config13
+        # replays this exact load with 100k watches registered and its
+        # bench.py gate compares against these
         for cycle in range(2):   # first cycle compiles the size bucket
             phase(f"cycle{cycle}")
             sink.flushed.clear()
@@ -417,8 +421,11 @@ def config4_global_merge(scale=1.0):
             while glob.packet_queue.qsize() and \
                     time.time() - t1 < FLUSH_WAIT:
                 time.sleep(0.02)
+            tf = time.perf_counter()
             _flush_checked(glob, timeout=WARM_TIMEOUT if cycle == 0
                            else FLUSH_WAIT)
+            if cycle > 0:
+                flush_seconds.append(time.perf_counter() - tf)
             dt = time.perf_counter() - t0
 
         # Sustained absorption (VERDICT r04 #5): pump pre-serialized
@@ -479,6 +486,9 @@ def config4_global_merge(scale=1.0):
             "merged_p99_err_mean": round(float(np.mean(_acc(
                 p99_errs, "merged p99", flushed_keys=len(flushed)))), 5),
             "merged_p99_err_max": round(float(np.max(p99_errs)), 5),
+            "flush_seconds": [round(s, 3) for s in flush_seconds],
+            "flush_p99_seconds": round(float(
+                np.percentile(flush_seconds, 99)), 3),
             "wall_seconds": round(dt, 3),
         }
     finally:
@@ -1731,12 +1741,312 @@ def config12_elastic_resize(scale=1.0):
     }
 
 
+# -- config 13: standing-watch storm -----------------------------------------
+
+def config13_watch_storm(scale=1.0):
+    """100k standing monitors as one fused device evaluation (README
+    §Watches): replay config4's EXACT global-merge load (same seed,
+    same caps, same loopback-gRPC forward path) into a watch-enabled
+    global, register >=100k watches over the merged population — the
+    fleet size does NOT scale down; the tentpole claim IS the fleet —
+    and prove the alerting tier rides the flush for free. Always-on
+    gates: every watch evaluated every interval by ONE appended device
+    launch (launches == intervals, no per-watch dispatches); fired /
+    suppressed / notify-dropped reconcile EXACTLY against closed-form
+    expected counts (the breach pattern is deterministic by
+    construction); at-least-once delivery accounting over a
+    deliberately stalled SSE subscriber (received + dropped ==
+    transitions, exact); registrations + firing state byte-exact
+    across a snapshot/restore round trip into a second server; and
+    flush p99 with the fleet armed inside the watches-off band
+    measured on the SAME server minutes earlier (bench.py adds the
+    cross-config gate vs config4's flush_p99_seconds). The
+    notification-latency gate — p99 of flush-return to
+    transitions-published < one production interval — arms on TPU
+    only: the CPU smoke's first packed evaluation pays an XLA compile
+    that would gate compiler wall time, not the tier (the absorb
+    cycle's wall is still reported)."""
+    import json as _json
+    import urllib.request
+
+    import jax
+
+    from veneur_tpu.aggregation.host import BatchSpec
+    from veneur_tpu.aggregation.state import TableSpec
+    from veneur_tpu.forward.convert import export_metrics
+    from veneur_tpu.forward.rpc import ForwardClient
+    from veneur_tpu.samplers.parser import parse_metric
+    from veneur_tpu.server.aggregator import Aggregator
+    from veneur_tpu.sinks.debug import DebugMetricSink
+    from veneur_tpu.watch.model import WATCH_KINDS
+
+    n_locals = 64
+    counters = max(8, int(200 * scale))
+    histos = max(4, int(50 * scale))
+    histo_samples = 20
+    rng = np.random.default_rng(4)      # config4's seed: same oracle
+    interval_s = 10.0    # production cadence the TPU notify gate bounds
+    K_BASE = 3           # timed watches-off flushes (the in-run baseline)
+    K_WATCH = 4          # watch intervals: absorb + 3 timed
+
+    spec = TableSpec(counter_capacity=1 << 10, gauge_capacity=64,
+                     status_capacity=16, set_capacity=16,
+                     histo_capacity=1 << 8)
+    bspec = BatchSpec(counter=2048, gauge=64, status=16, set=64, histo=2048)
+
+    exports = []
+    for li in range(n_locals):
+        agg = Aggregator(spec, bspec)
+        for c in range(counters):
+            agg.process_metric(parse_metric(
+                b"merged.counter.%d:%d|c|#veneurglobalonly" % (c, li + c)))
+        for h in range(histos):
+            for v in rng.lognormal(2.0, 0.8, histo_samples):
+                agg.process_metric(
+                    parse_metric(b"merged.timer.%d:%.4f|ms" % (h, v)))
+        _, table, raw = agg.flush([0.5], want_raw=True)
+        exports.append(export_metrics(raw, table, compression=spec.compression,
+                                      hll_precision=spec.hll_precision))
+    n_metrics = sum(len(e) for e in exports)
+
+    # The monitor estate, shaped like a real one: many thresholds per
+    # hot metric, deltas, tail-quantile watches, plus a band of
+    # cardinality watches on a namespace that never reports (the
+    # NO_DATA estate). Even indices breach — counter values are
+    # sums of li+c (>= 2016 > 0.5), identical every interval so a
+    # breaching watch fires EXACTLY once and then holds in ALERT
+    # (suppressed, counted); odd indices sit at an unreachable 1e18.
+    # Delta watches see exactly 0.0 from the second interval on
+    # (identical replays), so their breach threshold is -1.0.
+    n_watch = max(100_000, int(100_000 * scale))
+    n_thr = int(n_watch * 0.60)
+    n_delta = int(n_watch * 0.15)
+    n_quant = int(n_watch * 0.20)
+    n_card = n_watch - n_thr - n_delta - n_quant
+    thr_b = (n_thr + 1) // 2
+    delta_b = (n_delta + 1) // 2
+    quant_b = (n_quant + 1) // 2
+
+    sink = DebugMetricSink()
+    glob = _mk_server([sink], grpc_address="127.0.0.1:0",
+                      http_address="127.0.0.1:0",
+                      tpu_counter_capacity=1 << 12,
+                      tpu_histo_capacity=1 << 9,
+                      watch_enabled=True,
+                      watch_max_active=n_watch + 16)
+    try:
+        eng = glob.watch_engine
+        _warm(glob, [b"warm.c:1|c", b"warm.t:1.0|ms"], sinks=[sink])
+        client = ForwardClient(f"127.0.0.1:{glob.grpc_port}")
+
+        def feed_interval(timeout=FLUSH_WAIT):
+            """One full replay of the load, consumed end to end: the
+            watch determinism above needs every interval identical, so
+            wait on imported_total (exact), not just queue-empty."""
+            want = glob.imported_total + n_metrics
+            for e in exports:
+                client.send_metrics(e, timeout=30.0)
+            t1 = time.time()
+            while glob.imported_total < want and time.time() - t1 < timeout:
+                time.sleep(0.01)
+            if glob.imported_total < want:
+                raise RuntimeError(
+                    "forward feed not absorbed: %d of %d imports after "
+                    "%.0fs" % (glob.imported_total - want + n_metrics,
+                               n_metrics, timeout))
+
+        def wait_evaluated(target, timeout):
+            t1 = time.time()
+            done = lambda: (eng.intervals_evaluated
+                            + eng.intervals_skipped) >= target
+            while not done() and time.time() - t1 < timeout:
+                time.sleep(0.005)
+            if not done():
+                raise RuntimeError(
+                    "watch engine did not finish interval %d within "
+                    "%.0fs" % (target, timeout))
+
+        phase("compile_cycle")            # flush-program size buckets
+        feed_interval(timeout=WARM_TIMEOUT)
+        _flush_checked(glob, timeout=3 * WARM_TIMEOUT)
+
+        flush_base = []
+        for cycle in range(K_BASE):       # watches-off flush baseline
+            phase(f"base_cycle{cycle}")
+            feed_interval()
+            tf = time.perf_counter()
+            _flush_checked(glob)
+            flush_base.append(time.perf_counter() - tf)
+
+        phase("register")
+        http_registered = 0
+
+        def admit(body, via_http):
+            nonlocal http_registered
+            if via_http:                  # prove the public API path
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{glob.http_port}/watch",
+                    data=_json.dumps(body).encode(), method="POST",
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=30.0) as resp:
+                    if resp.status != 201:
+                        raise RuntimeError(
+                            f"POST /watch -> {resp.status}")
+                http_registered += 1
+            else:
+                eng.register(body)
+
+        t0 = time.perf_counter()
+        for i in range(n_thr):
+            admit({"kind": "threshold",
+                   "name": f"merged.counter.{i % counters}", "op": ">",
+                   "threshold": 0.5 if i % 2 == 0 else 1e18},
+                  via_http=i == 0)
+        for i in range(n_delta):
+            admit({"kind": "delta",
+                   "name": f"merged.counter.{i % counters}", "op": ">",
+                   "threshold": -1.0 if i % 2 == 0 else 1e18},
+                  via_http=i == 0)
+        for i in range(n_quant):
+            admit({"kind": "quantile", "quantile": 0.99,
+                   "name": f"merged.timer.{i % histos}", "op": ">",
+                   "threshold": 0.0 if i % 2 == 0 else 1e18},
+                  via_http=i == 0)
+        for i in range(n_card):
+            admit({"kind": "cardinality", "prefix": f"w13.sets.{i}.",
+                   "op": ">", "threshold": 0.5, "no_data_intervals": 2},
+                  via_http=i == 0)
+        reg_dt = time.perf_counter() - t0
+        if eng.n_active != n_watch:
+            raise RuntimeError(
+                f"registered {eng.n_active} of {n_watch} watches")
+
+        def kind_sum(counter):
+            return sum(counter.value(kind=k) for k in WATCH_KINDS)
+
+        ev0 = kind_sum(glob._c_watch_evaluated)
+        f0 = kind_sum(glob._c_watch_fired)
+        s0 = kind_sum(glob._c_watch_suppressed)
+        d0 = kind_sum(glob._c_watch_notify_dropped)
+        iv0, sk0, ln0 = (eng.intervals_evaluated, eng.intervals_skipped,
+                         eng.launches_total)
+        # a subscriber that never drains: its losses are the exact-drop
+        # accounting under a transition storm
+        sub = eng.hub.subscribe()
+        if sub is None:
+            raise RuntimeError("SSE subscribe refused below the cap")
+
+        flush_watch, notify_lat = [], []
+        for cycle in range(K_WATCH):
+            phase(f"watch_cycle{cycle}")
+            feed_interval(timeout=WARM_TIMEOUT if cycle == 0
+                          else FLUSH_WAIT)
+            tf = time.perf_counter()
+            _flush_checked(glob, timeout=WARM_TIMEOUT if cycle == 0
+                           else FLUSH_WAIT)
+            flush_dt = time.perf_counter() - tf
+            tn = time.perf_counter()
+            wait_evaluated(iv0 + sk0 + cycle + 1,
+                           timeout=WARM_TIMEOUT if cycle == 0
+                           else FLUSH_WAIT)
+            lat = time.perf_counter() - tn
+            if cycle == 0:   # absorbs the packed-evaluation compile
+                absorb_flush, absorb_lat = flush_dt, lat
+            else:
+                flush_watch.append(flush_dt)
+                notify_lat.append(lat)
+
+        received = 0
+        while True:
+            ev = sub.get(timeout=0.2)
+            if ev is None:
+                break
+            received += 1
+        eng.hub.unsubscribe(sub)
+
+        evaluated = kind_sum(glob._c_watch_evaluated) - ev0
+        fired = kind_sum(glob._c_watch_fired) - f0
+        suppressed = kind_sum(glob._c_watch_suppressed) - s0
+        dropped = kind_sum(glob._c_watch_notify_dropped) - d0
+        intervals = eng.intervals_evaluated - iv0
+        skipped = eng.intervals_skipped - sk0
+        launches = eng.launches_total - ln0
+
+        # closed-form expectations from the breach pattern: breaching
+        # threshold/quantile watches fire on interval 1 then hold
+        # (suppressed x3); breaching delta watches prime on interval 1,
+        # fire on 2, hold (x2); every cardinality watch posts exactly
+        # one NO_DATA transition on interval 2
+        fired_exp = thr_b + quant_b + delta_b
+        supp_exp = (thr_b + quant_b) * (K_WATCH - 1) \
+            + delta_b * (K_WATCH - 2)
+        events_exp = fired_exp + n_card
+        exact = (evaluated == n_watch * K_WATCH
+                 and fired == fired_exp and suppressed == supp_exp
+                 and received + dropped == events_exp and skipped == 0)
+
+        phase("checkpoint_roundtrip")
+        blob1 = _json.dumps(eng.snapshot(), separators=(",", ":"))
+        srv2 = _mk_server([DebugMetricSink()], watch_enabled=True,
+                          watch_max_active=n_watch + 16,
+                          tpu_counter_capacity=1 << 8,
+                          tpu_histo_capacity=1 << 6)
+        try:
+            srv2.watch_engine.restore(_json.loads(blob1))
+            blob2 = _json.dumps(srv2.watch_engine.snapshot(),
+                                separators=(",", ":"))
+        finally:
+            srv2.shutdown()
+        client.close()
+
+        base_p99 = float(np.percentile(flush_base, 99))
+        watch_p99 = float(np.percentile(flush_watch, 99))
+        on_tpu = jax.default_backend() == "tpu"
+        return {
+            "config": 13, "name": "watch_storm",
+            "n_watches": n_watch, "n_watches_http": http_registered,
+            "watch_kinds": {"threshold": n_thr, "delta": n_delta,
+                            "quantile": n_quant, "cardinality": n_card},
+            "register_seconds": round(reg_dt, 3),
+            "registrations_per_sec": round(n_watch / reg_dt, 1),
+            "watch_intervals": int(intervals),
+            "intervals_skipped": int(skipped),
+            "device_launches": int(launches),
+            "one_fused_launch_per_interval": bool(
+                launches == intervals == K_WATCH and skipped == 0),
+            "evaluations_per_interval": n_watch,
+            "fired": int(fired), "suppressed": int(suppressed),
+            "notify_received": int(received),
+            "notify_dropped": int(dropped),
+            "transitions_expected": int(events_exp),
+            "accounting_exact": bool(exact),
+            "watch_state_ckpt_byte_exact": bool(blob1 == blob2),
+            "flush_seconds_baseline": [round(s, 3) for s in flush_base],
+            "flush_seconds": [round(s, 3) for s in flush_watch],
+            "flush_p99_seconds_baseline": round(base_p99, 3),
+            "flush_p99_seconds": round(watch_p99, 3),
+            "flush_p99_interference_free": bool(
+                watch_p99 <= base_p99 * 1.5 + 0.5),
+            "eval_absorb_seconds": round(absorb_lat, 3),
+            "flush_absorb_seconds": round(absorb_flush, 3),
+            "notify_latency_seconds": [round(s, 3) for s in notify_lat],
+            "on_chip_gate_notify_armed": on_tpu,
+            "notify_p99_within_interval": (
+                bool(notify_lat)
+                and float(np.percentile(notify_lat, 99)) <= interval_s)
+            if on_tpu else None,
+        }
+    finally:
+        glob.shutdown()
+
+
 CONFIGS = {1: config1_counter_replay, 2: config2_zipf_timers,
            3: config3_set_cardinality, 4: config4_global_merge,
            5: config5_span_firehose, 6: config6_cardinality_stress,
            7: config7_checkpoint_restore, 8: config8_overload_storm,
            9: config9_duplicate_storm, 10: config10_wire_to_flush_firehose,
-           11: config11_collective_merge, 12: config12_elastic_resize}
+           11: config11_collective_merge, 12: config12_elastic_resize,
+           13: config13_watch_storm}
 
 # Per-config subprocess budget: backend init + first XLA compiles of the
 # config's size buckets (~tens of seconds each on the tunneled chip) +

@@ -358,6 +358,44 @@ CASES = [
         """},
     ),
     (
+        # surface 2 of the same pass: the watch tier's drop-oldest
+        # hand-off (engine.offer / StreamHub.offer shapes)
+        "accounting-flow",
+        lambda p: accounting_flow.run(p, targets=["pkg"], send_targets={}),
+        # positive: drop-oldest that discards the displaced interval
+        # without counting it anywhere
+        {"pkg/watchq.py": """
+            import queue
+            def offer(jobs, job):
+                try:
+                    jobs.put_nowait(job)
+                except queue.Full:
+                    try:
+                        jobs.get_nowait()
+                    except queue.Empty:
+                        pass
+                    jobs.put_nowait(job)
+        """},
+        # negative: both loss branches account (the engine.offer shape:
+        # displaced interval AND wedged re-put each count suppressed)
+        {"pkg/watchq.py": """
+            import queue
+            def offer(jobs, job, counters):
+                try:
+                    jobs.put_nowait(job)
+                except queue.Full:
+                    try:
+                        jobs.get_nowait()
+                        counters.suppressed += 1
+                    except queue.Empty:
+                        counters.raced_empty += 1
+                    try:
+                        jobs.put_nowait(job)
+                    except queue.Full:
+                        counters.suppressed += 1
+        """},
+    ),
+    (
         # surface 3 of the same pass (pytest uniquifies the repeated id)
         "accounting-flow",
         lambda p: accounting_flow.run(p, targets=[], send_targets={},

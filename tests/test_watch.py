@@ -1,0 +1,732 @@
+"""Watch tier tests: hysteresis/debounce state machines on a virtual
+clock, the /watch HTTP surface (including the shared shutdown gate and
+SSE stream), exact fired/suppressed/dropped accounting under storms,
+byte-exact checkpoint round trips, reshard survival, and value parity
+of the fused packed evaluation against per-watch POST /query."""
+
+import json
+import threading
+import time
+import types
+import urllib.error
+import urllib.request
+
+import pytest
+
+from veneur_tpu.server.server import Server
+from veneur_tpu.sinks.debug import DebugMetricSink
+from veneur_tpu.watch.model import (Watch, WatchError, parse_watch)
+from veneur_tpu.watch.notify import StreamHub
+from tests.test_server import (_send_udp, _wait_processed, _wait_until,
+                               by_name, small_config)
+
+
+def _watch_cfg(**kw):
+    # a long interval pins the offered-interval count to trigger_flush
+    # calls, which is what makes the accounting assertions exact
+    defaults = dict(http_address="127.0.0.1:0", watch_enabled=True,
+                    interval="600s")
+    defaults.update(kw)
+    return small_config(**defaults)
+
+
+def _http(srv, path, data=None, method=None, timeout=60.0):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.http_port}{path}", data=data,
+        method=method, headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, resp.read()
+
+
+def _register(srv, body):
+    status, raw = _http(srv, "/watch", json.dumps(body).encode())
+    assert status == 201
+    return json.loads(raw)
+
+
+def _flush_and_evaluate(srv, n):
+    """One offered interval: flush, then wait until the engine has
+    evaluated it (evaluation rides the engine's own thread)."""
+    assert srv.trigger_flush(timeout=300)
+    _wait_until(lambda: srv.watch_engine.intervals_evaluated
+                + srv.watch_engine.intervals_skipped >= n,
+                what=f"watch interval {n} evaluated")
+
+
+def _ingest(srv, lines, quiet_s=0.5):
+    """Send `lines` and wait until they are processed. Every flush
+    feeds ~16 self-metrics back through `aggregator.processed`
+    asynchronously, so a cumulative count can be satisfied by feedback
+    instead of our datagrams; waiting for the counter to go quiet
+    first makes the delta pin OUR lines exactly."""
+    agg = srv.aggregator
+    last, t_stable = agg.processed, time.time()
+    while time.time() - t_stable < quiet_s:
+        cur = agg.processed
+        if cur != last:
+            last, t_stable = cur, time.time()
+        time.sleep(0.05)
+    _send_udp(srv.local_addr(), lines)
+    _wait_until(lambda: agg.processed >= last + len(lines),
+                what="test datagrams processed")
+
+
+# -- registration validation --------------------------------------------------
+
+def test_parse_watch_rejects_malformed_bodies():
+    for body in [
+        None, {}, [], "x",
+        {"op": ">", "threshold": 1},                      # no selector
+        {"name": "a", "prefix": "b", "threshold": 1},     # two selectors
+        {"name": "", "threshold": 1},                     # empty selector
+        {"name": "a"},                                    # no threshold
+        {"name": "a", "threshold": "wat"},
+        {"name": "a", "threshold": float("inf")},
+        {"name": "a", "op": "!=", "threshold": 1},
+        {"name": "a", "threshold": 1, "hysteresis": -1},
+        {"name": "a", "threshold": 1, "for_intervals": 0},
+        {"name": "a", "threshold": 1, "for_intervals": 100000},
+        {"name": "a", "threshold": 1, "no_data_intervals": -2},
+        {"name": "a", "threshold": 1, "kind": "sparkline"},
+        {"name": "a", "threshold": 1, "quantile": 0.5},   # not a quantile watch
+        {"name": "a", "threshold": 1, "kind": "quantile", "quantile": 2},
+        {"name": "a", "threshold": 1, "metric_kinds": ["set"]},
+        {"name": "a", "threshold": 1, "kind": "cardinality",
+         "metric_kinds": ["counter"]},
+        {"name": "a", "threshold": 1, "tags": [7]},
+        {"name": "a", "threshold": 1, "description": "x" * 300},
+    ]:
+        with pytest.raises(WatchError):
+            parse_watch(body)
+
+
+def test_parse_watch_canonical_defaults():
+    spec = parse_watch({"name": "a", "threshold": 5})
+    assert spec == {"kind": "threshold", "name": "a", "op": ">",
+                    "threshold": 5.0, "hysteresis": 0.0,
+                    "for_intervals": 1, "no_data_intervals": 0}
+    q = parse_watch({"match": "api.*", "kind": "quantile", "threshold": 1})
+    assert q["quantile"] == 0.99          # the Datadog-shaped default
+
+
+# -- state machines on a virtual clock ---------------------------------------
+
+def _watch(**body):
+    body.setdefault("name", "m")
+    return Watch(1, parse_watch(body))
+
+
+def test_debounce_fires_on_consecutive_breaches_only():
+    w = _watch(threshold=5, for_intervals=3)
+    assert w.observe(9, 1) == (None, True)        # streak 1: suppressed
+    assert w.observe(9, 2) == (None, True)        # streak 2: suppressed
+    assert w.observe(1, 3) == (None, False)       # reset — no alert ever
+    assert w.observe(9, 4) == (None, True)
+    assert w.observe(9, 5) == (None, True)
+    assert w.observe(9, 6) == (("OK", "ALERT"), False)
+    assert w.status == "ALERT" and w.last_change_ts == 6
+
+
+def test_hysteresis_band_holds_the_alert():
+    w = _watch(op=">", threshold=100, hysteresis=10)
+    assert w.observe(101, 1) == (("OK", "ALERT"), False)
+    assert w.observe(105, 2) == (None, True)      # still breaching: held
+    assert w.observe(95, 3) == (None, False)      # in the band: held, no breach
+    assert w.status == "ALERT"
+    assert w.observe(90, 4) == (("ALERT", "OK"), False)  # band edge clears
+    # without hysteresis the same series would flap every interval
+    f = _watch(op=">", threshold=100)
+    assert f.observe(101, 1) == (("OK", "ALERT"), False)
+    assert f.observe(95, 2) == (("ALERT", "OK"), False)
+
+
+def test_down_watch_hysteresis_mirrors():
+    w = _watch(op="<", threshold=10, hysteresis=5)
+    assert w.observe(9, 1) == (("OK", "ALERT"), False)
+    assert w.observe(12, 2) == (None, False)      # above threshold, in band
+    assert w.observe(15, 3) == (("ALERT", "OK"), False)
+
+
+def test_no_data_entry_and_exit():
+    w = _watch(threshold=5, no_data_intervals=2)
+    assert w.observe(1, 1) == (None, False)
+    assert w.observe(None, 2) == (None, False)
+    assert w.observe(None, 3) == (("OK", "NO_DATA"), False)
+    assert w.observe(None, 4) == (None, False)    # already NO_DATA
+    assert w.observe(2, 5) == (("NO_DATA", "OK"), False)
+    # a breaching return from NO_DATA under debounce is OK + suppressed
+    w2 = _watch(threshold=5, for_intervals=2, no_data_intervals=1)
+    assert w2.observe(None, 1) == (("OK", "NO_DATA"), False)
+    assert w2.observe(9, 2) == (("NO_DATA", "OK"), True)
+    assert w2.observe(9, 3) == (("OK", "ALERT"), False)
+    # non-finite matches count as no data
+    w3 = _watch(threshold=5, no_data_intervals=1)
+    assert w3.observe(float("nan"), 1) == (("OK", "NO_DATA"), False)
+
+
+def test_delta_baseline_primes_and_gaps_invalidate():
+    w = _watch(kind="delta", threshold=5)
+    assert w.observe(10, 1) == (None, False)      # primes, no compare
+    assert w.observe(18, 2) == (("OK", "ALERT"), False)   # delta 8 > 5
+    assert w.observe(19, 3) == (("ALERT", "OK"), False)   # delta 1
+    assert w.observe(None, 4) == (None, False)    # gap: baseline dropped
+    assert w.last_value is None
+    assert w.observe(100, 5) == (None, False)     # re-primes — no bogus jump
+    assert w.observe(101, 6) == (None, False)     # delta 1: calm
+
+
+def test_multi_match_reduces_worst_of():
+    up = _watch(op=">", threshold=5)
+    assert up.reduce([1.0, 9.0, 3.0]) == 9.0
+    down = _watch(op="<", threshold=5)
+    assert down.reduce([1.0, 9.0, 3.0]) == 1.0
+    assert up.reduce([]) is None
+
+
+def test_observe_accounting_invariant_fuzz():
+    """Per evaluated interval: a transition into ALERT and a suppression
+    are mutually exclusive — the storm counters rely on it."""
+    import random
+    rng = random.Random(13)
+    for trial in range(50):
+        w = _watch(op=rng.choice([">", "<"]),
+                   threshold=rng.uniform(-5, 5),
+                   hysteresis=rng.choice([0.0, 1.0, 3.0]),
+                   for_intervals=rng.randint(1, 4),
+                   no_data_intervals=rng.choice([0, 2]))
+        for ts in range(1, 60):
+            raw = rng.choice([None, rng.uniform(-10, 10)])
+            transition, suppressed = w.observe(raw, ts)
+            fired = transition is not None and transition[1] == "ALERT"
+            assert not (fired and suppressed)
+            assert w.status in ("OK", "ALERT", "NO_DATA")
+
+
+def test_watch_state_round_trip_is_identity():
+    w = _watch(kind="delta", threshold=5, hysteresis=1, for_intervals=2,
+               no_data_intervals=3, tags=["k:v"], description="d")
+    w.observe(10, 1)
+    w.observe(18, 2)
+    clone = Watch(w.wid, parse_watch(
+        {k: v for k, v in w.to_dict().items() if k != "id"}))
+    clone.load_state(w.state_dict())
+    assert clone.to_dict() == w.to_dict()
+    assert clone.state_dict() == w.state_dict()
+    # byte-exact under the checkpoint chunk's compact serialization
+    blob = json.dumps({"spec": w.to_dict(), "state": w.state_dict()},
+                      separators=(",", ":"))
+    blob2 = json.dumps({"spec": clone.to_dict(),
+                        "state": clone.state_dict()},
+                       separators=(",", ":"))
+    assert blob == blob2
+
+
+# -- HTTP surface -------------------------------------------------------------
+
+def test_watch_endpoints_404_when_disabled():
+    srv = Server(small_config(http_address="127.0.0.1:0"),
+                 metric_sinks=[DebugMetricSink()])
+    srv.start()
+    try:
+        assert srv.watch_engine is None
+        for method, path, data in [("GET", "/watch", None),
+                                   ("POST", "/watch", b"{}"),
+                                   ("DELETE", "/watch/1", None),
+                                   ("GET", "/watch/stream", None)]:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _http(srv, path, data, method)
+            assert ei.value.code == 404, path
+    finally:
+        srv.shutdown()
+
+
+def test_watch_http_register_list_delete_roundtrip():
+    srv = Server(_watch_cfg(), metric_sinks=[DebugMetricSink()])
+    srv.start()
+    try:
+        out = _register(srv, {"name": "rt.hits", "threshold": 5,
+                              "hysteresis": 1, "for_intervals": 2})
+        assert out["id"] == 1 and out["threshold"] == 5.0
+        status, raw = _http(srv, "/watch")
+        listed = json.loads(raw)
+        assert status == 200 and listed["active"] == 1
+        assert listed["watches"][0]["status"] == "OK"
+        # client errors: malformed JSON, empty body, bad registration,
+        # non-integer delete id
+        for data, code in [(b"not json", 400), (b"", 400),
+                           (json.dumps({"threshold": 1}).encode(), 400)]:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _http(srv, "/watch", data)
+            assert ei.value.code == code
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _http(srv, "/watch/seven", method="DELETE")
+        assert ei.value.code == 400
+        status, raw = _http(srv, "/watch/1", method="DELETE")
+        assert status == 200 and json.loads(raw) == {"deleted": 1}
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _http(srv, "/watch/1", method="DELETE")
+        assert ei.value.code == 404
+        assert srv.watch_engine.n_active == 0
+    finally:
+        srv.shutdown()
+
+
+def test_watch_register_429_at_cap():
+    srv = Server(_watch_cfg(watch_max_active=2),
+                 metric_sinks=[DebugMetricSink()])
+    srv.start()
+    try:
+        _register(srv, {"name": "cap.a", "threshold": 1})
+        _register(srv, {"name": "cap.b", "threshold": 1})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _http(srv, "/watch",
+                  json.dumps({"name": "cap.c", "threshold": 1}).encode())
+        assert ei.value.code == 429
+    finally:
+        srv.shutdown()
+
+
+def test_watch_stream_delivers_transitions_and_caps_subscribers():
+    from veneur_tpu.cli.watch import tail_events
+
+    srv = Server(_watch_cfg(watch_stream_max_subscribers=1),
+                 metric_sinks=[DebugMetricSink()])
+    srv.start()
+    try:
+        _register(srv, {"name": "sse.c", "threshold": 5})
+        _register(srv, {"name": "sse.ghost", "threshold": 1,
+                        "no_data_intervals": 1})
+        # subscribe BEFORE the transition (only transitions fan out)
+        resp = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.http_port}/watch/stream", timeout=60)
+        try:
+            # the subscriber cap answers 503 through the same gate chain
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _http(srv, "/watch/stream")
+            assert ei.value.code == 503
+            _ingest(srv, [b"sse.c:10|c"])
+            _flush_and_evaluate(srv, 1)
+            events = list(tail_events(resp, limit=2))
+        finally:
+            resp.close()
+        assert [e["to"] for e in events] == ["ALERT", "NO_DATA"]
+        assert events[0] == {"id": 1, "kind": "threshold", "name": "sse.c",
+                             "from": "OK", "to": "ALERT",
+                             "ts": events[0]["ts"], "threshold": 5.0,
+                             "value": 10.0}
+        assert events[1]["name"] == "sse.ghost"
+    finally:
+        srv.shutdown()
+
+
+def test_watch_shares_shutdown_gate_and_readyz_phase():
+    from veneur_tpu.server.health import ready_phase
+
+    srv = Server(_watch_cfg(), metric_sinks=[DebugMetricSink()])
+    srv.start()
+    try:
+        _register(srv, {"name": "gate.c", "threshold": 1})
+        assert ready_phase(srv) == "ready"
+        status, raw = _http(srv, "/readyz")
+        assert status == 200 and json.loads(raw)["phase"] == "ready"
+        srv._shutdown.set()
+        assert ready_phase(srv) == "draining"
+        for method, path, data in [("GET", "/watch", None),
+                                   ("POST", "/watch", b"{}"),
+                                   ("DELETE", "/watch/1", None),
+                                   ("GET", "/watch/stream", None),
+                                   ("GET", "/readyz", None)]:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _http(srv, path, data, method)
+            assert ei.value.code == 503, path
+    finally:
+        srv._shutdown.clear()
+        srv.shutdown()
+
+
+# -- exact accounting ---------------------------------------------------------
+
+class _Ctr:
+    """Counter stub recording per-kind increments exactly."""
+
+    def __init__(self):
+        self.by_kind = {}
+
+    def inc(self, n=1.0, **labels):
+        k = labels.get("kind")
+        self.by_kind[k] = self.by_kind.get(k, 0) + n
+
+
+def test_storm_fired_suppressed_evaluated_reconcile_exactly():
+    """Two watches, three offered intervals, every counter predicted
+    from the state-machine semantics — nothing is approximate."""
+    srv = Server(_watch_cfg(), metric_sinks=[DebugMetricSink()])
+    srv.start()
+    try:
+        _register(srv, {"name": "st.c", "threshold": 5})
+        _register(srv, {"name": "st.c", "threshold": 5, "for_intervals": 3})
+        for i in range(1, 4):
+            _ingest(srv, [b"st.c:10|c"])
+            _flush_and_evaluate(srv, i)
+        eng = srv.watch_engine
+        assert eng.intervals_evaluated == 3
+        assert eng.intervals_skipped == 0
+        # ONE fused launch per interval — never per-watch dispatches
+        assert eng.launches_total == 3
+        assert srv._c_watch_evaluated.value(kind="threshold") == 6.0
+        # watch 1 fires interval 1; watch 2's debounce fires interval 3
+        assert srv._c_watch_fired.value(kind="threshold") == 2.0
+        # watch 2 suppressed intervals 1+2 (debounce pending); watch 1
+        # suppressed intervals 2+3 (hysteresis hold while ALERT)
+        assert srv._c_watch_suppressed.value(kind="threshold") == 4.0
+        assert srv._c_watch_eval_ns.value() > 0
+        assert srv._g_watch_active.value(kind="threshold") == 2.0
+    finally:
+        srv.shutdown()
+
+
+def test_stream_hub_drop_oldest_exact_accounting():
+    ctr = _Ctr()
+    hub = StreamHub(4, dropped=ctr, depth=4)
+    sub = hub.subscribe()
+    events = [{"id": i, "kind": "threshold"} for i in range(10)]
+    dropped = hub.publish(events)
+    assert dropped == 6
+    assert ctr.by_kind == {"threshold": 6}
+    # the survivors are the NEWEST four, in order
+    kept = [sub.get(timeout=1.0)["id"] for _ in range(4)]
+    assert kept == [6, 7, 8, 9]
+    hub.unsubscribe(sub)
+    # publish with no subscribers drops nothing; at the cap subscribe
+    # is refused (the HTTP layer turns None into a 503)
+    assert hub.publish(events) == 0
+    hub2 = StreamHub(1, dropped=ctr)
+    assert hub2.subscribe() is not None
+    assert hub2.subscribe() is None
+
+
+def test_offer_backlog_drops_oldest_interval_with_accounting():
+    """The depth-2 job queue sheds the OLDEST interval when the engine
+    falls behind, counting one suppression per active watch — the
+    flush worker never blocks."""
+    from veneur_tpu.watch.engine import WatchEngine
+
+    stub = types.SimpleNamespace(
+        aggregator=types.SimpleNamespace(spec=None))
+    supp = _Ctr()
+    eng = WatchEngine(stub, suppressed=supp)
+    try:
+        eng.register({"name": "bk.a", "threshold": 1})
+        eng.register({"name": "bk.b", "threshold": 1, "kind": "delta"})
+        entered, release = threading.Event(), threading.Event()
+        seen = []
+
+        def stall(state, table, set_shift, ts):
+            seen.append(ts)
+            entered.set()
+            release.wait(30)
+
+        eng._evaluate_interval = stall
+        eng.offer(None, None, 0, 1)     # engine thread picks this up...
+        assert entered.wait(30)         # ...and stalls inside it
+        eng.offer(None, None, 0, 2)     # queue slot 1
+        eng.offer(None, None, 0, 3)     # queue slot 2 (full)
+        eng.offer(None, None, 0, 4)     # displaces ts=2: drop-oldest
+        assert eng.intervals_skipped == 1
+        assert supp.by_kind == {"threshold": 1, "delta": 1}
+        release.set()
+        _wait_until(lambda: len(seen) == 3, what="backlog drained")
+    finally:
+        release.set()
+        eng.close()
+    # every offered interval is accounted for: evaluated by the engine
+    # thread or counted as skipped — nothing silent
+    assert seen == [1, 3, 4]
+    assert eng.intervals_skipped == 1
+
+
+def test_overload_critical_skips_evaluation_counted():
+    from veneur_tpu.reliability.overload import CRITICAL
+
+    srv = Server(_watch_cfg(overload_enabled=True),
+                 metric_sinks=[DebugMetricSink()])
+    srv.start()
+    try:
+        _register(srv, {"name": "ov.c", "threshold": 1})
+        _ingest(srv, [b"ov.c:10|c"])
+        srv._overload.state = CRITICAL
+        _flush_and_evaluate(srv, 1)
+        assert srv.watch_engine.intervals_skipped == 1
+        assert srv.watch_engine.intervals_evaluated == 0
+        assert srv.watch_engine.launches_total == 0
+        assert srv._c_watch_suppressed.value(kind="threshold") == 1.0
+        # back below CRITICAL the next interval evaluates normally
+        srv._overload.state = 0
+        _ingest(srv, [b"ov.c:10|c"])
+        _flush_and_evaluate(srv, 2)
+        assert srv.watch_engine.intervals_evaluated == 1
+        assert srv._c_watch_fired.value(kind="threshold") == 1.0
+    finally:
+        srv.shutdown()
+
+
+# -- persistence --------------------------------------------------------------
+
+def test_watch_state_byte_exact_across_checkpoint_restore(tmp_path):
+    """snapshot → encode_to_dir → load_dir → restore → snapshot must
+    serialize to IDENTICAL bytes: registrations, status, debounce
+    streaks and delta baselines all survive."""
+    cfg = dict(checkpoint_dir=str(tmp_path / "ckpt"), native_ingest=False)
+    srv = Server(_watch_cfg(**cfg), metric_sinks=[DebugMetricSink()])
+    srv.start()
+    try:
+        _register(srv, {"name": "ck.c", "threshold": 5, "hysteresis": 2,
+                        "for_intervals": 2, "description": "ckpt"})
+        _register(srv, {"prefix": "ck.", "kind": "delta", "threshold": 3})
+        _register(srv, {"name": "ck.ghost", "threshold": 1,
+                        "no_data_intervals": 1})
+        for i in range(1, 3):
+            _ingest(srv, [b"ck.c:10|c"])
+            _flush_and_evaluate(srv, i)
+        snap1 = srv.watch_engine.snapshot()
+        # the states are non-trivial: an ALERT (debounce completed), a
+        # primed delta baseline, and a NO_DATA
+        states = {w["spec"]["id"]: w["state"]["status"]
+                  for w in snap1["watches"]}
+        assert states == {1: "ALERT", 2: "OK", 3: "NO_DATA"}
+        assert snap1["watches"][1]["state"]["last_value"] == 10.0
+    finally:
+        srv.shutdown()          # final checkpoint carries the chunk
+
+    srv2 = Server(_watch_cfg(restore_on_start=True, **cfg),
+                  metric_sinks=[DebugMetricSink()])
+    srv2.start()
+    try:
+        snap2 = srv2.watch_engine.snapshot()
+        blob1 = json.dumps(snap1, separators=(",", ":"))
+        blob2 = json.dumps(snap2, separators=(",", ":"))
+        assert blob1 == blob2
+        # new registrations never reuse restored ids
+        out = _register(srv2, {"name": "ck.new", "threshold": 1})
+        assert out["id"] == 4
+    finally:
+        srv2.shutdown()
+
+
+def test_restore_ignores_malformed_watch_chunk():
+    srv = Server(_watch_cfg(), metric_sinks=[DebugMetricSink()])
+    srv.start()
+    try:
+        srv.watch_engine.restore({"watches": [{"spec": {"op": "!!"}}]})
+        assert srv.watch_engine.n_active == 0     # logged, not fatal
+        srv.watch_engine.restore(
+            {"next_id": 9,
+             "watches": [{"spec": {"id": 5, "name": "ok.c",
+                                   "threshold": 1},
+                          "state": {"status": "ALERT", "streak": 1}}]})
+        listed = srv.watch_engine.list_watches()
+        assert [w["id"] for w in listed] == [5]
+        assert listed[0]["status"] == "ALERT"
+    finally:
+        srv.shutdown()
+
+
+# -- reshard survival ---------------------------------------------------------
+
+def test_watch_survives_4_to_8_reshard():
+    srv = Server(_watch_cfg(reshard_enabled=True, tpu_n_shards=4),
+                 metric_sinks=[DebugMetricSink()])
+    srv.start()
+    try:
+        _register(srv, {"prefix": "rs.", "threshold": 5})
+        _ingest(srv, [b"rs.c:10|c"])
+        _flush_and_evaluate(srv, 1)
+        assert srv.watch_engine.list_watches()[0]["status"] == "ALERT"
+        summary = srv.trigger_reshard(8, timeout=300)
+        assert not summary["failed"]
+        assert srv.aggregator.n_shards == 8
+        _ingest(srv, [b"rs.c:10|c"])
+        _flush_and_evaluate(srv, 2)
+        w = srv.watch_engine.list_watches()[0]
+        # the registration, its firing state AND its value survive the
+        # mesh resize; the plan re-resolved against the 8-shard table
+        assert w["status"] == "ALERT" and w["value"] == 10.0
+        assert srv.watch_engine.intervals_evaluated == 2
+    finally:
+        srv.shutdown()
+
+
+# -- value parity vs the query tier -------------------------------------------
+
+@pytest.mark.parametrize("shards", [1, 8])
+def test_watch_values_equal_query_values(shards):
+    """The fused watch evaluation and POST /query run the same jitted
+    flush program over the same interval state, so per-watch values
+    must equal per-query answers bit for bit, on every backend."""
+    srv = Server(_watch_cfg(query_enabled=True, tpu_n_shards=shards),
+                 metric_sinks=[DebugMetricSink()])
+    srv.start()
+    try:
+        _register(srv, {"name": "vx.count", "threshold": 0.5})
+        _register(srv, {"name": "vx.gauge", "threshold": 1e9})
+        _register(srv, {"name": "vx.timer", "kind": "quantile",
+                        "quantile": 0.5, "threshold": 1e9})
+        _register(srv, {"name": "vx.set", "kind": "cardinality",
+                        "threshold": 0.5})
+        lines = ([b"vx.count:2|c", b"vx.count:3|c", b"vx.gauge:7.5|g"]
+                 + [b"vx.set:u%d|s" % i for i in range(32)]
+                 + [b"vx.timer:%d|ms" % v for v in (10, 20, 30, 40, 50)])
+        _ingest(srv, lines)
+        status, raw = _http(srv, "/query", json.dumps({"queries": [
+            {"name": "vx.count", "kinds": ["counter"]},
+            {"name": "vx.gauge", "kinds": ["gauge"]},
+            {"name": "vx.timer", "kinds": ["timer"], "quantiles": [0.5]},
+            {"name": "vx.set", "kinds": ["set"]},
+        ]}).encode())
+        q = json.loads(raw)["results"]
+        _flush_and_evaluate(srv, 1)
+        w = {d["name"]: d for d in srv.watch_engine.list_watches()}
+        assert w["vx.count"]["value"] == \
+            q[0]["matches"][0]["value"] == 5.0
+        assert w["vx.gauge"]["value"] == q[1]["matches"][0]["value"] == 7.5
+        assert w["vx.timer"]["value"] == \
+            q[2]["matches"][0]["quantiles"]["0.5"]
+        assert w["vx.set"]["value"] == q[3]["matches"][0]["estimate"]
+        assert srv.watch_engine.launches_total == 1
+    finally:
+        srv.shutdown()
+
+
+def test_watch_values_equal_query_values_collective():
+    """Same parity on a collective-attached topology: the global tier's
+    watches see mesh-global (replica-merged) state."""
+    srv = Server(_watch_cfg(query_enabled=True, collective_enabled=True,
+                            collective_group="w1", tpu_n_shards=4,
+                            tpu_n_replicas=2),
+                 metric_sinks=[DebugMetricSink()])
+    srv.start()
+    lsrv = Server(small_config(collective_attach="w1"),
+                  metric_sinks=[DebugMetricSink()])
+    try:
+        lsrv.start()
+        _register(srv, {"name": "cx.count", "threshold": 0.5})
+        lines = [b"cx.count:2|c|#veneurglobalonly",
+                 b"cx.count:3|c|#veneurglobalonly"]
+        _send_udp(lsrv.local_addr(), lines)
+        _wait_processed(lsrv, len(lines))
+        lsrv.trigger_flush()
+        assert srv.aggregator.absorbed_rows > 0
+        status, raw = _http(srv, "/query", json.dumps(
+            {"name": "cx.count", "kinds": ["counter"]}).encode())
+        qv = json.loads(raw)["results"][0]["matches"][0]["value"]
+        _flush_and_evaluate(srv, 1)
+        w = srv.watch_engine.list_watches()[0]
+        assert w["value"] == qv == 5.0 and w["status"] == "ALERT"
+    finally:
+        lsrv.shutdown()
+        srv.shutdown()
+
+
+# -- operator CLI -------------------------------------------------------------
+
+def test_cli_watch_roundtrip(capsys):
+    from veneur_tpu.cli import watch as cli_watch
+
+    srv = Server(_watch_cfg(), metric_sinks=[DebugMetricSink()])
+    srv.start()
+    url = f"http://127.0.0.1:{srv.http_port}"
+    try:
+        rc = cli_watch.main(["--url", url, "register", "cli.hits",
+                             "--threshold", "5", "--hysteresis", "1",
+                             "--for-intervals", "1"])
+        assert rc == 0
+        assert "registered watch #1" in capsys.readouterr().out
+        rc = cli_watch.main(["--url", url, "list"])
+        assert rc == 0
+        text = capsys.readouterr().out
+        assert "#1" in text and "cli.hits" in text and "> 5" in text
+
+        # tail one transition end to end through the SSE stream
+        got = []
+        done = threading.Event()
+
+        def tailer():
+            # subscribe first; the generator returns after one event
+            resp = cli_watch._request(f"{url}/watch/stream", 60.0)
+            with resp:
+                got.extend(cli_watch.tail_events(resp, limit=1))
+            done.set()
+
+        t = threading.Thread(target=tailer, daemon=True)
+        t.start()
+        _wait_until(lambda: srv.watch_engine.hub.n_subscribers == 1,
+                    what="SSE subscriber attached")
+        _ingest(srv, [b"cli.hits:9|c"])
+        _flush_and_evaluate(srv, 1)
+        assert done.wait(60)
+        assert got[0]["to"] == "ALERT" and got[0]["value"] == 9.0
+
+        rc = cli_watch.main(["--url", url, "delete", "1"])
+        assert rc == 0
+        assert "deleted watch #1" in capsys.readouterr().out
+        # errors surface as exit code 1 with the server's body
+        rc = cli_watch.main(["--url", url, "delete", "1"])
+        assert rc == 1
+        assert "404" in capsys.readouterr().err
+    finally:
+        srv.shutdown()
+
+
+def test_cli_watch_build_registration_validation():
+    from veneur_tpu.cli.watch import build_registration, main
+
+    ns = types.SimpleNamespace(
+        kind="quantile", name=None, prefix="api.", match=None, op=">",
+        threshold=250.0, hysteresis=25.0, for_intervals=3,
+        no_data_intervals=0, quantile=0.99, metric_kind=["timer"],
+        tag=["env:prod"], description="p99 page")
+    body = build_registration(ns)
+    assert body == {"kind": "quantile", "prefix": "api.", "op": ">",
+                    "threshold": 250.0, "hysteresis": 25.0,
+                    "for_intervals": 3, "quantile": 0.99,
+                    "metric_kinds": ["timer"], "tags": ["env:prod"],
+                    "description": "p99 page"}
+    # parse_watch accepts exactly what the CLI builds
+    parse_watch(body)
+    ns.prefix = None
+    with pytest.raises(SystemExit):
+        build_registration(ns)
+
+
+# -- metrics + inventory ------------------------------------------------------
+
+def test_watch_metrics_registered_and_telemetry_table():
+    # go through the REAL exposition round trip (render -> parse) —
+    # scraped names arrive underscore-mangled (veneur_watch_*), which a
+    # dot-name matcher would silently never see
+    from veneur_tpu.cli.prometheus import parse_exposition
+    from veneur_tpu.cli.telemetry import watch_table
+    from veneur_tpu.observability import render_prometheus
+    from veneur_tpu.watch.model import WATCH_KINDS
+
+    srv = Server(_watch_cfg(), metric_sinks=[DebugMetricSink()])
+    srv.start()
+    try:
+        _register(srv, {"name": "tm.c", "threshold": 1})
+        _ingest(srv, [b"tm.c:5|c"])
+        _flush_and_evaluate(srv, 1)
+        _, samples = parse_exposition(render_prometheus(srv.metrics))
+        assert any(n.startswith("veneur_watch_") for n, _lb, _v in samples)
+        table = watch_table(samples)
+        # header + one row per kind: the active gauge exposes all four
+        # kinds (zeros included), so the whole estate is visible
+        assert len(table) == 1 + len(WATCH_KINDS)
+        assert "active" in table[0] and "fired" in table[0]
+        thr = next(ln.split() for ln in table[1:]
+                   if ln.split()[0] == "threshold")
+        row = dict(zip(table[0].split()[1:], thr[1:]))
+        assert row["active"] == "1" and row["evaluated"] == "1" \
+            and row["fired"] == "1"
+        assert watch_table([("veneur_ring_depth", {}, 0.0)]) == []
+    finally:
+        srv.shutdown()

@@ -33,6 +33,7 @@ TARGETS = [
     "veneur_tpu/protocol",
     "veneur_tpu/forward",
     "veneur_tpu/reliability",
+    "veneur_tpu/watch",
 ]
 
 # counter families that discard sites rely on; each must appear as a
@@ -45,6 +46,7 @@ REQUIRED_COUNTERS = [
     "veneur.forward.spill.dropped_total",
     "veneur.tcp.rejected_total",
     "veneur.tcp.idle_closed_total",
+    "veneur.watch.notify_dropped_total",
 ]
 
 # exception names whose handlers ARE discard sites

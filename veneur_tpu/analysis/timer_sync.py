@@ -41,6 +41,7 @@ FILES = [
     "veneur_tpu/server/sharded_aggregator.py",
     "veneur_tpu/collective/tier.py",
     "veneur_tpu/query/engine.py",
+    "veneur_tpu/watch/engine.py",
 ]
 
 _SYNC_LEAVES = ("block_until_ready", "sync_and_time")

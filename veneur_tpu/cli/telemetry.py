@@ -57,6 +57,34 @@ def ring_table(samples) -> list:
             for r in rows]
 
 
+def watch_table(samples) -> list:
+    """Render the streaming watch tier family (veneur.watch.*,
+    kind=<watch kind> label) as one aligned row per watch kind — the
+    operator's firing/suppression/drop balance sheet (README §Watches).
+    Empty when the watch tier is off or has no registrations."""
+    per_kind: dict = {}
+    cols: list = []
+    for name, labels, value in samples:
+        # exposition names arrive underscore-mangled (veneur_watch_*)
+        if not name.startswith("veneur_watch_") or "kind" not in labels:
+            continue
+        stat = name[len("veneur_watch_"):]
+        if stat.endswith("_total"):
+            stat = stat[:-len("_total")]
+        if stat not in cols:
+            cols.append(stat)
+        per_kind.setdefault(labels["kind"], {})[stat] = value
+    if not per_kind:
+        return []
+    rows = [["kind"] + cols]
+    for kind in sorted(per_kind):
+        rows.append([kind] + [f"{per_kind[kind].get(c, 0):g}"
+                              for c in cols])
+    widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
+    return ["  ".join(f"{cell:>{w}}" for cell, w in zip(r, widths))
+            for r in rows]
+
+
 def dump_once(fetch, as_json: bool, out=None) -> int:
     """One scrape → sorted text (or JSON) on `out`. Returns an exit
     code: 1 on fetch failure, 0 otherwise (an empty exposition is a
@@ -85,6 +113,12 @@ def dump_once(fetch, as_json: bool, out=None) -> int:
     if table:
         print("", file=out)
         print("native ingest rings:", file=out)
+        for line in table:
+            print(f"  {line}", file=out)
+    table = watch_table(samples)
+    if table:
+        print("", file=out)
+        print("standing watches:", file=out)
         for line in table:
             print(f"  {line}", file=out)
     return 0

@@ -365,6 +365,19 @@ class Config:
     reshard_enabled: bool = False
     reshard_transfer_timeout_s: float = 10.0
     reshard_max_parallel_shards: int = 4
+    # streaming watch tier (veneur_tpu/watch/): standing monitors
+    # registered via POST /watch, evaluated as ONE fused device launch
+    # per flush interval on the detached state, transitions streamed
+    # over GET /watch/stream (SSE) and an optional webhook. Off by
+    # default — it spins up an engine thread. watch_max_active caps the
+    # registry (and therefore the packed evaluation's gather size);
+    # watch_stream_max_subscribers caps concurrent SSE consumers;
+    # watch_webhook_url, when set, POSTs each interval's transition
+    # batch through the sink retry/breaker machinery.
+    watch_enabled: bool = False
+    watch_max_active: int = 1 << 17
+    watch_stream_max_subscribers: int = 64
+    watch_webhook_url: str = ""
 
     def parse_interval(self) -> float:
         return parse_duration(self.interval)

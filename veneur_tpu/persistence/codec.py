@@ -122,6 +122,13 @@ def encode_to_dir(dirpath: str, snap: dict, fsync: bool = True) -> int:
                        json.dumps(snap["forward"],
                                   separators=(",", ":")).encode(),
                        None, None))
+    # watch registrations + firing state (JSON sidecar; optional under
+    # the same unknown-chunk compatibility rule as "forward")
+    if snap.get("watches"):
+        chunks.append(("watches",
+                       json.dumps(snap["watches"],
+                                  separators=(",", ":")).encode(),
+                       None, None))
 
     index = []
     offset = 0
@@ -260,6 +267,12 @@ def load_dir(dirpath: str) -> dict:
             forward = json.loads(chunks["forward"])
         except ValueError as e:
             raise CorruptSnapshot(f"{dirpath}: forward chunk: {e}")
+    watches = None
+    if chunks.get("watches"):
+        try:
+            watches = json.loads(chunks["watches"])
+        except ValueError as e:
+            raise CorruptSnapshot(f"{dirpath}: watches chunk: {e}")
     return {
         "agg_kind": manifest["agg_kind"],
         "n_shards": manifest["n_shards"],
@@ -271,6 +284,7 @@ def load_dir(dirpath: str) -> dict:
         "arrays": arrays,
         "spill": chunks.get("spill", b""),
         "forward": forward,
+        "watches": watches,
     }
 
 

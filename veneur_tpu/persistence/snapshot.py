@@ -57,7 +57,8 @@ def build_snapshot(spec, table: KeyTable, result: Dict[str, np.ndarray],
                    n_shards: int, interval_ts: float, hostname: str = "",
                    spill: Optional[bytes] = None,
                    spill_entries: int = 0,
-                   forward_meta: Optional[dict] = None) -> dict:
+                   forward_meta: Optional[dict] = None,
+                   watches: Optional[dict] = None) -> dict:
     """`result`/`raw` are compute_flush's outputs for the interval being
     checkpointed (want_raw=True — both backends emit identical raw keys).
     `table` is the interval's detached KeyTable."""
@@ -114,4 +115,7 @@ def build_snapshot(spec, table: KeyTable, result: Dict[str, np.ndarray],
         "spill_entries": int(spill_entries),
         # exactly-once forwarding state; None/absent = feature off
         "forward": forward_meta,
+        # streaming watch tier registrations + firing state
+        # (veneur_tpu/watch/); None/absent = tier off or no watches
+        "watches": watches,
     }

@@ -4,7 +4,9 @@ Endpoints: GET /healthcheck, GET /healthz (liveness), GET /readyz
 (readiness — see server/health.py), GET /version, GET /builddate,
 POST /import, optional POST/GET /quitquitquit (gated on http_quit,
 server.go:80), GET /debug/profile?seconds=N (gated on
-profile_capture_enabled: on-demand jax.profiler device trace).
+profile_capture_enabled: on-demand jax.profiler device trace), and —
+gated on watch_enabled — POST /watch, GET /watch, DELETE /watch/<id>,
+GET /watch/stream (SSE; see README §Watches).
 
 /import accepts BOTH body formats, optionally zlib-deflated
 (handlers_global.go:134-146):
@@ -223,6 +225,10 @@ def start_http_server(server, address) -> "http.server.ThreadingHTTPServer":
                     {"trace_dir": trace_dir,
                      "seconds": min(seconds, 60.0)}).encode(),
                     "application/json")
+            elif self.path == "/watch":
+                self._handle_watch_list()
+            elif self.path == "/watch/stream":
+                self._handle_watch_stream()
             elif self.path == "/quitquitquit" and server.cfg.http_quit:
                 self._quit()
             else:
@@ -249,8 +255,16 @@ def start_http_server(server, address) -> "http.server.ThreadingHTTPServer":
                 self._handle_query()
             elif self.path == "/reshard":
                 self._handle_reshard()
+            elif self.path == "/watch":
+                self._handle_watch_register()
             elif self.path == "/quitquitquit" and server.cfg.http_quit:
                 self._quit()
+            else:
+                self._reply(404, b"not found")
+
+        def do_DELETE(self):
+            if self.path.startswith("/watch/"):
+                self._handle_watch_delete()
             else:
                 self._reply(404, b"not found")
 
@@ -298,6 +312,110 @@ def start_http_server(server, address) -> "http.server.ThreadingHTTPServer":
                 return
             self._reply(200, json.dumps(out).encode(),
                         "application/json")
+
+        def _watch_gate(self):
+            """Shared gate chain for every /watch endpoint, the /query
+            ordering: shutdown gate first, then the config gate (an
+            unaware deployment exposes nothing). Returns the engine, or
+            None when a reply was already sent."""
+            if self._shutdown_gate():
+                return None
+            engine = server.watch_engine
+            if engine is None:
+                self._reply(404, b"watch_enabled is off")
+                return None
+            return engine
+
+        def _handle_watch_register(self):
+            """POST /watch: register one standing monitor (README
+            §Watches). Registration is a host-side registry insert —
+            cheap enough that it is NOT shed at overload CRITICAL (the
+            EVALUATION is, on the flush side, counted)."""
+            engine = self._watch_gate()
+            if engine is None:
+                return
+            length = int(self.headers.get("Content-Length", "0"))
+            body = self.rfile.read(length)
+            if not body.strip():
+                self._reply(400, b"Received empty /watch request")
+                return
+            try:
+                req = json.loads(body)
+            except ValueError:
+                self._reply(400, b"bad JSON body")
+                return
+            from veneur_tpu.watch import WatchError, WatchLimitError
+            try:
+                out = engine.register(req)
+            except WatchLimitError as e:
+                self._reply(429, str(e).encode())
+                return
+            except WatchError as e:
+                self._reply(400, str(e).encode())
+                return
+            self._reply(201, json.dumps(out).encode(),
+                        "application/json")
+
+        def _handle_watch_list(self):
+            engine = self._watch_gate()
+            if engine is None:
+                return
+            watches = engine.list_watches()
+            self._reply(200, json.dumps(
+                {"watches": watches, "active": len(watches)}).encode(),
+                "application/json")
+
+        def _handle_watch_delete(self):
+            engine = self._watch_gate()
+            if engine is None:
+                return
+            try:
+                wid = int(self.path[len("/watch/"):])
+            except ValueError:
+                self._reply(400, b"want DELETE /watch/<integer id>")
+                return
+            if engine.delete(wid):
+                self._reply(200, json.dumps({"deleted": wid}).encode(),
+                            "application/json")
+            else:
+                self._reply(404, b"no such watch")
+
+        def _handle_watch_stream(self):
+            """GET /watch/stream: SSE tail of state transitions. One
+            bounded queue per subscriber (drop-oldest, drops counted);
+            503 at the subscriber cap and — via _shutdown_gate, shared
+            with every stateful endpoint — during shutdown/draining.
+            The loop re-checks the shutdown flag each second so a
+            draining server sheds open streams promptly."""
+            engine = self._watch_gate()
+            if engine is None:
+                return
+            sub = engine.hub.subscribe()
+            if sub is None:
+                self._reply(503, b"watch_stream_max_subscribers reached")
+                return
+            try:
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.end_headers()
+                self.wfile.write(b": watch stream open\n\n")
+                self.wfile.flush()
+                while not server._shutdown.is_set():
+                    ev = sub.get(timeout=1.0)
+                    if ev is None:
+                        # keepalive comment: lets a dead client surface
+                        # as BrokenPipeError instead of a leaked thread
+                        self.wfile.write(b": keepalive\n\n")
+                    else:
+                        self.wfile.write(
+                            b"data: " + json.dumps(ev).encode()
+                            + b"\n\n")
+                    self.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                pass   # client went away; unsubscribe below
+            finally:
+                engine.hub.unsubscribe(sub)
 
         def _handle_reshard(self):
             """POST /reshard {"n_shards": N}: start a live mesh resize.

@@ -415,6 +415,36 @@ class Server:
         self._t_reshard = M.timer(
             "veneur.reshard.duration_ns",
             "one live resize end to end: drain swap through final fold")
+        # streaming watch tier (veneur_tpu/watch/) — registered even
+        # with the tier off so the inventory is stable
+        self._g_watch_active = M.gauge(
+            "veneur.watch.active",
+            "standing watches currently registered, by watch kind",
+            labelnames=("kind",))
+        self._c_watch_evaluated = M.counter(
+            "veneur.watch.evaluated_total",
+            "watch evaluations performed (one per active watch per "
+            "evaluated interval)", labelnames=("kind",))
+        self._c_watch_fired = M.counter(
+            "veneur.watch.fired_total",
+            "watch state transitions into ALERT", labelnames=("kind",))
+        self._c_watch_suppressed = M.counter(
+            "veneur.watch.suppressed_total",
+            "breaches that did not transition (debounce pending or "
+            "hysteresis hold) plus per-watch evaluations lost to a "
+            "skipped interval — overload CRITICAL, backlog drop-oldest, "
+            "or an engine failure (exact accounting)",
+            labelnames=("kind",))
+        self._c_watch_notify_dropped = M.counter(
+            "veneur.watch.notify_dropped_total",
+            "transition notifications lost: SSE subscriber queue "
+            "drop-oldest + terminal webhook failures (one inc per lost "
+            "event)", labelnames=("kind",))
+        self._c_watch_eval_ns = M.counter(
+            "veneur.watch.eval_ns_total",
+            "watch-engine-thread time per evaluated interval: selector "
+            "resolution + the one fused device evaluation + state "
+            "machine steps (off the flush path by construction)")
         jaxruntime.install()
         # h2d_bytes high-water at the last flush report, for per-interval
         # byte tags on the flush trace (flush worker thread only)
@@ -599,6 +629,22 @@ class Server:
                 batched=self._c_query_batched,
                 duration=self._t_query,
                 stale_reads=self._c_reshard_stale)
+        # -- streaming watch tier (veneur_tpu/watch/) ---------------------
+        # Off by default: no engine thread, /watch endpoints answer 404.
+        self.watch_engine = None
+        if cfg.watch_enabled:
+            from veneur_tpu.watch import WatchEngine
+            self.watch_engine = WatchEngine(
+                self, max_active=cfg.watch_max_active,
+                max_subscribers=cfg.watch_stream_max_subscribers,
+                webhook_url=cfg.watch_webhook_url,
+                retry_policy=self.retry_policy,
+                evaluated=self._c_watch_evaluated,
+                fired=self._c_watch_fired,
+                suppressed=self._c_watch_suppressed,
+                dropped=self._c_watch_notify_dropped,
+                eval_ns=self._c_watch_eval_ns,
+                active=self._g_watch_active)
         # last: every attribute a collector closes over now exists
         self._register_collectors()
 
@@ -2072,13 +2118,22 @@ class Server:
                 n_shards=n_shards, interval_ts=ts,
                 hostname=self.hostname, spill=spill_bytes,
                 spill_entries=spill_n,
-                forward_meta=self._forward_meta_snapshot())
+                forward_meta=self._forward_meta_snapshot(),
+                watches=self._watch_snapshot())
             self._ckpt_writer.submit(snap)
         except Exception:
             log.exception("checkpoint snapshot build failed; interval "
                           "not checkpointed")
         self._t_flush_phase.observe(time.perf_counter_ns() - ck_t0,
                                     phase="checkpoint_build")
+
+    def _watch_snapshot(self) -> Optional[dict]:
+        """Watch registrations + firing state for the checkpoint's
+        sidecar chunk. None (chunk omitted) when the tier is off or no
+        watches are registered."""
+        if self.watch_engine is None:
+            return None
+        return self.watch_engine.snapshot()
 
     def _forward_meta_snapshot(self) -> Optional[dict]:
         """Exactly-once forwarding state for the checkpoint: the sender
@@ -2152,6 +2207,10 @@ class Server:
                 restore_spill(self.forward_spill, snap["spill"])
             if fwd_meta:
                 self._restore_forward_meta(fwd_meta)
+            if snap.get("watches") and self.watch_engine is not None:
+                # registrations + firing state: monitors keep their
+                # debounce streaks and ALERT holds across the restart
+                self.watch_engine.restore(snap["watches"])
             self._c_ckpt_restores.inc()
             log.info("restored %d metrics from %s (interval_ts=%d)",
                      n, path, snap["interval_ts"])
@@ -2236,6 +2295,23 @@ class Server:
         if trace:
             sp.set_tag("h2d_bytes", str(h2d_delta))
         sp.client_finish(self.trace_client)
+        # streaming watch tier: hand the DETACHED interval to the watch
+        # engine's own thread. compute_flush does not donate its state
+        # input, so the reference stays valid for that thread's fused
+        # evaluation; offer() is non-blocking (bounded queue,
+        # drop-oldest with exact accounting), so watches can never
+        # stretch the flush deadline. At overload CRITICAL the
+        # evaluation is shed outright — counted, never silent.
+        if self.watch_engine is not None:
+            watch_shed = False
+            if self._overload is not None:
+                from veneur_tpu.reliability.overload import CRITICAL
+                watch_shed = self._overload.state >= CRITICAL
+            if watch_shed:
+                self.watch_engine.skip_interval("overload CRITICAL")
+            else:
+                self.watch_engine.offer(
+                    state, table, int(stats.get("set_shift", 0)), ts)
         # exactly-once forwarding: export + stage this interval's unit
         # under a fresh (epoch, seq) BEFORE the checkpoint build, so the
         # snapshot's spill chunk carries the payload with its envelope
@@ -3110,6 +3186,11 @@ class Server:
             # requests on packet_queue; one racing in behind _STOP
             # would never run
             self.query_engine.close()
+        if self.watch_engine is not None:
+            # the engine launches on the device from its own thread; it
+            # must be out of the JAX runtime before teardown (it never
+            # touches packet_queue, so ordering vs _STOP is free)
+            self.watch_engine.close()
         self.packet_queue.put(_STOP)
         # drain order matters: the pipeline thread may still enqueue a final
         # flush job; only after it exits is it safe to stop the flush worker
@@ -3195,7 +3276,8 @@ class Server:
                         n_shards=n_shards, interval_ts=int(time.time()),
                         hostname=self.hostname, spill=spill_bytes,
                         spill_entries=spill_n,
-                        forward_meta=self._forward_meta_snapshot()))
+                        forward_meta=self._forward_meta_snapshot(),
+                        watches=self._watch_snapshot()))
                 except Exception:
                     log.exception("final checkpoint failed; last periodic "
                                   "checkpoint remains newest")
