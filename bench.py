@@ -393,7 +393,7 @@ def main():
             # under the wall-clock guard the TAIL gets truncated, never
             # the head
             out["e2e"] = e2e.main(
-                configs=[2, 1, 4, 13, 9, 10, 11, 12, 3, 5, 6, 7, 8],
+                configs=[2, 1, 4, 13, 14, 9, 10, 11, 12, 3, 5, 6, 7, 8],
                 scale=scale,
                 force_cpu=on_cpu, on_result=on_result,
                 deadline=T0 + guard - 45.0)
@@ -456,6 +456,28 @@ def main():
                 out["e2e_watch_fleet"] = cfg13["n_watches"]
                 out["e2e_watch_register_per_sec"] = \
                     cfg13.get("registrations_per_sec")
+            # config 14 gate "flush p99 unchanged vs config4": the range
+            # dashboard replays a comparable load on a history-enabled
+            # server — the per-window ring write rides the flush
+            # program, so the flush must not notice (cfg14 also carries
+            # its own in-run history-off baseline band, always on). The
+            # headline HBM number — K=90 windows over the ~1M-key
+            # kernel table — rides the artifact next to its cap.
+            cfg14 = next((r for r in out["e2e"] if r.get("config") == 14),
+                         None)
+            if cfg4 and cfg14 and cfg4.get("flush_p99_seconds") is not None \
+                    and cfg14.get("flush_p99_seconds") is not None:
+                delta = cfg14["flush_p99_seconds"] \
+                    - cfg4["flush_p99_seconds"]
+                cfg14["flush_p99_delta_vs_config4"] = round(delta, 3)
+                cfg14["flush_p99_unchanged_vs_config4"] = delta <= max(
+                    1.0, cfg4["flush_p99_seconds"])
+            if cfg14 and cfg14.get("hbm_k90_1m_bytes"):
+                out["e2e_history_hbm_k90_1m_gib"] = \
+                    cfg14.get("hbm_k90_1m_gib")
+                out["e2e_history_hbm_gate_ok"] = cfg14.get("hbm_gate_ok")
+                out["e2e_range_queries_per_sec"] = \
+                    cfg14.get("range_queries_per_sec")
         except Exception as e:  # bench must still print its line
             out["e2e_error"] = f"{type(e).__name__}: {e}"
 

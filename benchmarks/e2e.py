@@ -2040,13 +2040,296 @@ def config13_watch_storm(scale=1.0):
         glob.shutdown()
 
 
+def config14_range_dashboard(scale=1.0):
+    """The history tier under dashboard load (README §History): replay
+    a deterministic per-interval load into a history-enabled server,
+    flush K intervals, then hammer POST /query with a concurrent
+    range-query storm while verifying three always-on gates. (1) BYTE
+    EXACTNESS: the ring the flush program filled is byte-identical to
+    re-writing the archived (table, result, raw) flush frames into a
+    fresh ring via the standalone write/roll programs — so every range
+    answer equals re-merging the archive — and the HTTP per-interval
+    points match the closed-form per-interval sums. (2) ZERO FLUSH
+    INTERFERENCE: flush p99 with the ring armed stays inside the
+    history-off band measured on an identical server minutes earlier in
+    the SAME process (bench.py adds the cross-config band vs config4).
+    (3) HBM BUDGET: the production `for_table` derivation at K=90
+    windows / 3 decimation tiers over the kernel benchmark's ~1M-key
+    TableSpec is measured per kind and capped at 6 GiB — the analytic
+    number IS the allocation (tests pin hbm_bytes == sum of device
+    array nbytes), so the budget gate is exact without touching the
+    chip. The range-query throughput gate arms on TPU only (standing
+    constraint): the CPU smoke records qps/latency but a compile-bound
+    first launch would gate XLA wall time, not the serving path."""
+    import json as _json
+    import urllib.request
+
+    import jax
+
+    from veneur_tpu.aggregation.state import TableSpec
+    from veneur_tpu.history.spec import HistorySpec
+    from veneur_tpu.history.writer import HistoryWriter
+    from veneur_tpu.sinks.debug import DebugMetricSink
+
+    counters = max(8, int(200 * scale))
+    gauges = max(4, int(50 * scale))
+    timers = max(4, int(50 * scale))
+    sets = max(4, int(25 * scale))
+    histo_samples = 10
+    # The ring's tier-roll program compiles per roll SHAPE: 1 tier rolls
+    # at seq 2, 2 at seq 4, 3 at seq 8 — so the timed window starts at
+    # cycle 8, after every shape the steady state revisits has compiled
+    # (cycle-1/3/7 walls would otherwise gate XLA, not the ring write).
+    K_ABSORB = 8
+    K_TIMED = 4
+    K_TOT = K_ABSORB + K_TIMED
+    interval_s = 600.0        # _mk_server's manual-flush interval
+    rng = np.random.default_rng(14)
+
+    def interval_lines(i):
+        """Interval i's wire load. Counter key c receives ONE sample of
+        c + i + 1, so its archived window value is closed-form — the
+        HTTP range check below needs no replay to know the answer."""
+        lines = []
+        for c in range(counters):
+            lines.append(b"c14.counter.%d:%d|c" % (c, c + i + 1))
+        for g in range(gauges):
+            lines.append(b"c14.gauge.%d:%d|g" % (g, 10 * i + g))
+        for h in range(timers):
+            for v in rng.lognormal(2.0, 0.8, histo_samples):
+                lines.append(b"c14.timer.%d:%.4f|ms" % (h, v))
+        for s in range(sets):
+            lines.append(b"c14.set.%d:m%d|s" % (s, i))
+        lines.append(b"c14.marker.%d:1|c" % i)
+        return lines
+
+    def post_query(srv, body, timeout=30.0):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.http_port}/query",
+            data=_json.dumps(body).encode(), method="POST",
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return _json.loads(resp.read())
+
+    def feed_interval(srv, i, timeout=FLUSH_WAIT):
+        """Feed interval i and wait for the trailing MARKER key to
+        answer a live instant query. The pipeline queue is FIFO, so a
+        staged marker proves the whole interval is staged — the
+        cumulative `processed` counter can't (flush intermetrics ride
+        the same pipeline and inflate it)."""
+        _feed_queue(srv, interval_lines(i))
+        t1 = time.time()
+        probe = {"queries": [{"name": f"c14.marker.{i}",
+                              "kinds": ["counter"]}]}
+        while time.time() - t1 < timeout:
+            out = post_query(srv, probe)
+            if out["results"][0]["matches"]:
+                return
+            time.sleep(0.02)
+        raise RuntimeError(f"interval {i} marker never staged "
+                           f"within {timeout:.0f}s")
+
+    srv_kw = dict(http_address="127.0.0.1:0", query_enabled=True,
+                  tpu_counter_capacity=1 << 12,
+                  tpu_histo_capacity=1 << 9)
+
+    # -- phase A: history-OFF flush baseline (the interference oracle) --
+    phase("baseline_server")
+    base = _mk_server([DebugMetricSink()], **srv_kw)
+    flush_base = []
+    try:
+        _warm(base, [b"warm.c:1|c", b"warm.t:1.0|ms"])
+        rng = np.random.default_rng(14)   # identical timer draws
+        for i in range(K_TOT):
+            phase(f"base_cycle{i}")
+            feed_interval(base, i, timeout=WARM_TIMEOUT if i == 0
+                          else FLUSH_WAIT)
+            tf = time.perf_counter()
+            _flush_checked(base, timeout=WARM_TIMEOUT if i == 0
+                           else FLUSH_WAIT)
+            dt = time.perf_counter() - tf
+            if i >= K_ABSORB:             # early cycles absorb compiles
+                flush_base.append(dt)
+    finally:
+        base.shutdown()
+
+    # -- phase B: history-ON, frames archived for the replay oracle ----
+    phase("history_server")
+    glob = _mk_server([DebugMetricSink()], history_enabled=True,
+                      **srv_kw)
+    try:
+        frames = []
+        orig = glob.aggregator.compute_flush
+
+        def archiving(state, table, percentiles, want_raw=False,
+                      history=None):
+            out = orig(state, table, percentiles, want_raw=True,
+                       history=history)
+            result, tbl, raw = out
+            frames.append((tbl,
+                           {k: np.copy(v) for k, v in result.items()},
+                           {k: np.copy(v) for k, v in raw.items()}))
+            return out if want_raw else (result, tbl)
+
+        glob.aggregator.compute_flush = archiving
+        _warm(glob, [b"warm.c:1|c", b"warm.t:1.0|ms"])
+        rng = np.random.default_rng(14)   # identical timer draws
+        flush_hist = []
+        for i in range(K_TOT):
+            phase(f"hist_cycle{i}")
+            feed_interval(glob, i, timeout=WARM_TIMEOUT if i == 0
+                          else FLUSH_WAIT)
+            tf = time.perf_counter()
+            _flush_checked(glob, timeout=WARM_TIMEOUT if i == 0
+                           else FLUSH_WAIT)
+            dt = time.perf_counter() - tf
+            if i >= K_ABSORB:
+                flush_hist.append(dt)
+        if glob.history.seq != K_TOT:
+            raise RuntimeError(
+                f"ring advanced {glob.history.seq} of {K_TOT} windows")
+
+        # gate 1a: ring bytes == replaying the archived frames
+        phase("replay_oracle")
+        wr = HistoryWriter(glob.history.spec,
+                           interval_s=glob.history.interval_s)
+        for tbl, result, raw in frames:
+            wr.record_frame(tbl, result, raw)
+        sa, sb = glob.history.snapshot(), wr.snapshot()
+        byte_exact = (sa["meta"]["seq"] == sb["meta"]["seq"]
+                      and sa["meta"]["keys"] == sb["meta"]["keys"])
+        for name in sa["arrays"]:
+            byte_exact = byte_exact and bool(np.array_equal(
+                sa["arrays"][name], sb["arrays"][name], equal_nan=True))
+
+        # gate 1b: HTTP per-interval points match the closed form
+        def range_ok(c):
+            out = post_query(glob, {"queries": [
+                {"name": f"c14.counter.{c}",
+                 "range": int(K_TOT * interval_s),
+                 "step": int(interval_s)}]})
+            pts = out["results"][0]["matches"][0]["points"]
+            want = [float(c + i + 1) for i in range(K_TOT)]
+            return ([p["value"] for p in pts] == want
+                    and all(p["complete"] for p in pts))
+
+        values_exact = all(range_ok(c) for c in (0, counters - 1))
+
+        # -- concurrent range-query storm over live HTTP ---------------
+        phase("range_storm")
+        n_threads = max(2, min(8, int(8 * scale)))
+        per_thread = max(10, int(100 * scale))
+        errors = []
+        lat = []
+        lat_lock = threading.Lock()
+        ln0 = glob.query_engine.launches_total
+
+        def storm(t):
+            try:
+                for j in range(per_thread):
+                    c = (t * per_thread + j) % counters
+                    body = {"queries": [
+                        {"name": f"c14.counter.{c}",
+                         "range": int(K_TOT * interval_s),
+                         "step": int(interval_s)},
+                        {"name": f"c14.gauge.{c % gauges}",
+                         "range": int(K_TOT * interval_s)},
+                        {"name": f"c14.counter.{c}",
+                         "kinds": ["counter"]},      # instant, same launch
+                    ]}
+                    tq = time.perf_counter()
+                    out = post_query(glob, body)
+                    dt = time.perf_counter() - tq
+                    pts = out["results"][0]["matches"][0]["points"]
+                    if len(pts) != K_TOT or not all(
+                            p["complete"] for p in pts):
+                        raise RuntimeError(
+                            f"storm range answer malformed for key {c}: "
+                            f"{len(pts)} points")
+                    with lat_lock:
+                        lat.append(dt)
+            except Exception as e:
+                errors.append(f"{type(e).__name__}: {e}")
+
+        threads = [threading.Thread(target=storm, args=(t,))
+                   for t in range(n_threads)]
+        t0 = time.perf_counter()
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        storm_dt = time.perf_counter() - t0
+        n_queries = n_threads * per_thread
+        launches = glob.query_engine.launches_total - ln0
+        qps = n_queries / storm_dt if storm_dt > 0 else 0.0
+
+        ring_bytes_live = glob.history.spec.hbm_bytes()
+    finally:
+        glob.shutdown()
+
+    # -- gate 3: K=90 @ ~1M keys HBM budget (analytic == allocated) ----
+    kernel_1m = TableSpec(counter_capacity=1 << 19,
+                          gauge_capacity=1 << 18,
+                          status_capacity=1 << 10,
+                          set_capacity=1 << 14,
+                          histo_capacity=1 << 17)
+    h90 = HistorySpec.for_table(kernel_1m, windows=90, tiers=3,
+                                max_keys=1 << 20)
+    w = h90.total_cols
+    hbm_cap = 6 * (1 << 30)
+    hbm_by_kind = {
+        "counter": h90.counter_rows * w * 2 * 4,
+        "gauge": h90.gauge_rows * w * 4,
+        "status": h90.status_rows * w * 4,
+        "set": h90.set_rows * w * h90.hll_words * 4,
+        "histo": h90.histo_rows * w * (2 * h90.centroids + 6) * 4,
+    }
+
+    base_p99 = float(np.percentile(flush_base, 99))
+    hist_p99 = float(np.percentile(flush_hist, 99))
+    on_tpu = jax.default_backend() == "tpu"
+    return {
+        "config": 14, "name": "range_dashboard",
+        "intervals": K_TOT,
+        "ring_windows": 90, "ring_tiers": 3,
+        "range_byte_exact": bool(byte_exact),
+        "range_values_exact": bool(values_exact),
+        "storm_threads": n_threads,
+        "storm_queries": n_queries,
+        "storm_errors": errors[:5],
+        "storm_ok": not errors,
+        "range_queries_per_sec": round(qps, 1),
+        "range_query_p99_ms": round(
+            float(np.percentile(lat, 99)) * 1e3, 2) if lat else None,
+        "device_launches": int(launches),
+        "flush_seconds_baseline": [round(s, 3) for s in flush_base],
+        "flush_seconds": [round(s, 3) for s in flush_hist],
+        "flush_p99_seconds_baseline": round(base_p99, 3),
+        "flush_p99_seconds": round(hist_p99, 3),
+        # same noise band as config13: CPU flush walls jitter ~2x run
+        # to run; a per-window device write that actually interfered
+        # would cost far more than the band
+        "flush_p99_interference_free": bool(
+            hist_p99 <= base_p99 * 1.5 + 0.5),
+        "ring_hbm_bytes_live": int(ring_bytes_live),
+        "hbm_k90_1m_bytes": int(h90.hbm_bytes()),
+        "hbm_k90_1m_gib": round(h90.hbm_bytes() / (1 << 30), 3),
+        "hbm_k90_1m_by_kind": {k: int(v) for k, v in
+                               hbm_by_kind.items()},
+        "hbm_cap_gib": round(hbm_cap / (1 << 30), 3),
+        "hbm_gate_ok": bool(h90.hbm_bytes() <= hbm_cap),
+        "gate_range_qps_armed": on_tpu,
+        "gate_range_qps_ok": bool(qps >= 100.0) if on_tpu else None,
+    }
+
+
 CONFIGS = {1: config1_counter_replay, 2: config2_zipf_timers,
            3: config3_set_cardinality, 4: config4_global_merge,
            5: config5_span_firehose, 6: config6_cardinality_stress,
            7: config7_checkpoint_restore, 8: config8_overload_storm,
            9: config9_duplicate_storm, 10: config10_wire_to_flush_firehose,
            11: config11_collective_merge, 12: config12_elastic_resize,
-           13: config13_watch_storm}
+           13: config13_watch_storm, 14: config14_range_dashboard}
 
 # Per-config subprocess budget: backend init + first XLA compiles of the
 # config's size buckets (~tens of seconds each on the tunneled chip) +

@@ -1,0 +1,610 @@
+"""On-device history tier tests (veneur_tpu/history/): ring geometry
+and decimation coverage, Pallas/XLA window-merge bit parity, the
+replay-oracle byte-exactness contract on both the fused single-device
+and host-fed sharded backends, checkpoint/restore byte-exactness, live
+4->8 reshard survival with exact range answers across the move, mixed
+instant+range batches in ONE device launch, delta watches reading
+their previous-interval baseline from the ring, and the CLI range
+round trip."""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from tests.test_query import _matches, _post, _query
+from tests.test_server import _send_udp, _wait_until, small_config
+from veneur_tpu.history import merge as hmerge
+from veneur_tpu.history.spec import HistorySpec
+from veneur_tpu.history.writer import KINDS, HistoryWriter
+from veneur_tpu.server.server import Server
+from veneur_tpu.sinks.debug import DebugMetricSink
+
+
+def _hist_cfg(**kw):
+    # a long interval pins ring seq numbers to trigger_flush calls and
+    # keeps range quantization deterministic (1 window == 1 flush)
+    defaults = dict(http_address="127.0.0.1:0", query_enabled=True,
+                    history_enabled=True, history_windows=8,
+                    history_decimation_tiers=2, interval="600s")
+    defaults.update(kw)
+    return small_config(**defaults)
+
+
+def _points(out, i=0, j=0):
+    return out["results"][i]["matches"][j]["points"]
+
+
+def _wait_keyed(srv, *keys):
+    """Wait until each (kind, name) is visible to a LIVE instant query.
+    `_wait_processed`'s cumulative count is unusable across repeated
+    flushes here: flush intermetrics ride the same pipeline and inflate
+    `processed`, so a count-based wait can return before the batch under
+    test was even dequeued. Query visits are FIFO pipeline-thread items,
+    so a hit here happens-after our datagrams were staged — and the
+    probe is thread-safe on both key-table implementations."""
+
+    def resident():
+        out = _query(srv, {"queries": [
+            {"name": name, "kinds": [kind]} for kind, name in keys]})
+        return all(r["matches"] for r in out["results"])
+
+    _wait_until(resident, what=f"keys {keys} staged in live table")
+
+
+# -- writer-level harness ----------------------------------------------------
+
+class _Meta:
+    def __init__(self, kind, name, tags=""):
+        self.kind, self.name, self.joined_tags = kind, name, tags
+
+
+class _Table:
+    """Minimal stand-in for KeyTable: get_meta(kind) in flush order."""
+
+    def __init__(self, by_kind):
+        self._by_kind = by_kind
+
+    def get_meta(self, kind):
+        return list(enumerate(self._by_kind.get(kind, [])))
+
+
+def _counter_frame(spec, names, values):
+    """(table, result, raw) for one archived interval holding only
+    counters — empty sketch kinds keep their trailing dims so the
+    write_window scatter shapes line up."""
+    table = _Table({"counter": [_Meta("counter", n) for n in names]})
+    result = {
+        "counter": np.asarray(values, np.float64),
+        "status": np.zeros(0, np.float32),
+        "histo_count": np.zeros(0, np.float64),
+        "histo_sum": np.zeros(0, np.float64),
+    }
+    raw = {
+        "gauge": np.zeros(0, np.float32),
+        "hll": np.zeros((0, spec.hll_words), np.int32),
+        "h_mean": np.zeros((0, spec.centroids), np.float32),
+        "h_weight": np.zeros((0, spec.centroids), np.float32),
+        "h_min": np.zeros(0, np.float32),
+        "h_max": np.zeros(0, np.float32),
+    }
+    return table, result, raw
+
+
+def _range_counters(wr, rows, range_s, step_s=None, window_s=None):
+    """Plan + merge + unpack one counter range query straight against a
+    writer — the query engine's path without the HTTP tier. Returns
+    [(RangeStep, [per-row f64 value])] oldest last (plan order)."""
+    from veneur_tpu.aggregation.step import unpack_flush
+    import jax.numpy as jnp
+
+    plan = wr.plan_range(range_s, window_s, step_s, hmerge.MAX_STEPS)
+    need = [list(rows), [], [], [], []]
+    flat, n_q, n_steps, buckets, _ = hmerge.pack_range_inputs(
+        wr.spec, need, plan.sel, plan.rank, set())
+    hist = wr.acquire_read()
+    try:
+        packed = np.asarray(hmerge.range_in_packed(
+            hist, jnp.asarray(flat), hspec=wr.spec, n_q=n_q,
+            n_steps=n_steps, buckets=buckets))
+    finally:
+        wr.release_read()
+    pieces = unpack_flush(packed, hmerge.range_shapes(
+        wr.spec, buckets, n_steps, n_q))
+    vals = (pieces["r_counter_hi"].astype(np.float64)
+            + pieces["r_counter_lo"].astype(np.float64))
+    return [(st, [float(vals[r, i]) for r in range(len(rows))])
+            for i, st in enumerate(plan.steps)]
+
+
+# -- geometry ----------------------------------------------------------------
+
+def test_spec_geometry_and_hbm_accounting():
+    spec = HistorySpec(windows=4, tiers=2)
+    assert spec.total_cols == 12            # windows * (tiers + 1)
+    assert spec.span_intervals == 16        # windows << tiers
+    # the analytic footprint is exactly the allocated ring bytes
+    from veneur_tpu.history import device as hdev
+    hist = hdev.empty_history(spec)
+    alloc = sum(np.asarray(getattr(hist, f)).nbytes
+                for f in hdev.HISTORY_FIELDS)
+    assert alloc == spec.hbm_bytes()
+
+
+def test_for_table_pins_hll_precision_and_caps_rows():
+    from veneur_tpu.aggregation.state import TableSpec
+    ts = TableSpec()
+    spec = HistorySpec.for_table(ts, windows=6, tiers=1, max_keys=128)
+    assert spec.hll_precision == ts.hll_precision
+    assert spec.windows == 6 and spec.tiers == 1
+    for k in range(len(KINDS)):
+        assert 64 <= spec.rows_for(k) <= 128
+
+
+# -- ring write / decimation / range cover -----------------------------------
+
+def test_decimated_ring_answers_exact_counter_ranges():
+    """10 intervals into a windows=4/tiers=2 ring: tier 0 holds only
+    the last 4, yet a whole-range step still folds EXACTLY (the older
+    seqs ride tier-1/2 columns), and per-step tails stay per-interval
+    where tier 0 is resident."""
+    spec = HistorySpec(windows=4, tiers=2)
+    wr = HistoryWriter(spec, interval_s=10.0)
+    for s in range(10):
+        t, res, raw = _counter_frame(spec, ["rng.c"], [float(s + 1)])
+        wr.record_frame(t, res, raw, ts=(s + 1) * 10.0)
+    assert wr.seq == 10
+
+    # one step over the full retained span: exact total 1+..+10
+    ((st, vals),) = _range_counters(wr, [0], range_s=100.0)
+    assert st.seq_lo == 0 and st.seq_hi == 9 and st.complete
+    assert vals == [55.0]
+
+    # last four intervals individually: raw tier-0 answers, newest first
+    steps = _range_counters(wr, [0], range_s=40.0, step_s=10.0)
+    assert [(s.seq_lo, s.seq_hi, v[0]) for s, v in steps] == [
+        (9, 9, 10.0), (8, 8, 9.0), (7, 7, 8.0), (6, 6, 7.0)]
+    assert all(s.complete for s, _ in steps)
+
+    # an aligned 4-wide window deep in history folds from tier 2
+    ((st, vals),) = _range_counters(wr, [0], range_s=10.0,
+                                    window_s=40.0)
+    assert (st.seq_lo, st.seq_hi) == (6, 9) and vals == [34.0]
+
+    # a single-seq step whose tier-0 column was recycled is INCOMPLETE
+    steps = _range_counters(wr, [0], range_s=100.0, step_s=10.0)
+    old = [s for s, _ in steps if s.seq_hi < 6]
+    assert old and not any(s.complete for s in old)
+
+
+def test_read_values_lookback_and_residency():
+    spec = HistorySpec(windows=4, tiers=1)
+    wr = HistoryWriter(spec, interval_s=10.0)
+    for s in range(6):
+        t, res, raw = _counter_frame(spec, ["lb.c"], [float(10 * s)])
+        wr.record_frame(t, res, raw, ts=(s + 1) * 10.0)
+    row = wr.rows_for_keys(0, [("counter", "lb.c", "")])[0]
+    vals = wr.read_values(5, [(0, row)])
+    assert vals[0] == 50.0
+    # seq 0's tier-0 column was recycled by seq 4 -> NaN, not a stale read
+    assert np.isnan(wr.read_values(0, [(0, row)])[0])
+    # unknown rows answer NaN
+    assert np.isnan(wr.read_values(5, [(0, None)])[0])
+
+
+def test_eviction_wipes_reassigned_rows():
+    """A ring at key capacity reclaims the least-recently-flushed row
+    and the new key must NOT inherit the old key's windows."""
+    spec = HistorySpec(windows=4, tiers=0, counter_rows=64)
+    wr = HistoryWriter(spec, interval_s=10.0)
+    names = [f"ev.c{i}" for i in range(64)]
+    t, res, raw = _counter_frame(spec, names, [7.0] * 64)
+    wr.record_frame(t, res, raw, ts=10.0)
+    # 64 fresh keys: ev.c0's row is reclaimed (it is the eviction
+    # candidate with the lowest stable sort position)
+    t, res, raw = _counter_frame(
+        spec, [f"ev.n{i}" for i in range(64)], [1.0] * 64)
+    wr.record_frame(t, res, raw, ts=20.0)
+    row = wr.rows_for_keys(0, [("counter", "ev.n0", "")])[0]
+    assert row is not None
+    ((_, vals),) = _range_counters(wr, [row], range_s=20.0)
+    assert vals == [1.0]            # 7.0 from the evicted key is gone
+    keys = {key for _, key, _ in wr.iter_keys()}
+    assert ("counter", "ev.n0", "") in keys
+    assert ("counter", "ev.c0", "") not in keys
+
+
+# -- Pallas parity ------------------------------------------------------------
+
+def test_merge_windows_pallas_interpret_parity():
+    """The Pallas masked HLL window merge must be BIT-identical to the
+    XLA fori chain — packed words are integers, so exact equality."""
+    import jax.numpy as jnp
+    from veneur_tpu.ops import hll, pallas_history
+
+    rng = np.random.default_rng(11)
+    p = 10
+    r = hll.num_registers(p)
+    regs = rng.integers(0, 48, size=(5, 7, r)).astype(np.uint8)
+    regs[0, :] = 0                       # all-empty row
+    rows = jnp.asarray(hll.pack_registers(jnp.asarray(regs),
+                                          precision=p))
+    sel = rng.integers(0, 2, size=(3, 7)).astype(np.float32)
+    sel[1, :] = 0.0                      # empty selection step
+    sel = jnp.asarray(sel)
+    xla = np.asarray(hmerge._merge_windows_xla(rows, sel, precision=p))
+    pal = np.asarray(pallas_history.merge_windows_packed(
+        rows, sel, precision=p, interpret=True))
+    np.testing.assert_array_equal(pal, xla)
+
+
+# -- replay oracle: fused + host-fed backends --------------------------------
+
+def _capture_frames(srv):
+    """Wrap the aggregator's compute_flush to archive every interval's
+    (table, result, raw) frame — the replay oracle's input — while the
+    server keeps flushing through its normal (history-fused) path."""
+    frames = []
+    orig = srv.aggregator.compute_flush
+
+    def wrapper(state, table, percentiles, want_raw=False, history=None):
+        out = orig(state, table, percentiles, want_raw=True,
+                   history=history)
+        result, tbl, raw = out
+        frames.append((tbl,
+                       {k: np.copy(v) for k, v in result.items()},
+                       {k: np.copy(v) for k, v in raw.items()}))
+        return out if want_raw else (result, tbl)
+
+    srv.aggregator.compute_flush = wrapper
+    return frames
+
+
+def _replay(srv, frames):
+    """Feed the archived frames through a FRESH writer via the
+    standalone write/roll programs — the byte-exactness oracle."""
+    wr = HistoryWriter(srv.history.spec,
+                       interval_s=srv.history.interval_s)
+    for tbl, result, raw in frames:
+        wr.record_frame(tbl, result, raw)
+    return wr
+
+
+def _assert_rings_equal(a, b):
+    sa, sb = a.snapshot(), b.snapshot()
+    assert sa["meta"]["seq"] == sb["meta"]["seq"]
+    assert sa["meta"]["keys"] == sb["meta"]["keys"]
+    for name in sorted(sa["arrays"]):
+        np.testing.assert_array_equal(
+            sa["arrays"][name], sb["arrays"][name],
+            err_msg=f"ring field {name} diverged from the replay oracle")
+
+
+@pytest.mark.parametrize("backend_kw", [
+    {}, {"tpu_n_shards": 4, "native_ingest": False},
+], ids=["single-fused", "sharded-hostfed"])
+def test_range_answers_byte_exact_vs_replayed_frames(backend_kw):
+    """THE history contract: the ring the flush program fills (fused
+    write on single-device, host-fed on sharded) is byte-identical to
+    re-writing the archived flush frames into a fresh ring — so any
+    range answer equals re-merging the archive."""
+    srv = Server(_hist_cfg(**backend_kw), metric_sinks=[DebugMetricSink()])
+    srv.start()
+    try:
+        frames = _capture_frames(srv)
+        loads = [
+            [b"ra.hits:2|c", b"ra.g:7|g", b"ra.t:5|ms", b"ra.s:a|s"],
+            [b"ra.hits:3|c", b"ra.t:9|ms", b"ra.t:1|ms", b"ra.s:b|s"],
+            [b"ra.hits:4|c", b"ra.g:12|g", b"ra.s:a|s"],
+        ]
+        key_sets = [
+            [("counter", "ra.hits"), ("gauge", "ra.g"),
+             ("timer", "ra.t"), ("set", "ra.s")],
+            [("counter", "ra.hits"), ("timer", "ra.t"), ("set", "ra.s")],
+            [("counter", "ra.hits"), ("gauge", "ra.g"), ("set", "ra.s")],
+        ]
+        for batch, keys in zip(loads, key_sets):
+            _send_udp(srv.local_addr(), batch)
+            _wait_keyed(srv, *keys)
+            assert srv.trigger_flush(timeout=300)
+        assert srv.history.seq == 3
+        _assert_rings_equal(srv.history, _replay(srv, frames))
+
+        # and the HTTP range answer carries the archived per-interval
+        # values verbatim
+        out = _query(srv, {"queries": [
+            {"name": "ra.hits", "range": 1800, "step": 600}]})
+        pts = _points(out)
+        assert [p["value"] for p in pts] == [2.0, 3.0, 4.0]
+        assert [p["seq"] for p in pts] == [[0, 0], [1, 1], [2, 2]]
+        assert all(p["complete"] for p in pts)
+        out = _query(srv, {"queries": [{"name": "ra.hits",
+                                        "range": 1800}]})
+        assert _points(out)[0]["value"] == 9.0
+    finally:
+        srv.shutdown()
+
+
+def test_range_covers_every_kind_over_http():
+    """One prefix range query returns counters, gauges (LWW), set
+    estimates and timer quantiles from the ring."""
+    srv = Server(_hist_cfg(), metric_sinks=[DebugMetricSink()])
+    srv.start()
+    try:
+        _send_udp(srv.local_addr(),
+                  [b"mk.c:4|c", b"mk.g:5|g", b"mk.t:10|ms",
+                   b"mk.t:30|ms", b"mk.s:x|s", b"mk.s:y|s"])
+        _wait_keyed(srv, ("counter", "mk.c"), ("gauge", "mk.g"),
+                    ("timer", "mk.t"), ("set", "mk.s"))
+        assert srv.trigger_flush(timeout=300)
+        _send_udp(srv.local_addr(), [b"mk.g:12|g"])
+        _wait_keyed(srv, ("gauge", "mk.g"))
+        assert srv.trigger_flush(timeout=300)
+        out = _query(srv, {"queries": [
+            {"prefix": "mk.", "range": 1200, "quantiles": [0.5]}]})
+        got = {m["name"]: m for m in _matches(out)}
+        assert got["mk.c"]["points"][-1]["value"] == 4.0
+        # LWW across the two merged windows: the newer gauge wins
+        assert got["mk.g"]["points"][-1]["value"] == 12.0
+        assert got["mk.s"]["points"][-1]["estimate"] == pytest.approx(
+            2.0, abs=0.1)
+        assert got["mk.t"]["points"][-1]["quantiles"]["0.5"] == \
+            pytest.approx(20.0, abs=10.0)
+        assert out["results"][0]["range"] is True
+    finally:
+        srv.shutdown()
+
+
+def test_range_rejected_when_history_off():
+    srv = Server(_hist_cfg(history_enabled=False),
+                 metric_sinks=[DebugMetricSink()])
+    srv.start()
+    try:
+        import urllib.error
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(srv, "/query", json.dumps(
+                {"queries": [{"name": "x", "range": 600}]}).encode())
+        assert ei.value.code == 400
+        assert b"history" in ei.value.read()
+    finally:
+        srv.shutdown()
+
+
+# -- one launch for mixed instant + range batches -----------------------------
+
+def test_mixed_instant_and_range_batch_is_one_launch():
+    srv = Server(_hist_cfg(), metric_sinks=[DebugMetricSink()])
+    srv.start()
+    try:
+        _send_udp(srv.local_addr(), [b"mx.a:3|c", b"mx.b:8|g"])
+        _wait_keyed(srv, ("counter", "mx.a"), ("gauge", "mx.b"))
+        assert srv.trigger_flush(timeout=300)
+        _send_udp(srv.local_addr(), [b"mx.a:5|c"])
+        _wait_keyed(srv, ("counter", "mx.a"))
+        before = srv.query_engine.launches_total
+        out = _query(srv, {"queries": [
+            {"name": "mx.a", "kinds": ["counter"]},          # instant
+            {"name": "mx.a", "range": 600, "step": 600},     # range
+            {"name": "mx.b", "range": 600},                  # range
+        ]})
+        assert srv.query_engine.launches_total == before + 1
+        assert _matches(out, 0)[0]["value"] == 5.0           # live interval
+        assert _points(out, 1)[0]["value"] == 3.0            # flushed window
+        assert _points(out, 2)[0]["value"] == 8.0
+    finally:
+        srv.shutdown()
+
+
+# -- checkpoint / restore -----------------------------------------------------
+
+def test_writer_snapshot_restore_identity():
+    spec = HistorySpec(windows=4, tiers=1)
+    wr = HistoryWriter(spec, interval_s=10.0)
+    for s in range(5):
+        t, res, raw = _counter_frame(spec, ["id.c"], [float(s)])
+        wr.record_frame(t, res, raw, ts=(s + 1) * 10.0)
+    snap = wr.snapshot()
+    wr2 = HistoryWriter(spec, interval_s=10.0)
+    wr2.restore(snap)
+    _assert_rings_equal(wr, wr2)
+    assert wr2.seq == 5
+    # a spec mismatch keeps the fresh ring (history is a cache)
+    wr3 = HistoryWriter(HistorySpec(windows=8, tiers=1),
+                        interval_s=10.0)
+    wr3.restore(snap)
+    assert wr3.seq == 0
+
+
+def test_history_survives_checkpoint_restore_byte_exact(tmp_path):
+    """Feed -> flush -> periodic checkpoint -> restore on a fresh
+    server: the restored ring is byte-identical and answers the same
+    range queries."""
+    kw = dict(checkpoint_dir=str(tmp_path / "ckpt"),
+              checkpoint_interval_flushes=1,
+              checkpoint_on_shutdown=False, native_ingest=False)
+    srv = Server(_hist_cfg(**kw), metric_sinks=[DebugMetricSink()])
+    srv.start()
+    try:
+        for batch, keys in [
+                ([b"ck.c:2|c", b"ck.g:5|g"],
+                 [("counter", "ck.c"), ("gauge", "ck.g")]),
+                ([b"ck.c:9|c"], [("counter", "ck.c")])]:
+            _send_udp(srv.local_addr(), batch)
+            _wait_keyed(srv, *keys)
+            assert srv.trigger_flush(timeout=300)
+        snap1 = srv.history.snapshot()
+        out1 = _query(srv, {"queries": [
+            {"name": "ck.c", "range": 1200, "step": 600}]})
+    finally:
+        srv.shutdown()
+
+    srv2 = Server(_hist_cfg(restore_on_start=True, **kw),
+                  metric_sinks=[DebugMetricSink()])
+    srv2.start()
+    try:
+        snap2 = srv2.history.snapshot()
+        assert json.dumps(snap1["meta"], sort_keys=True) == \
+            json.dumps(snap2["meta"], sort_keys=True)
+        for name in sorted(snap1["arrays"]):
+            np.testing.assert_array_equal(
+                snap1["arrays"][name], snap2["arrays"][name],
+                err_msg=f"restored ring field {name} not byte-exact")
+        out2 = _query(srv2, {"queries": [
+            {"name": "ck.c", "range": 1200, "step": 600}]})
+        assert [p["value"] for p in _points(out1)] == \
+            [p["value"] for p in _points(out2)] == [2.0, 9.0]
+    finally:
+        srv2.shutdown()
+
+
+def test_restore_ignores_malformed_history_chunk():
+    srv = Server(_hist_cfg(), metric_sinks=[DebugMetricSink()])
+    try:
+        srv.history.restore({"meta": {"spec": {"windows": -1}},
+                             "arrays": {}})
+        assert srv.history.seq == 0
+        srv.history.restore({})
+        assert srv.history.seq == 0
+    finally:
+        srv._shutdown.set()
+
+
+# -- live reshard -------------------------------------------------------------
+
+def test_history_survives_4_to_8_reshard_range_exact():
+    """The writer keys at SERVER scope, so a live 4->8 resize neither
+    moves nor re-keys ring rows: windows written before the move and
+    after it answer one range query with exact per-interval values."""
+    srv = Server(_hist_cfg(tpu_n_shards=4, native_ingest=False,
+                           reshard_enabled=True),
+                 metric_sinks=[DebugMetricSink()])
+    srv.start()
+    try:
+        _send_udp(srv.local_addr(), [b"rs.h:3|c", b"rs.hg:4|g"])
+        _wait_keyed(srv, ("counter", "rs.h"), ("gauge", "rs.hg"))
+        assert srv.trigger_flush(timeout=300)
+        summary = srv.trigger_reshard(8, timeout=300)
+        assert not summary["failed"]
+        assert srv.aggregator.n_shards == 8
+        _send_udp(srv.local_addr(), [b"rs.h:5|c"])
+        _wait_keyed(srv, ("counter", "rs.h"))
+        assert srv.trigger_flush(timeout=300)
+        assert srv.history.seq == 2          # the move rolled nothing
+        out = _query(srv, {"queries": [
+            {"name": "rs.h", "range": 1200, "step": 600},
+            {"name": "rs.hg", "range": 1200}]})
+        pts = _points(out)
+        assert [p["value"] for p in pts] == [3.0, 5.0]
+        assert all(p["complete"] for p in pts)
+        assert _points(out, 1)[0]["value"] == 4.0
+    finally:
+        srv.shutdown()
+
+
+# -- delta watches read the ring ----------------------------------------------
+
+def _run_delta_sequence(history_on):
+    cfg = _hist_cfg(watch_enabled=True, history_enabled=history_on)
+    srv = Server(cfg, metric_sinks=[DebugMetricSink()])
+    srv.start()
+    seen = []
+    ring_reads = [0]
+    try:
+        if history_on:
+            orig = srv.history.read_values
+
+            def counting(seq, items):
+                ring_reads[0] += 1
+                return orig(seq, items)
+
+            srv.history.read_values = counting
+        import urllib.request
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.http_port}/watch",
+            data=json.dumps({"name": "dw.c", "kind": "delta",
+                             "threshold": 5}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            assert resp.status == 201
+        for i, v in enumerate([10, 18, 2]):
+            _send_udp(srv.local_addr(), [f"dw.c:{v}|c".encode()])
+            _wait_keyed(srv, ("counter", "dw.c"))
+            assert srv.trigger_flush(timeout=300)
+            _wait_until(lambda: srv.watch_engine.intervals_evaluated
+                        + srv.watch_engine.intervals_skipped >= i + 1,
+                        what=f"watch interval {i + 1} evaluated")
+            w = srv.watch_engine.list_watches()[0]
+            seen.append((w["status"], w.get("value")))
+    finally:
+        srv.shutdown()
+    return seen, ring_reads[0]
+
+
+def test_delta_watch_ring_baseline_parity():
+    """Satellite fix: with history on, delta watches read their
+    previous-interval baseline from the ring — transitions and values
+    must be IDENTICAL to the legacy retained-Python-state behavior."""
+    legacy, legacy_reads = _run_delta_sequence(history_on=False)
+    ring, reads = _run_delta_sequence(history_on=True)
+    assert ring == legacy
+    # the canonical delta walk: priming interval carries no value, then
+    # the DELTAS +8 (ALERT, > 5) and -16 (back OK)
+    assert [s for s, _ in ring] == ["OK", "ALERT", "OK"]
+    assert [v for _, v in ring] == [None, 8.0, -16.0]
+    assert legacy_reads == 0
+    assert reads >= 1              # the baseline actually came off-ring
+
+
+# -- CLI round trip (satellite 1) ---------------------------------------------
+
+def test_cli_query_range_round_trip(capsys):
+    from veneur_tpu.cli import query as cli
+    srv = Server(_hist_cfg(), metric_sinks=[DebugMetricSink()])
+    srv.start()
+    try:
+        for v in [4, 6]:
+            _send_udp(srv.local_addr(), [f"cli.c:{v}|c".encode()])
+            _wait_keyed(srv, ("counter", "cli.c"))
+            assert srv.trigger_flush(timeout=300)
+        url = f"http://127.0.0.1:{srv.http_port}/query"
+        # --json: machine-readable body round-trips the point values
+        assert cli.main(["cli.c", "--range", "20m", "--step", "10m",
+                         "--url", url, "--json"]) == 0
+        out = json.loads(capsys.readouterr().out)
+        pts = out["results"][0]["matches"][0]["points"]
+        assert [p["value"] for p in pts] == [4.0, 6.0]
+        assert out["results"][0]["range"] is True
+        # human rendering: one line per point, seq span + rate visible
+        assert cli.main(["cli.c", "--range", "1200s", "--step", "600s",
+                         "--url", url]) == 0
+        text = capsys.readouterr().out
+        assert "cli.c  [counter]" in text
+        assert "seq[0..0]" in text and "seq[1..1]" in text
+        assert "value=4" in text and "value=6" in text
+    finally:
+        srv.shutdown()
+
+
+def test_cli_duration_and_flag_validation():
+    from veneur_tpu.cli import query as cli
+    import argparse
+    import types
+
+    assert cli.parse_duration("90") == 90.0
+    assert cli.parse_duration("15m") == 900.0
+    assert cli.parse_duration("2h") == 7200.0
+    assert cli.parse_duration("1d") == 86400.0
+    with pytest.raises(argparse.ArgumentTypeError):
+        cli.parse_duration("bogus")
+    with pytest.raises(argparse.ArgumentTypeError):
+        cli.parse_duration("-5m")
+    # --window/--step without --range is a usage error
+    args = types.SimpleNamespace(name="x", prefix=None, match=None,
+                                 kind=[], quantile=[], tag=[],
+                                 range=None, window=60.0, step=None)
+    with pytest.raises(SystemExit):
+        cli.build_query(args)
+    args.range, args.window, args.step = 900.0, 300.0, 60.0
+    q = cli.build_query(args)
+    assert (q["range"], q["window"], q["step"]) == (900.0, 300.0, 60.0)

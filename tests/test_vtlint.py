@@ -257,6 +257,48 @@ CASES = [
         """},
     ),
     (
+        # same pass, history-ring surface: the ring mutators are donating
+        # jits declared via the partial(jax.jit, ...) idiom — losing
+        # donate_argnames must flag, and a host materialization inside a
+        # ring-maintenance hot function (commit runs inside the flush's
+        # dispatch window) must flag too
+        "jax-hot-path",
+        lambda p: jax_hot_path.run(
+            p, hot_funcs={"pkg/ring.py": ["commit"]},
+            donating_jits={"pkg/ring.py": ["write_window"]},
+            sync_scan=[]),
+        {"pkg/ring.py": """
+            import functools
+            import jax
+            import numpy as np
+
+            def write_window_core(hist, vals, *, hspec):
+                return hist
+
+            write_window = functools.partial(
+                jax.jit, static_argnames=("hspec",))(write_window_core)
+
+            def commit(state, plan):
+                rolled = jax.numpy.add(state, 1)
+                return np.asarray(rolled)
+        """},
+        {"pkg/ring.py": """
+            import functools
+            import jax
+
+            def write_window_core(hist, vals, *, hspec):
+                return hist
+
+            write_window = functools.partial(
+                jax.jit, static_argnames=("hspec",),
+                donate_argnames=("hist",))(write_window_core)
+
+            def commit(state, plan):
+                rolled = jax.numpy.add(state, 1)
+                return rolled
+        """},
+    ),
+    (
         "lock-discipline",
         lambda p: lock_discipline.run(p, modules=["pkg/mod.py"]),
         {"pkg/mod.py": """
@@ -334,6 +376,43 @@ CASES = [
                     n = sum(len(r) for r in rows)
                     self.host_ns += time.perf_counter_ns() - t0
                     return n
+        """},
+    ),
+    (
+        # same pass, history-ring surface: the writer's decimation roll
+        # is a device dispatch on the flush thread — timing it without a
+        # sync must flag; the dispatch_* naming convention (SampledSync
+        # owns the real periodic drain) must not
+        "timer-sync",
+        lambda p: timer_sync.run(p, files=["pkg/ring.py"]),
+        {"pkg/ring.py": """
+            import time
+            import jax
+
+            class Writer:
+                def commit(self, state, plan):
+                    t0 = time.perf_counter_ns()
+                    state = jax.numpy.roll(state, 1)
+                    self.roll_ns += time.perf_counter_ns() - t0
+                    return state
+        """},
+        {"pkg/ring.py": """
+            import time
+            import jax
+
+            class Writer:
+                def commit(self, state, plan):
+                    t0 = time.perf_counter_ns()
+                    state = jax.numpy.roll(state, 1)
+                    self.roll_dispatch_ns += time.perf_counter_ns() - t0
+                    return state
+
+                def commit_synced(self, state, plan):
+                    t0 = time.perf_counter_ns()
+                    state = jax.numpy.roll(state, 1)
+                    jax.block_until_ready(state)
+                    self.roll_ns += time.perf_counter_ns() - t0
+                    return state
         """},
     ),
     (

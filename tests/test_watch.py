@@ -421,7 +421,7 @@ def test_offer_backlog_drops_oldest_interval_with_accounting():
         entered, release = threading.Event(), threading.Event()
         seen = []
 
-        def stall(state, table, set_shift, ts):
+        def stall(state, table, set_shift, ts, hist_seq=None):
             seen.append(ts)
             entered.set()
             release.wait(30)

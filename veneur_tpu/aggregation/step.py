@@ -612,6 +612,58 @@ flush_live_in_packed = partial(
         _flush_live_in_packed_core)
 
 
+def _flush_live_hist_packed_core(state, flat, hist, hflat, *, spec,
+                                 hspec, n_q: int, buckets: tuple,
+                                 want_raw: bool = False,
+                                 clear: bool = False):
+    """The flush program WITH the history tier's fused window write:
+    identical flush math and packed output wire as
+    _flush_live_in_packed_core, plus one extra scatter of the interval's
+    values into ring column `col` — no second launch, no extra host
+    traffic (ISSUE 18 tentpole). `hflat` carries the per-kind ring-row
+    destinations (same bucket sizes as the flush's live-index buckets,
+    sentinel rows drop) followed by the column scalar; the ring is
+    DONATED and returned alongside the packed outputs.
+
+    The write itself is history/device.write_window_core — the same
+    function the host-fed backends and the replay oracle jit standalone
+    — so both paths store bit-identical window bytes."""
+    from veneur_tpu.history.device import write_window_core
+    qs = jax.lax.bitcast_convert_type(flat[:n_q], jnp.float32)
+    idx, off = [], n_q
+    for n in buckets:
+        idx.append(flat[off:off + n])
+        off += n
+    out = flush_live_core(state, qs, *idx, spec=spec, want_raw=True)
+    dests, hoff = [], 0
+    for n in buckets:
+        dests.append(hflat[hoff:hoff + n])
+        hoff += n
+    col = hflat[hoff]
+    vals = {
+        "counter_hi": out["counter_hi"], "counter_lo": out["counter_lo"],
+        "gauge": out["gauge"], "status": out["status"],
+        "hll": out["raw_hll"],
+        "h_mean": out["raw_h_mean"], "h_weight": out["raw_h_weight"],
+        "h_min": out["histo_min"], "h_max": out["histo_max"],
+        "h_count_hi": out["histo_count_hi"],
+        "h_count_lo": out["histo_count_lo"],
+        "h_sum_hi": out["histo_sum_hi"], "h_sum_lo": out["histo_sum_lo"],
+    }
+    new_hist = write_window_core(hist, vals, tuple(dests), col,
+                                 hspec=hspec, clear=clear)
+    if not want_raw:
+        out = {k: v for k, v in out.items() if not k.startswith("raw_")}
+    return _pack_outputs(out), new_hist
+
+
+flush_live_hist_packed = partial(
+    jax.jit,
+    static_argnames=("spec", "hspec", "n_q", "buckets", "want_raw",
+                     "clear"),
+    donate_argnames=("hist",))(_flush_live_hist_packed_core)
+
+
 def unpack_flush(packed, shapes: dict) -> dict:
     """Host-side inverse of the device packing: slice the flat f32 array
     back into named arrays. `shapes` maps key -> (shape, dtype); keys are
@@ -691,15 +743,18 @@ def live_slots(table, kind: str):
     return idx
 
 
-def pack_bucket_chunks(slots, buckets, block_i: int):
-    """Block `block_i`'s per-kind index chunk, zero-padded to each
+def pack_bucket_chunks(slots, buckets, block_i: int, fill: int = 0):
+    """Block `block_i`'s per-kind index chunk, padded to each
     kind's STATIC bucket size (the tiled flush's executable-shape
-    contract: every block invocation has identical bucket shapes)."""
+    contract: every block invocation has identical bucket shapes).
+    `fill` is the pad value: 0 for gather indices (clipped, outputs
+    trimmed), an out-of-range sentinel for the history tier's scatter
+    destinations (mode="drop" discards pads)."""
     import numpy as np
     out = []
     for sarr, b in zip(slots, buckets):
         c = sarr[block_i * b:(block_i + 1) * b]
-        buf = np.zeros(b, np.int32)
+        buf = np.full(b, fill, np.int32)
         buf[:len(c)] = c
         out.append(buf)
     return out

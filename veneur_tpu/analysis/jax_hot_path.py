@@ -74,7 +74,12 @@ HOT_FUNCS: Dict[str, List[str]] = {
     "veneur_tpu/collective/tier.py": [
         "_dispatch_row", "_dispatch_routed", "_on_stage_batch",
         "absorb_raw", "swap", "query_snapshot"],
-    "veneur_tpu/query/engine.py": ["_launch", "_launch_on_pipeline"],
+    "veneur_tpu/query/engine.py": [
+        "_launch", "_launch_on_pipeline", "_launch_combined"],
+    # history ring maintenance runs inside the flush's dispatch window
+    # on the pipeline/flush thread: a hidden sync here stalls swap()
+    "veneur_tpu/history/writer.py": [
+        "begin_flush", "commit_flush", "_roll", "record_frame"],
 }
 
 # named jit wrappers that MUST donate their state argument: dropping
@@ -82,13 +87,19 @@ HOT_FUNCS: Dict[str, List[str]] = {
 DONATING_JITS: Dict[str, List[str]] = {
     "veneur_tpu/aggregation/step.py": [
         "ingest_step", "ingest_step_packed", "compact"],
+    # the ring mutators update HistoryState in place; losing donation
+    # doubles the history tier's HBM footprint per flush
+    "veneur_tpu/history/device.py": [
+        "write_window", "wipe_rows", "roll_tiers"],
 }
 
 # static parameters of the jitted family: a list/dict/set literal here
 # is unhashable (TypeError at trace time)
-STATIC_ARG_NAMES = ("spec", "sizes")
+STATIC_ARG_NAMES = ("spec", "sizes", "hspec")
 JITTED_CALLEES = ("ingest_step", "packed_step", "compact",
-                  "flush_compute", "quantile_compute")
+                  "flush_compute", "quantile_compute",
+                  "write_window", "wipe_rows", "roll_tiers",
+                  "range_in_packed", "query_combined")
 
 # files scanned for stray block_until_ready (bench code lives under
 # benchmarks/ and is out of scope by construction); the Pallas-kernel

@@ -42,6 +42,7 @@ FILES = [
     "veneur_tpu/collective/tier.py",
     "veneur_tpu/query/engine.py",
     "veneur_tpu/watch/engine.py",
+    "veneur_tpu/history/writer.py",
 ]
 
 _SYNC_LEAVES = ("block_until_ready", "sync_and_time")

@@ -378,11 +378,11 @@ class CollectiveGlobalTier(ShardedAggregator):
                             merged)
 
     def compute_flush(self, state, table, percentiles,
-                      want_raw: bool = False):
+                      want_raw: bool = False, history=None):
         t_flush = time.perf_counter_ns()
         try:
             return self._compute_flush_timed(state, table, percentiles,
-                                             want_raw)
+                                             want_raw, history)
         finally:
             # implicitly synced: every return path host-materializes the
             # flush arrays (np.asarray), so this is true wall time
@@ -391,7 +391,7 @@ class CollectiveGlobalTier(ShardedAggregator):
                                       phase="flush")
 
     def _compute_flush_timed(self, state, table, percentiles,
-                             want_raw: bool = False):
+                             want_raw: bool = False, history=None):
         # the replica_merge span parents onto the most recent co-located
         # absorb and is emitted on EVERY flush path — on the plain path
         # the merge collectives run inside the compiled flush itself, so
@@ -406,19 +406,19 @@ class CollectiveGlobalTier(ShardedAggregator):
             mspan.set_tag("replicas", str(self.n_replicas))
         try:
             return self._compute_flush_inner(state, table, percentiles,
-                                             want_raw)
+                                             want_raw, history)
         finally:
             if mspan is not None:
                 mspan.client_finish(self._trace_client)
                 self._last_absorb = None
 
     def _compute_flush_inner(self, state, table, percentiles,
-                             want_raw: bool = False):
-        if not want_raw or self.n_replicas == 1:
+                             want_raw: bool = False, history=None):
+        if self.n_replicas == 1 or (not want_raw and history is None):
             # R == 1: the inherited raw gather reads the state verbatim,
             # byte-identical to the sharded backend by construction
             return super().compute_flush(state, table, percentiles,
-                                         want_raw)
+                                         want_raw, history=history)
         import jax
         import jax.numpy as jnp
         from veneur_tpu.aggregation.step import live_indices, unpack_flush
@@ -449,4 +449,11 @@ class CollectiveGlobalTier(ShardedAggregator):
             "h_max": r["h_max"],
             "h_recip": r["recip_hi"].astype(np.float64) + r["recip_lo"],
         }
-        return result, table, raw
+        if history is not None:
+            # replica-merged raw is the mesh-global frame — the one the
+            # archive keeps — so the ring stores the same bytes a replay
+            # of those frames would
+            history.record_frame(table, result, raw)
+        if want_raw:
+            return result, table, raw
+        return result, table

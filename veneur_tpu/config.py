@@ -378,6 +378,19 @@ class Config:
     watch_max_active: int = 1 << 17
     watch_stream_max_subscribers: int = 64
     watch_webhook_url: str = ""
+    # on-device history tier (veneur_tpu/history/): keep the last
+    # history_windows flush intervals device-resident per key (written
+    # by the flush program itself), with history_decimation_tiers
+    # levels of 2x-decimated older windows — history_windows *
+    # 2^tiers intervals of total lookback. Range queries ride POST
+    # /query (query tier) and `python -m veneur_tpu.cli.query --range`.
+    # history_max_keys caps per-kind ring rows (HBM: see
+    # history.HistorySpec.hbm_bytes; the veneur.history.hbm_bytes gauge
+    # reports the resident figure).
+    history_enabled: bool = False
+    history_windows: int = 90
+    history_decimation_tiers: int = 3
+    history_max_keys: int = 1 << 20
 
     def parse_interval(self) -> float:
         return parse_duration(self.interval)

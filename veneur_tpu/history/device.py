@@ -1,0 +1,278 @@
+"""Device-resident history ring: state container + the jitted write,
+decimation-merge and column-read programs.
+
+Everything here follows the donation discipline of the ingest step: the
+ring is threaded through `write_window` / `roll_tiers` / `wipe_rows` as
+a donated argument, so the steady state holds exactly ONE HistoryState
+in HBM (the analytic budget in HistorySpec.hbm_bytes is also the real
+one). Callers (history/writer.py) serialize every dispatch that touches
+the ring under one lock and swap their reference to the returned state;
+readers grab the current reference under the same lock before
+dispatching, which is safe against donation because an enqueued
+execution keeps its input buffers alive until it retires.
+
+Absence is encoded in the values, not in side masks — each kind's
+neutral element is also its merge identity, so decimation and range
+merges need no occupancy bookkeeping:
+
+    counter   (0, 0)        additive identity of the two-float pair
+    gauge     NaN           LWW skips NaN (newer finite value wins)
+    status    NaN           same
+    hll       all-zero      register max identity
+    digest    weight 0      compress_rows ignores empty cells
+    min/max   +inf / -inf   order identities
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from veneur_tpu.history.spec import HistorySpec
+from veneur_tpu.ops import hll as hll_ops
+from veneur_tpu.ops import tdigest as td
+from veneur_tpu.utils.numerics import twofloat_merge
+
+
+class HistoryState(NamedTuple):
+    """One ring per kind; axis 1 is the flat column index
+    tier * windows + (slot % windows) (see spec.py)."""
+    counter_hi: jax.Array   # f32[Rc, W]
+    counter_lo: jax.Array   # f32[Rc, W]
+    gauge: jax.Array        # f32[Rg, W]
+    status: jax.Array       # f32[Rst, W]
+    hll: jax.Array          # i32[Rs, W, hll_words]
+    h_mean: jax.Array       # f32[Rh, W, C]
+    h_weight: jax.Array     # f32[Rh, W, C]
+    h_min: jax.Array        # f32[Rh, W]
+    h_max: jax.Array        # f32[Rh, W]
+    h_count_hi: jax.Array   # f32[Rh, W]
+    h_count_lo: jax.Array   # f32[Rh, W]
+    h_sum_hi: jax.Array     # f32[Rh, W]
+    h_sum_lo: jax.Array     # f32[Rh, W]
+
+
+HISTORY_FIELDS = HistoryState._fields
+
+# write_window's value-dict contract (all in table get_meta order,
+# padded to the dest buckets): h_mean/h_weight arrive at the FLUSH
+# table's cell count and are compressed to hspec.centroids in-program.
+WRITE_KEYS = ("counter_hi", "counter_lo", "gauge", "status", "hll",
+              "h_mean", "h_weight", "h_min", "h_max",
+              "h_count_hi", "h_count_lo", "h_sum_hi", "h_sum_lo")
+
+
+def empty_history(hspec: HistorySpec) -> HistoryState:
+    w = hspec.total_cols
+    f32 = jnp.float32
+    return HistoryState(
+        counter_hi=jnp.zeros((hspec.counter_rows, w), f32),
+        counter_lo=jnp.zeros((hspec.counter_rows, w), f32),
+        gauge=jnp.full((hspec.gauge_rows, w), jnp.nan, f32),
+        status=jnp.full((hspec.status_rows, w), jnp.nan, f32),
+        hll=jnp.zeros((hspec.set_rows, w, hspec.hll_words), jnp.int32),
+        h_mean=jnp.zeros((hspec.histo_rows, w, hspec.centroids), f32),
+        h_weight=jnp.zeros((hspec.histo_rows, w, hspec.centroids), f32),
+        h_min=jnp.full((hspec.histo_rows, w), jnp.inf, f32),
+        h_max=jnp.full((hspec.histo_rows, w), -jnp.inf, f32),
+        h_count_hi=jnp.zeros((hspec.histo_rows, w), f32),
+        h_count_lo=jnp.zeros((hspec.histo_rows, w), f32),
+        h_sum_hi=jnp.zeros((hspec.histo_rows, w), f32),
+        h_sum_lo=jnp.zeros((hspec.histo_rows, w), f32),
+    )
+
+
+_NEUTRAL = {
+    "counter_hi": 0.0, "counter_lo": 0.0,
+    "gauge": jnp.nan, "status": jnp.nan,
+    "h_min": jnp.inf, "h_max": -jnp.inf,
+    "h_count_hi": 0.0, "h_count_lo": 0.0,
+    "h_sum_hi": 0.0, "h_sum_lo": 0.0,
+}
+
+
+def _clear_column(hist: HistoryState, col) -> HistoryState:
+    """Neutralize ring column `col` for every kind — the ring-wraparound
+    eviction of the window being overwritten."""
+    out = {}
+    for name in HISTORY_FIELDS:
+        a = getattr(hist, name)
+        if a.ndim == 2:
+            out[name] = a.at[:, col].set(jnp.float32(_NEUTRAL[name]))
+        else:
+            out[name] = a.at[:, col, :].set(
+                jnp.zeros((a.shape[0], a.shape[2]), a.dtype))
+    return HistoryState(**out)
+
+
+def write_window_core(hist: HistoryState, vals: dict, dests: tuple, col,
+                      *, hspec: HistorySpec, clear: bool):
+    """Scatter one flush interval's per-key values into ring column
+    `col`. `dests` is (counter, gauge, status, set, histo) i32 row
+    arrays in get_meta order, padded with an out-of-range sentinel
+    (>= rows) so pads drop; `clear` neutralizes the column first (set
+    by the FIRST block of a tiled flush only). This function is inlined
+    into the flush program itself (aggregation/step.py
+    flush_live_hist_packed) — the "one extra fused write" — and is also
+    its own jit (`write_window`) for host-fed backends and the replay
+    oracle, so both paths store bit-identical bytes by construction."""
+    if clear:
+        hist = _clear_column(hist, col)
+    dc, dg, dst_, ds, dh = dests
+
+    def put(arr, dest, v):
+        return arr.at[dest, col].set(v, mode="drop")
+
+    cm, cw = td.compress_rows(
+        vals["h_mean"], vals["h_weight"], compression=hspec.compression,
+        cells_per_k=hspec.cells_per_k, out_c=hspec.centroids,
+        exact_extremes=hspec.exact_extremes)
+    return HistoryState(
+        counter_hi=put(hist.counter_hi, dc, vals["counter_hi"]),
+        counter_lo=put(hist.counter_lo, dc, vals["counter_lo"]),
+        gauge=put(hist.gauge, dg, vals["gauge"]),
+        status=put(hist.status, dst_, vals["status"]),
+        hll=hist.hll.at[ds, col, :].set(vals["hll"], mode="drop"),
+        h_mean=hist.h_mean.at[dh, col, :].set(cm, mode="drop"),
+        h_weight=hist.h_weight.at[dh, col, :].set(cw, mode="drop"),
+        h_min=put(hist.h_min, dh, vals["h_min"]),
+        h_max=put(hist.h_max, dh, vals["h_max"]),
+        h_count_hi=put(hist.h_count_hi, dh, vals["h_count_hi"]),
+        h_count_lo=put(hist.h_count_lo, dh, vals["h_count_lo"]),
+        h_sum_hi=put(hist.h_sum_hi, dh, vals["h_sum_hi"]),
+        h_sum_lo=put(hist.h_sum_lo, dh, vals["h_sum_lo"]),
+    )
+
+
+write_window = partial(
+    jax.jit, static_argnames=("hspec", "clear"),
+    donate_argnames=("hist",))(write_window_core)
+
+
+def wipe_rows_core(hist: HistoryState, resets: tuple, *,
+                   hspec: HistorySpec):
+    """Neutralize whole ROWS across every column — run when the writer
+    reassigns an evicted key's row to a new key, so the new key never
+    inherits the old key's windows. `resets` mirrors `dests` (i32 per
+    kind, sentinel-padded)."""
+    dc, dg, dst_, ds, dh = resets
+    w = hspec.total_cols
+
+    def wipe(arr, rows, fill):
+        v = jnp.full((rows.shape[0], w), jnp.float32(fill))
+        return arr.at[rows, :].set(v, mode="drop")
+
+    def wipe3(arr, rows):
+        v = jnp.zeros((rows.shape[0], w, arr.shape[2]), arr.dtype)
+        return arr.at[rows, :, :].set(v, mode="drop")
+
+    return HistoryState(
+        counter_hi=wipe(hist.counter_hi, dc, 0.0),
+        counter_lo=wipe(hist.counter_lo, dc, 0.0),
+        gauge=wipe(hist.gauge, dg, jnp.nan),
+        status=wipe(hist.status, dst_, jnp.nan),
+        hll=wipe3(hist.hll, ds),
+        h_mean=wipe3(hist.h_mean, dh),
+        h_weight=wipe3(hist.h_weight, dh),
+        h_min=wipe(hist.h_min, dh, jnp.inf),
+        h_max=wipe(hist.h_max, dh, -jnp.inf),
+        h_count_hi=wipe(hist.h_count_hi, dh, 0.0),
+        h_count_lo=wipe(hist.h_count_lo, dh, 0.0),
+        h_sum_hi=wipe(hist.h_sum_hi, dh, 0.0),
+        h_sum_lo=wipe(hist.h_sum_lo, dh, 0.0),
+    )
+
+
+wipe_rows = partial(
+    jax.jit, static_argnames=("hspec",),
+    donate_argnames=("hist",))(wipe_rows_core)
+
+
+def roll_tiers_core(hist: HistoryState, src0, src1, dst, *,
+                    hspec: HistorySpec):
+    """Decimation merge: fold columns src0 (older) and src1 (newer) of
+    tier t-1 into column dst of tier t, for ALL rows at once. Column
+    indices are TRACED scalars so one compiled executable serves every
+    (tier, slot) combination — amortized launch cost per flush is
+    sum(2^-t) < 1.
+
+    Merge semantics per kind: counters and histo count/sum fold with
+    compensated two-float merges; gauges/status are last-writer-wins
+    (src1 wins when finite); HLL takes the register max (exact union);
+    digest centroids concatenate and re-compress through the SAME
+    k-cell compression as the window write, which is what keeps
+    decimated quantiles inside the t-digest merge bound."""
+    def colv(arr, c):
+        return jax.lax.dynamic_index_in_dim(arr, c, axis=1,
+                                            keepdims=False)
+
+    chi, clo = twofloat_merge(
+        colv(hist.counter_hi, src0), colv(hist.counter_lo, src0),
+        colv(hist.counter_hi, src1), colv(hist.counter_lo, src1))
+    g0, g1 = colv(hist.gauge, src0), colv(hist.gauge, src1)
+    gauge = jnp.where(jnp.isnan(g1), g0, g1)
+    s0, s1 = colv(hist.status, src0), colv(hist.status, src1)
+    status = jnp.where(jnp.isnan(s1), s0, s1)
+    p = hspec.hll_precision
+    regs = jnp.maximum(
+        hll_ops.unpack_registers(colv(hist.hll, src0), precision=p),
+        hll_ops.unpack_registers(colv(hist.hll, src1), precision=p))
+    words = hll_ops.pack_registers(regs, precision=p)
+    mcat = jnp.concatenate(
+        [colv(hist.h_mean, src0), colv(hist.h_mean, src1)], axis=-1)
+    wcat = jnp.concatenate(
+        [colv(hist.h_weight, src0), colv(hist.h_weight, src1)], axis=-1)
+    cm, cw = td.compress_rows(
+        mcat, wcat, compression=hspec.compression,
+        cells_per_k=hspec.cells_per_k, out_c=hspec.centroids,
+        exact_extremes=hspec.exact_extremes)
+    hct_hi, hct_lo = twofloat_merge(
+        colv(hist.h_count_hi, src0), colv(hist.h_count_lo, src0),
+        colv(hist.h_count_hi, src1), colv(hist.h_count_lo, src1))
+    hs_hi, hs_lo = twofloat_merge(
+        colv(hist.h_sum_hi, src0), colv(hist.h_sum_lo, src0),
+        colv(hist.h_sum_hi, src1), colv(hist.h_sum_lo, src1))
+    return HistoryState(
+        counter_hi=hist.counter_hi.at[:, dst].set(chi),
+        counter_lo=hist.counter_lo.at[:, dst].set(clo),
+        gauge=hist.gauge.at[:, dst].set(gauge),
+        status=hist.status.at[:, dst].set(status),
+        hll=hist.hll.at[:, dst, :].set(words),
+        h_mean=hist.h_mean.at[:, dst, :].set(cm),
+        h_weight=hist.h_weight.at[:, dst, :].set(cw),
+        h_min=hist.h_min.at[:, dst].set(
+            jnp.minimum(colv(hist.h_min, src0), colv(hist.h_min, src1))),
+        h_max=hist.h_max.at[:, dst].set(
+            jnp.maximum(colv(hist.h_max, src0), colv(hist.h_max, src1))),
+        h_count_hi=hist.h_count_hi.at[:, dst].set(hct_hi),
+        h_count_lo=hist.h_count_lo.at[:, dst].set(hct_lo),
+        h_sum_hi=hist.h_sum_hi.at[:, dst].set(hs_hi),
+        h_sum_lo=hist.h_sum_lo.at[:, dst].set(hs_lo),
+    )
+
+
+roll_tiers = partial(
+    jax.jit, static_argnames=("hspec",),
+    donate_argnames=("hist",))(roll_tiers_core)
+
+
+def read_column_core(hist: HistoryState, col, cidx, gidx, stidx, *,
+                     hspec: HistorySpec):
+    """Gather one ring column's counter/gauge/status values for a row
+    subset — the watch tier's "previous interval" lookback (ISSUE 18
+    satellite: delta watches read the ring instead of retained Python
+    state). Pads ride mode="clip" gathers; the caller trims."""
+    def grab(arr, idx):
+        rows = jnp.take(arr, idx, axis=0, mode="clip")
+        return jax.lax.dynamic_index_in_dim(rows, col, axis=1,
+                                            keepdims=False)
+
+    return (grab(hist.counter_hi, cidx), grab(hist.counter_lo, cidx),
+            grab(hist.gauge, gidx), grab(hist.status, stidx))
+
+
+read_column = partial(
+    jax.jit, static_argnames=("hspec",))(read_column_core)

@@ -1,0 +1,132 @@
+"""Shape contract for the on-device history tier.
+
+The history tier keeps the last K flush intervals device-resident as a
+packed per-key ring in HBM (ROADMAP item 4): two-float counters, LWW
+gauges/status, 6-bit packed HLL rows and per-window merged digest
+centroids. `HistorySpec` is the frozen, hashable shape descriptor every
+history jit specializes on — the same role `TableSpec` plays for the
+ingest/flush programs, and deliberately a SEPARATE type: history
+configuration must not perturb the snapshot `schema_hash` (which covers
+DeviceState/TableSpec only), so history-off and history-on servers can
+restore each other's checkpoints.
+
+Ring layout (per kind, per row):
+
+    col = tier * windows + (slot % windows)
+
+Tier 0 holds raw flush intervals; tier t >= 1 holds 2x-decimated merges
+of tier t-1 (slot m covers tier-(t-1) slots 2m and 2m+1), so `windows`
+instants per tier buy `windows * 2^tiers` intervals of total lookback in
+`windows * (tiers + 1)` resident columns. Error bound under decimation:
+counters/counts/sums merge with compensated two-float adds (error-free
+to ~48 significand bits — utils/numerics.py); HLL registers merge by
+max (exact union); digests re-merge centroids through the same k-cell
+compression as ingest, so windowed quantiles stay within the t-digest
+merge bound (arxiv 1902.04023) with compression fixed by this spec;
+gauges/status are last-writer-wins (the newer window's value survives a
+merge — exact for "latest value" semantics, lossy by design for
+anything else).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, asdict
+
+from veneur_tpu.ops import hll
+from veneur_tpu.ops import tdigest as td
+
+
+@dataclass(frozen=True)
+class HistorySpec:
+    """Static shape parameters for one history ring. Hashable: used as a
+    static jit argument by every history device program."""
+
+    windows: int = 90           # K0: ring length per tier, in windows
+    tiers: int = 3              # decimation tiers beyond tier 0
+    counter_rows: int = 1 << 10
+    gauge_rows: int = 1 << 9
+    status_rows: int = 1 << 8
+    set_rows: int = 1 << 8
+    histo_rows: int = 1 << 8
+    # History digests are re-merged many times (once per decimation
+    # level and once per range query), so they run a SMALLER compression
+    # than the live table: ~32 centroids per window keeps the histo ring
+    # inside budget while the k-cell invariant bounds quantile error.
+    compression: float = 20.0
+    cells_per_k: int = 2
+    exact_extremes: int = 4
+    hll_precision: int = hll.DEFAULT_PRECISION
+
+    @property
+    def total_cols(self) -> int:
+        return self.windows * (self.tiers + 1)
+
+    @property
+    def centroids(self) -> int:
+        return td.centroid_capacity(self.compression, self.cells_per_k,
+                                    self.exact_extremes)
+
+    @property
+    def hll_words(self) -> int:
+        return hll.packed_words(self.hll_precision)
+
+    @property
+    def span_intervals(self) -> int:
+        """Total lookback in flush intervals: tier `tiers` retains
+        `windows` slots of 2^tiers intervals each."""
+        return self.windows * (1 << self.tiers)
+
+    def rows_for(self, kind_idx: int) -> int:
+        return (self.counter_rows, self.gauge_rows, self.status_rows,
+                self.set_rows, self.histo_rows)[kind_idx]
+
+    def hbm_bytes(self) -> int:
+        """Analytic device-resident footprint of one HistoryState, in
+        bytes — the number `veneur.history.hbm_bytes` reports and the
+        bench's K=90 @ 1M-keys cap gates on."""
+        w = self.total_cols
+        f32 = 4
+        counter = self.counter_rows * w * 2 * f32          # hi + lo
+        gauge = self.gauge_rows * w * f32
+        status = self.status_rows * w * f32
+        sets = self.set_rows * w * self.hll_words * f32
+        # mean + weight centroid planes, plus min/max/count-pair/sum-pair
+        histo = self.histo_rows * w * (2 * self.centroids + 6) * f32
+        return counter + gauge + status + sets + histo
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "HistorySpec":
+        return cls(**{k: d[k] for k in cls.__dataclass_fields__
+                      if k in d})
+
+    @classmethod
+    def for_table(cls, table_spec, *, windows: int = 90, tiers: int = 3,
+                  max_keys: int | None = None) -> "HistorySpec":
+        """Derive a ring spec from the live TableSpec: the HLL precision
+        MUST match (history stores the flush program's packed rows
+        verbatim), per-kind row caps default to the live capacities
+        clamped to `max_keys` (counters dominate real fleets; sketch
+        kinds get smaller rings because their per-row window cost is
+        2-3 orders of magnitude higher — see hbm_bytes)."""
+        cap = max_keys if max_keys is not None else 1 << 20
+
+        def rows(n, ceiling):
+            return max(64, min(int(n), int(ceiling), cap))
+
+        return cls(
+            windows=int(windows), tiers=int(tiers),
+            counter_rows=rows(table_spec.counter_capacity, 1 << 20),
+            gauge_rows=rows(table_spec.gauge_capacity, 1 << 18),
+            status_rows=rows(table_spec.status_capacity, 1 << 16),
+            # a packed p=14 HLL row costs hll_words*4 = 12 KiB per
+            # RESIDENT WINDOW (~4.3 MiB per key at K=90/tiers=3), so the
+            # set ring's ceiling is far below the other sketch kinds:
+            # 256 rows keep the whole K=90 @ 1M-key ring inside the
+            # single-chip HBM budget config14_range_dashboard gates on
+            set_rows=rows(table_spec.set_capacity, 1 << 8),
+            histo_rows=rows(table_spec.histo_capacity, 1 << 14),
+            hll_precision=table_spec.hll_precision,
+        )

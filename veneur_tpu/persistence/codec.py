@@ -129,6 +129,19 @@ def encode_to_dir(dirpath: str, snap: dict, fsync: bool = True) -> int:
                        json.dumps(snap["watches"],
                                   separators=(",", ":")).encode(),
                        None, None))
+    # history ring sidecar (veneur_tpu/history/): one JSON meta chunk
+    # (spec + seq + key index) plus one raw-bytes chunk per ring array.
+    # Same unknown-chunk rule — old readers skip all of them.
+    if snap.get("history"):
+        hist = snap["history"]
+        chunks.append(("history",
+                       json.dumps(hist["meta"],
+                                  separators=(",", ":")).encode(),
+                       None, None))
+        for name in sorted(hist["arrays"]):
+            arr = np.ascontiguousarray(hist["arrays"][name])
+            chunks.append((f"history:{name}", arr.tobytes(),
+                           str(arr.dtype), list(arr.shape)))
 
     index = []
     offset = 0
@@ -273,6 +286,21 @@ def load_dir(dirpath: str) -> dict:
             watches = json.loads(chunks["watches"])
         except ValueError as e:
             raise CorruptSnapshot(f"{dirpath}: watches chunk: {e}")
+    history = None
+    if chunks.get("history"):
+        try:
+            h_arrays = {}
+            for entry in manifest["chunks"]:
+                name = entry["name"]
+                if not name.startswith("history:"):
+                    continue
+                h_arrays[name[len("history:"):]] = np.frombuffer(
+                    chunks[name],
+                    dtype=np.dtype(entry["dtype"])).reshape(entry["shape"])
+            history = {"meta": json.loads(chunks["history"]),
+                       "arrays": h_arrays}
+        except (KeyError, TypeError, ValueError) as e:
+            raise CorruptSnapshot(f"{dirpath}: history chunks: {e}")
     return {
         "agg_kind": manifest["agg_kind"],
         "n_shards": manifest["n_shards"],
@@ -285,6 +313,7 @@ def load_dir(dirpath: str) -> dict:
         "spill": chunks.get("spill", b""),
         "forward": forward,
         "watches": watches,
+        "history": history,
     }
 
 

@@ -58,7 +58,8 @@ def build_snapshot(spec, table: KeyTable, result: Dict[str, np.ndarray],
                    spill: Optional[bytes] = None,
                    spill_entries: int = 0,
                    forward_meta: Optional[dict] = None,
-                   watches: Optional[dict] = None) -> dict:
+                   watches: Optional[dict] = None,
+                   history: Optional[dict] = None) -> dict:
     """`result`/`raw` are compute_flush's outputs for the interval being
     checkpointed (want_raw=True — both backends emit identical raw keys).
     `table` is the interval's detached KeyTable."""
@@ -118,4 +119,7 @@ def build_snapshot(spec, table: KeyTable, result: Dict[str, np.ndarray],
         # streaming watch tier registrations + firing state
         # (veneur_tpu/watch/); None/absent = tier off or no watches
         "watches": watches,
+        # history ring sidecar (veneur_tpu/history/): key index + raw
+        # window arrays, restored byte-exact; None/absent = tier off
+        "history": history,
     }

@@ -54,6 +54,7 @@ import logging
 import queue as queue_mod
 import threading
 import time
+from fnmatch import fnmatchcase
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -124,8 +125,27 @@ def _parse_one(q) -> dict:
                 or any(not isinstance(t, str) for t in tags):
             raise QueryError("tags must be a list of strings")
         tags = tuple(tags)
+
+    def _seconds(field):
+        v = q.get(field)
+        if v is None:
+            return None
+        try:
+            v = float(v)
+        except (TypeError, ValueError):
+            raise QueryError(f"{field} must be a number of seconds")
+        if not (0.0 < v < float("inf")):
+            raise QueryError(f"{field} must be positive seconds")
+        return v
+
+    rng = _seconds("range")
+    window = _seconds("window")
+    step = _seconds("step")
+    if rng is None and (window is not None or step is not None):
+        raise QueryError("window/step only apply with range")
     return {"mode": mode, "arg": arg, "kinds": kinds,
-            "quantiles": qs, "tags": tags}
+            "quantiles": qs, "tags": tags,
+            "range": rng, "window": window, "step": step}
 
 
 def parse_request(body, max_queries: int) -> List[dict]:
@@ -164,9 +184,10 @@ class QueryEngine:
 
     def __init__(self, server, *, max_batch: int = 64,
                  timeout_ms: float = 2.0, requests=None, batched=None,
-                 duration=None, stale_reads=None) -> None:
+                 duration=None, stale_reads=None, history=None) -> None:
         self._server = server
         self.spec = server.aggregator.spec           # TOTAL capacities
+        self._history = history                      # HistoryWriter | None
         self.max_batch = max(1, int(max_batch))
         self.timeout_s = max(0.0, float(timeout_ms)) / 1000.0
         self._c_requests = requests
@@ -193,6 +214,10 @@ class QueryEngine:
         QueryError (400) on a bad body, TimeoutError/RuntimeError (503)
         when the pipeline or device cannot serve."""
         queries = parse_request(body, self.max_batch)
+        if self._history is None \
+                and any(q["range"] is not None for q in queries):
+            raise QueryError("range queries need the history tier "
+                             "(history_enabled: false)")
         if self._c_requests is not None:
             self._c_requests.inc(len(queries))
         if self._stop.is_set():
@@ -316,28 +341,69 @@ class QueryEngine:
         return out
 
     def _launch_on_pipeline(self, aggregator, table, packed_inputs,
-                            n_q: int, buckets: tuple):
+                            n_q: int, buckets: tuple, rargs=None):
         """Visit #2 body, pipeline-thread-only: re-drain staging,
         verify the interval the slots were resolved against is still
         live (swap() installs a fresh table object), and dispatch the
         gather while the state buffers are guaranteed undonated.
-        Returns (device output, live set_shift)."""
-        if aggregator.table is not table:
+        Returns ((instant packed | None, range packed | None), live
+        set_shift). With range work the ring joins the SAME dispatch
+        (merge.query_combined — one launch for the mixed batch), under
+        the writer's dispatch lock with a seq re-check so a flush that
+        landed since planning forces a replan instead of silently
+        reading re-purposed columns."""
+        if packed_inputs is not None and aggregator.table is not table:
             raise _IntervalRolled()
         state, _table, set_shift = aggregator.query_snapshot()
-        flat = aggregator.query_flat_state(state)
-        return self._launch(flat, packed_inputs, n_q, buckets), \
-            int(set_shift)
+        flat = (aggregator.query_flat_state(state)
+                if packed_inputs is not None else None)
+        if rargs is None:
+            return (self._launch(flat, packed_inputs, n_q, buckets),
+                    None), int(set_shift)
+        hflat, hn_q, hsteps, hbuckets, hseq = rargs
+        ring = self._history.acquire_read()
+        try:
+            if self._history.seq != hseq:
+                raise _IntervalRolled()
+            out = self._launch_combined(flat, packed_inputs, ring, hflat,
+                                        n_q, buckets, hn_q, hsteps,
+                                        hbuckets)
+        finally:
+            self._history.release_read()
+        return out, int(set_shift)
+
+    def _launch_combined(self, flat, packed_inputs, ring, hflat,
+                         n_q, buckets, hn_q, hsteps, hbuckets):
+        """Range / mixed dispatch — still ONE device launch (vtlint
+        jax-hot-path + timer-sync covered, same discipline as
+        _launch)."""
+        from veneur_tpu.history import merge as hmerge
+        hspec = self._history.spec
+        t0 = time.perf_counter_ns()
+        if packed_inputs is None:
+            out = (None, hmerge.range_in_packed(
+                ring, hflat, hspec=hspec, n_q=hn_q, n_steps=hsteps,
+                buckets=hbuckets))
+        else:
+            out = hmerge.query_combined(
+                flat, packed_inputs, ring, hflat, spec=self.spec,
+                n_q=n_q, buckets=buckets, hspec=hspec, hn_q=hn_q,
+                hsteps=hsteps, hbuckets=hbuckets)
+        self.dispatch_ns += time.perf_counter_ns() - t0
+        self.launches_total += 1
+        return out
 
     # -- batch execution -----------------------------------------------------
     def _execute(self, batch: List[_Item], total: int) -> None:
         t0 = time.perf_counter_ns()
-        plans = res = None
+        plans = res = rinfo = rres = None
         qcol: dict = {}
+        rqcol: dict = {}
         set_shift = 0
         for _attempt in range(2):
             try:
-                plans, res, qcol, set_shift = self._plan_and_evaluate(batch)
+                (plans, res, qcol, set_shift,
+                 rinfo, rres, rqcol) = self._plan_and_evaluate(batch)
                 break
             except _IntervalRolled:
                 # swap() landed between the two pipeline visits: the
@@ -351,24 +417,31 @@ class QueryEngine:
             # snapshots, resolves and dispatches with no gap to roll
             # into. Costs index/resolution time on the pipeline thread,
             # so it is the escalation path, never the default.
-            plans, res, qcol, set_shift = self._evaluate_atomic(batch)
+            (plans, res, qcol, set_shift,
+             rinfo, rres, rqcol) = self._evaluate_atomic(batch)
         dur = time.perf_counter_ns() - t0
         # stale-bounded availability during a live reshard: the serving
         # table answers before all moved rows folded, so rows in flight
         # may be missing for at most one flush interval. The answer is
         # still served (availability wins); it is MARKED so consumers
-        # and the chaos drill can pin the guarantee.
+        # and the chaos drill can pin the guarantee. Range answers
+        # inherit the mark only for their NEWEST window — history
+        # columns older than the move are immutable.
         stale = bool(getattr(self._server, "reshard_active", False))
         if stale and self._c_stale_reads is not None:
             self._c_stale_reads.inc(len(batch))
         for item, per_q in plans:
             results = []
-            for rows, truncated, q in per_q:
-                matches = [self._render(tname, r, meta, q, res, qcol)
-                           for tname, r, meta in rows]
-                entry = {"matches": matches}
-                if truncated:
-                    entry["truncated"] = True
+            for qi, (rows, truncated, q) in enumerate(per_q):
+                if q["range"] is not None:
+                    entry = self._render_range_entry(item, qi, q, rinfo,
+                                                     rres, rqcol)
+                else:
+                    matches = [self._render(tname, r, meta, q, res, qcol)
+                               for tname, r, meta in rows]
+                    entry = {"matches": matches}
+                    if truncated:
+                        entry["truncated"] = True
                 results.append(entry)
             item.result = {"results": results, "batched": total,
                            "set_shift": set_shift}
@@ -389,6 +462,11 @@ class QueryEngine:
         for item in batch:
             per_q = []
             for q in item.queries:
+                if q["range"] is not None:
+                    # range queries resolve against the HISTORY writer's
+                    # key index (_plan_ranges), not the live interval
+                    per_q.append(([], False, q))
+                    continue
                 ms = self._resolve(index, q)
                 truncated = len(ms) > _MAX_MATCHES
                 if truncated:
@@ -441,16 +519,32 @@ class QueryEngine:
         return res
 
     def _plan_and_evaluate(self, batch: List[_Item]):
-        """Two-visit default: snapshot + off-thread resolution, then a
-        pipeline-dispatched launch (if anything matched)."""
+        """Two-visit default: snapshot + off-thread resolution (both the
+        live-interval index and the history writer's key index), then a
+        pipeline-dispatched launch (if anything matched). A mixed
+        instant+range batch still costs ONE launch (query_combined)."""
         snap = self._snapshot()
         index = self._index_for(snap)
         plans, need, union_qs = self._plan(index, batch)
-        if not any(need[t] for t in COUNT_TABLES):
-            return plans, None, {}, snap.set_shift
-        inputs, n_q, buckets, qcol = self._build_inputs(need, union_qs)
+        rinfo = self._plan_ranges(batch)
+        has_instant = any(need[t] for t in COUNT_TABLES)
+        has_range = rinfo is not None and not rinfo["empty"]
+        if not has_instant and not has_range:
+            return plans, None, {}, snap.set_shift, rinfo, None, {}
+        inputs = n_q = buckets = None
+        qcol: dict = {}
+        rargs = None
+        rqcol: dict = {}
+        hn_q = hsteps = hbuckets = None
+        if has_instant:
+            inputs, n_q, buckets, qcol = self._build_inputs(
+                need, union_qs)
+        if has_range:
+            (hflat, hn_q, hsteps, hbuckets,
+             rqcol) = self._build_range_inputs(rinfo)
+            rargs = (hflat, hn_q, hsteps, hbuckets, rinfo["seq"])
         call = PipelineCall(lambda agg: self._launch_on_pipeline(
-            agg, snap.table, inputs, n_q, buckets))
+            agg, snap.table, inputs, n_q, buckets, rargs))
         self._pipeline_put(call)
         if not call.wait(_SNAPSHOT_TIMEOUT_S):
             raise RuntimeError("query launch timed out")
@@ -458,15 +552,24 @@ class QueryEngine:
             if isinstance(call.exc, _IntervalRolled):
                 raise call.exc
             raise RuntimeError(call.detail or "query launch failed")
-        packed, set_shift = call.result
-        res = self._materialize(packed, n_q, buckets, set_shift)
-        return plans, res, qcol, set_shift
+        (packed, rpacked), set_shift = call.result
+        res = (self._materialize(packed, n_q, buckets, set_shift)
+               if packed is not None else None)
+        rres = (self._materialize_range(rpacked, hn_q, hsteps, hbuckets,
+                                        count_batch=packed is None)
+                if rpacked is not None else None)
+        return plans, res, qcol, set_shift, rinfo, rres, rqcol
 
     def _evaluate_atomic(self, batch: List[_Item]):
         """Escalation path: snapshot, resolution, and launch dispatch
         in ONE pipeline visit — immune to interval rolls because swap()
-        runs on the same thread and cannot interleave."""
+        runs on the same thread and cannot interleave. Range planning
+        happens UNDER the writer's dispatch lock here, so the ring seq
+        cannot advance between plan and dispatch either."""
         from veneur_tpu.query.snapshot import _META_KIND, QuerySnapshot
+        want_range = (self._history is not None
+                      and any(q["range"] is not None
+                              for it in batch for q in it.queries))
 
         def fn(agg):
             state, table, set_shift = agg.query_snapshot()
@@ -477,13 +580,40 @@ class QueryEngine:
                                  set_shift=int(set_shift))
             index = self._index_for(snap)
             plans, need, union_qs = self._plan(index, batch)
-            if not any(need[t] for t in COUNT_TABLES):
-                return plans, None, None, snap.set_shift
-            inputs, n_q, buckets, qcol = self._build_inputs(
-                need, union_qs)
-            flat = agg.query_flat_state(state)
-            packed = self._launch(flat, inputs, n_q, buckets)
-            return plans, packed, (n_q, buckets, qcol), snap.set_shift
+            has_instant = any(need[t] for t in COUNT_TABLES)
+            ring = None
+            if want_range:
+                ring = self._history.acquire_read()
+            try:
+                rinfo = self._plan_ranges(batch)
+                has_range = rinfo is not None and not rinfo["empty"]
+                if not has_instant and not has_range:
+                    return (plans, (None, None), None,
+                            snap.set_shift, rinfo, {})
+                inputs = n_q = buckets = None
+                qcol: dict = {}
+                rqcol: dict = {}
+                hn_q = hsteps = hbuckets = None
+                if has_instant:
+                    inputs, n_q, buckets, qcol = self._build_inputs(
+                        need, union_qs)
+                flat = (agg.query_flat_state(state)
+                        if has_instant else None)
+                if has_range:
+                    (hflat, hn_q, hsteps, hbuckets,
+                     rqcol) = self._build_range_inputs(rinfo)
+                    out = self._launch_combined(
+                        flat, inputs, ring, hflat, n_q, buckets,
+                        hn_q, hsteps, hbuckets)
+                else:
+                    out = (self._launch(flat, inputs, n_q, buckets),
+                           None)
+                return (plans, out, (n_q, buckets, qcol,
+                                     hn_q, hsteps, hbuckets),
+                        snap.set_shift, rinfo, rqcol)
+            finally:
+                if ring is not None:
+                    self._history.release_read()
 
         call = PipelineCall(fn)
         self._pipeline_put(call)
@@ -491,18 +621,156 @@ class QueryEngine:
             raise RuntimeError("query launch timed out")
         if not call.ok:
             raise RuntimeError(call.detail or "query launch failed")
-        plans, packed, shape, set_shift = call.result
-        if packed is None:
-            return plans, None, {}, set_shift
-        n_q, buckets, qcol = shape
-        res = self._materialize(packed, n_q, buckets, set_shift)
-        return plans, res, qcol, set_shift
+        plans, out, shape, set_shift, rinfo, rqcol = call.result
+        packed, rpacked = out
+        if packed is None and rpacked is None:
+            return plans, None, {}, set_shift, rinfo, None, rqcol
+        n_q, buckets, qcol, hn_q, hsteps, hbuckets = shape
+        res = (self._materialize(packed, n_q, buckets, set_shift)
+               if packed is not None else None)
+        rres = (self._materialize_range(rpacked, hn_q, hsteps, hbuckets,
+                                        count_batch=packed is None)
+                if rpacked is not None else None)
+        return plans, res, qcol, set_shift, rinfo, rres, rqcol
 
     def _pipeline_put(self, item) -> None:
         try:
             self._server.packet_queue.put(item, timeout=1.0)
         except queue_mod.Full:
             raise RuntimeError("pipeline backlogged; query not scheduled")
+
+    # -- range planning (history tier) ---------------------------------------
+    def _resolve_range(self, keys, q: dict) -> List[tuple]:
+        """Match one range query against the writer's key index snapshot
+        ([(kind_idx, (kind, name, joined_tags), row)]). Same name/
+        prefix/match + kinds + tags semantics as the instant resolver,
+        over the RING's population (which outlives interval tables)."""
+        mode, arg = q["mode"], q["arg"]
+        tags_j = ",".join(q["tags"]) if q["tags"] is not None else None
+        kinds = q["kinds"]
+        out = []
+        for k, key, row in keys:
+            kind, name, jt = key
+            if kinds is not None and kind not in kinds:
+                continue
+            if tags_j is not None and jt != tags_j:
+                continue
+            if mode == "name":
+                ok = name == arg
+            elif mode == "prefix":
+                ok = name.startswith(arg)
+            else:
+                ok = fnmatchcase(name, arg)
+            if ok:
+                out.append((k, row, kind, name, jt))
+        out.sort(key=lambda e: (e[0], e[3], e[4], e[1]))
+        return out
+
+    def _plan_ranges(self, batch: List[_Item]):
+        """Resolve + plan every range query in the batch: one shared
+        ring-row gather per kind, one concatenated step-selection mask
+        (each query's steps occupy a contiguous slice), capped at
+        merge.MAX_STEPS total. Returns None when the batch has no range
+        queries or the tier is off."""
+        from veneur_tpu.history import merge as hmerge
+        if self._history is None:
+            return None
+        rqs = [(item, qi, q) for item in batch
+               for qi, q in enumerate(item.queries)
+               if q["range"] is not None]
+        if not rqs:
+            return None
+        hist = self._history
+        keys = hist.iter_keys()
+        need: List[List[int]] = [[] for _ in range(5)]
+        rowof: Dict[Tuple[int, int], int] = {}
+        union_qs: set = set()
+        specs: dict = {}
+        sel_rows: list = []
+        all_steps: list = []
+        per_q: dict = {}
+        rank = np.zeros(hist.spec.total_cols, np.float32)
+        planned_seq = hist.seq
+        for item, qi, q in rqs:
+            matches = self._resolve_range(keys, q)
+            truncated = len(matches) > _MAX_MATCHES
+            if truncated:
+                matches = matches[:_MAX_MATCHES]
+            rows = []
+            histo_hit = False
+            for k, row, kind, name, jt in matches:
+                key = (k, row)
+                r = rowof.get(key)
+                if r is None:
+                    r = len(need[k])
+                    rowof[key] = r
+                    need[k].append(row)
+                rows.append((k, r, kind, name, jt))
+                histo_hit = histo_hit or k == 4
+            if histo_hit:
+                union_qs.update(q["quantiles"] or _DEFAULT_QS)
+            skey = (q["range"], q["window"], q["step"])
+            ent = specs.get(skey)
+            if ent is None:
+                room = hmerge.MAX_STEPS - len(all_steps)
+                if room <= 0:
+                    # step budget spent by earlier specs in the batch:
+                    # this query renders empty + truncated rather than
+                    # growing the launch past its compiled step cap
+                    ent = (0, [], True)
+                else:
+                    plan = hist.plan_range(skey[0], skey[1], skey[2],
+                                           room)
+                    ent = (len(all_steps), plan.steps, False)
+                    all_steps.extend(plan.steps)
+                    sel_rows.append(plan.sel)
+                    rank = plan.rank
+                specs[skey] = ent
+            per_q[(id(item), qi)] = (rows, truncated or ent[2],
+                                     ent[0], ent[1])
+        sel = (np.concatenate(sel_rows, axis=0) if sel_rows
+               else np.zeros((1, hist.spec.total_cols), np.float32))
+        return {"per_q": per_q, "need": need, "union_qs": union_qs,
+                "sel": sel, "rank": rank, "seq": planned_seq,
+                "empty": not any(need)}
+
+    def _build_range_inputs(self, rinfo):
+        from veneur_tpu.history import merge as hmerge
+        return hmerge.pack_range_inputs(
+            self._history.spec, rinfo["need"], rinfo["sel"],
+            rinfo["rank"], rinfo["union_qs"])
+
+    def _materialize_range(self, rpacked, hn_q, hsteps, hbuckets,
+                           count_batch: bool = False):
+        """ENGINE-thread finish for the range half: sampled sync, host
+        transfer, unpack, f64 residual folds. Set estimates come back
+        UNSCALED: history windows were written from their own
+        intervals' raw registers, and a degrade-ladder sampling shift
+        is not retroactive (documented in README §History)."""
+        from veneur_tpu.aggregation.step import unpack_flush
+        from veneur_tpu.history import merge as hmerge
+        self._sync.tick(rpacked)
+        out = unpack_flush(
+            np.asarray(rpacked),
+            hmerge.range_shapes(self._history.spec, hbuckets, hsteps,
+                                hn_q))
+        if count_batch and self._c_batched is not None:
+            self._c_batched.inc()
+        f64 = np.float64
+        return {
+            "counter": (out["r_counter_hi"].astype(f64)
+                        + out["r_counter_lo"].astype(f64)),
+            "gauge": out["r_gauge"],
+            "status": out["r_status"],
+            "set_estimate": out["r_set_estimate"],
+            "histo_quantiles": out["r_histo_quantiles"],
+            "histo_min": out["r_histo_min"],
+            "histo_max": out["r_histo_max"],
+            "histo_count": (out["r_histo_count_hi"].astype(f64)
+                            + out["r_histo_count_lo"].astype(f64)),
+            "histo_sum": (out["r_histo_sum_hi"].astype(f64)
+                          + out["r_histo_sum_lo"].astype(f64)),
+        }
 
     # -- response assembly ---------------------------------------------------
     @staticmethod
@@ -534,4 +802,67 @@ class QueryEngine:
             out["sum"] = self._f(res["histo_sum"][r])
             out["avg"] = self._f(res["histo_avg"][r])
             out["hmean"] = self._f(res["histo_hmean"][r])
+        return out
+
+    def _render_range_entry(self, item, qi: int, q: dict, rinfo, rres,
+                            rqcol) -> dict:
+        if rinfo is None:
+            return {"matches": [], "range": True}
+        rows, truncated, soff, steps = rinfo["per_q"][(id(item), qi)]
+        matches = [self._render_range(k, r, kind, name, jt, q, rres,
+                                      rqcol, steps, soff)
+                   for k, r, kind, name, jt in rows]
+        entry = {"matches": matches, "range": True,
+                 "interval_s": self._history.interval_s}
+        if truncated:
+            entry["truncated"] = True
+        return entry
+
+    def _render_range(self, k: int, r: int, kind: str, name: str,
+                      jt: str, q: dict, rres, rqcol, steps,
+                      soff: int) -> dict:
+        """One range match -> its point series, OLDEST first. Counters
+        add per-point rate (value over the step's wall span); scalar
+        kinds add delta vs the previous rendered point — the
+        'rates, deltas, sliding-window p99s' surface of the tier."""
+        out = {"name": name, "kind": kind,
+               "tags": jt.split(",") if jt else []}
+        iv = self._history.interval_s
+        pts = []
+        for j, stp in enumerate(steps):
+            s = soff + j
+            p = {"ts": stp.ts_hi, "ts_start": stp.ts_lo,
+                 "seq": [stp.seq_lo, stp.seq_hi],
+                 "complete": bool(stp.complete)}
+            if k == 0:
+                v = self._f(rres["counter"][r, s])
+                p["value"] = v
+                span = max(stp.seq_hi - stp.seq_lo + 1, 1) * iv
+                p["rate"] = (v / span) if v is not None else None
+            elif k == 1:
+                p["value"] = self._f(rres["gauge"][r, s])
+            elif k == 2:
+                p["value"] = self._f(rres["status"][r, s])
+            elif k == 3:
+                p["estimate"] = self._f(rres["set_estimate"][r, s])
+            else:
+                qs = q["quantiles"] or _DEFAULT_QS
+                p["quantiles"] = {
+                    str(float(v)):
+                    self._f(rres["histo_quantiles"][r, s, rqcol[v]])
+                    for v in qs}
+                p["min"] = self._f(rres["histo_min"][r, s])
+                p["max"] = self._f(rres["histo_max"][r, s])
+                p["count"] = self._f(rres["histo_count"][r, s])
+                p["sum"] = self._f(rres["histo_sum"][r, s])
+            pts.append(p)
+        pts.reverse()   # plan_range steps back from now; serve oldest->newest
+        if k in (0, 1, 2):
+            prev = None
+            for p in pts:
+                v = p.get("value")
+                p["delta"] = (v - prev if v is not None
+                              and prev is not None else None)
+                prev = v
+        out["points"] = pts
         return out

@@ -431,19 +431,26 @@ class Aggregator:
         return state
 
     def compute_flush(self, state, table, percentiles: List[float],
-                      want_raw: bool = False
+                      want_raw: bool = False, history=None
                       ) -> Tuple[Dict[str, np.ndarray], KeyTable]:
         """Flush math on a detached interval (safe off the pipeline thread:
         JAX arrays are immutable and dispatch is thread-safe). Output
         arrays are COMPACT: row i pairs with table.get_meta(kind)[i]
         (flush_live gathers live rows on device, so only O(live) bytes
         cross the host boundary). With want_raw, also returns the live
-        rows' mergeable sketch state (numpy) for forwarding."""
+        rows' mergeable sketch state (numpy) for forwarding.
+
+        With `history` (a history.HistoryWriter), each block runs the
+        FUSED flush+history program instead: the interval's values land
+        in their ring column inside the flush launch itself — same
+        packed outputs, zero extra launches (ISSUE 18 tentpole). The
+        ring is donated through the blocks and committed back to the
+        writer with the interval's window metadata."""
         from veneur_tpu.aggregation.step import (
             FLUSH_BLOCK_ROWS, FLUSH_KEY_KIND, combine_flush_scalars,
-            flush_live_in_packed, flush_live_shapes, live_slots,
-            pack_bucket_chunks, pack_flush_inputs, pad_bucket,
-            unpack_flush)
+            flush_live_hist_packed, flush_live_in_packed,
+            flush_live_shapes, live_slots, pack_bucket_chunks,
+            pack_flush_inputs, pad_bucket, unpack_flush)
 
         # No fold/compact pass here: ingest folds accumulators in-program
         # (step.py ingest_core), and the quantile kernel argsorts cells
@@ -480,13 +487,36 @@ class Aggregator:
         # small-table case: same shapes as the old single-shot path. All
         # blocks are dispatched before any is materialized, so the
         # device pipelines them.
-        packs = [
-            flush_live_in_packed(
-                state, pack_flush_inputs(
-                    perc, pack_bucket_chunks(slots, buckets, i)),
-                spec=spec, n_q=len(perc), buckets=buckets,
-                want_raw=want_raw)
-            for i in range(n_blocks)]
+        if history is not None:
+            from veneur_tpu.history.writer import SENTINEL
+            plan = history.plan_flush(table)
+            hist = history.begin_flush(plan)
+            try:
+                packs = []
+                for i in range(n_blocks):
+                    hflat = np.concatenate(
+                        pack_bucket_chunks(plan.dests, buckets, i,
+                                           fill=SENTINEL)
+                        + [np.asarray([plan.col], np.int32)])
+                    p, hist = flush_live_hist_packed(
+                        state, pack_flush_inputs(
+                            perc, pack_bucket_chunks(slots, buckets, i)),
+                        hist, hflat, spec=spec, hspec=history.spec,
+                        n_q=len(perc), buckets=buckets,
+                        want_raw=want_raw, clear=(i == 0))
+                    packs.append(p)
+            except BaseException:
+                history.abort_flush()
+                raise
+            history.commit_flush(plan, hist)
+        else:
+            packs = [
+                flush_live_in_packed(
+                    state, pack_flush_inputs(
+                        perc, pack_bucket_chunks(slots, buckets, i)),
+                    spec=spec, n_q=len(perc), buckets=buckets,
+                    want_raw=want_raw)
+                for i in range(n_blocks)]
         pieces = [unpack_flush(np.asarray(p), shapes) for p in packs]
         out = {}
         for key, kind_i in ((k, FLUSH_KEY_KIND[k]) for k in pieces[0]):

@@ -445,6 +445,26 @@ class Server:
             "watch-engine-thread time per evaluated interval: selector "
             "resolution + the one fused device evaluation + state "
             "machine steps (off the flush path by construction)")
+        # on-device history tier (veneur_tpu/history/) — registered even
+        # with the tier off so the inventory is stable
+        self._c_history_writes = M.counter(
+            "veneur.history.writes_total",
+            "per-key window values written into the history ring (one "
+            "per live key per flushed interval)")
+        self._c_history_evictions = M.counter(
+            "veneur.history.evictions_total",
+            "ring rows reclaimed from their least-recently-flushed key "
+            "plus window writes turned away with every row in current "
+            "use (the ring is a bounded cache)")
+        self._c_history_range_queries = M.counter(
+            "veneur.history.range_queries_total",
+            "range queries planned against the ring (each POST /query "
+            "item carrying a range counts once)")
+        self._g_history_hbm_bytes = M.gauge(
+            "veneur.history.hbm_bytes",
+            "device-resident bytes of the history ring "
+            "(history.HistorySpec.hbm_bytes for the configured "
+            "geometry; 0 while the tier is off)")
         jaxruntime.install()
         # h2d_bytes high-water at the last flush report, for per-interval
         # byte tags on the flush trace (flush worker thread only)
@@ -617,6 +637,25 @@ class Server:
         self.grpc_port = None
         self._httpd = None
         self.http_port = None
+        # -- on-device history tier (veneur_tpu/history/) ------------------
+        # Off by default: no ring in HBM, flushes run the plain program.
+        # Server-scoped on purpose: the writer's key index outlives
+        # interval tables AND live reshards (windows are addressed by
+        # key identity, not by slot or shard).
+        self.history = None
+        if cfg.history_enabled:
+            from veneur_tpu.history import HistorySpec, HistoryWriter
+            hspec = HistorySpec.for_table(
+                spec_from_config(cfg),
+                windows=cfg.history_windows,
+                tiers=cfg.history_decimation_tiers,
+                max_keys=cfg.history_max_keys)
+            self.history = HistoryWriter(
+                hspec, interval_s=self.interval,
+                c_writes=self._c_history_writes,
+                c_evictions=self._c_history_evictions,
+                c_range=self._c_history_range_queries,
+                g_hbm=self._g_history_hbm_bytes)
         # -- on-device query tier (veneur_tpu/query/) ---------------------
         # Off by default: no batcher thread, POST /query answers 404.
         self.query_engine = None
@@ -628,7 +667,8 @@ class Server:
                 requests=self._c_query_requests,
                 batched=self._c_query_batched,
                 duration=self._t_query,
-                stale_reads=self._c_reshard_stale)
+                stale_reads=self._c_reshard_stale,
+                history=self.history)
         # -- streaming watch tier (veneur_tpu/watch/) ---------------------
         # Off by default: no engine thread, /watch endpoints answer 404.
         self.watch_engine = None
@@ -644,7 +684,8 @@ class Server:
                 suppressed=self._c_watch_suppressed,
                 dropped=self._c_watch_notify_dropped,
                 eval_ns=self._c_watch_eval_ns,
-                active=self._g_watch_active)
+                active=self._g_watch_active,
+                history=self.history)
         # last: every attribute a collector closes over now exists
         self._register_collectors()
 
@@ -2119,7 +2160,8 @@ class Server:
                 hostname=self.hostname, spill=spill_bytes,
                 spill_entries=spill_n,
                 forward_meta=self._forward_meta_snapshot(),
-                watches=self._watch_snapshot())
+                watches=self._watch_snapshot(),
+                history=self._history_snapshot())
             self._ckpt_writer.submit(snap)
         except Exception:
             log.exception("checkpoint snapshot build failed; interval "
@@ -2134,6 +2176,14 @@ class Server:
         if self.watch_engine is None:
             return None
         return self.watch_engine.snapshot()
+
+    def _history_snapshot(self) -> Optional[dict]:
+        """History ring (device arrays + host key index) for the
+        checkpoint's sidecar chunks. None (chunks omitted) when the
+        tier is off or the ring has not armed yet."""
+        if self.history is None or not self.history.armed:
+            return None
+        return self.history.snapshot()
 
     def _forward_meta_snapshot(self) -> Optional[dict]:
         """Exactly-once forwarding state for the checkpoint: the sender
@@ -2211,6 +2261,11 @@ class Server:
                 # registrations + firing state: monitors keep their
                 # debounce streaks and ALERT holds across the restart
                 self.watch_engine.restore(snap["watches"])
+            if snap.get("history") and self.history is not None:
+                # windowed lookback survives the restart byte-exact;
+                # a spec mismatch keeps the fresh ring (history is a
+                # cache of flushed intervals, never source of truth)
+                self.history.restore(snap["history"])
             self._c_ckpt_restores.inc()
             log.info("restored %d metrics from %s (interval_ts=%d)",
                      n, path, snap["interval_ts"])
@@ -2286,10 +2341,11 @@ class Server:
         if (self._forward_client is not None or ckpt_due
                 or self.cfg.collective_attach):
             flush_arrays, table, raw = self.aggregator.compute_flush(
-                state, table, self.cfg.percentiles, want_raw=True)
+                state, table, self.cfg.percentiles, want_raw=True,
+                history=self.history)
         else:
             flush_arrays, table = self.aggregator.compute_flush(
-                state, table, self.cfg.percentiles)
+                state, table, self.cfg.percentiles, history=self.history)
         self._t_flush_phase.observe(time.perf_counter_ns() - dev_t0,
                                     phase="device_update")
         if trace:
@@ -2310,8 +2366,14 @@ class Server:
             if watch_shed:
                 self.watch_engine.skip_interval("overload CRITICAL")
             else:
+                # pin THIS interval's ring window seq now — a later
+                # flush advances the ring before the engine thread runs
+                hist_seq = (self.history.seq - 1
+                            if self.history is not None
+                            and self.history.armed else None)
                 self.watch_engine.offer(
-                    state, table, int(stats.get("set_shift", 0)), ts)
+                    state, table, int(stats.get("set_shift", 0)), ts,
+                    hist_seq)
         # exactly-once forwarding: export + stage this interval's unit
         # under a fresh (epoch, seq) BEFORE the checkpoint build, so the
         # snapshot's spill chunk carries the payload with its envelope
@@ -3254,7 +3316,8 @@ class Server:
                     from veneur_tpu.persistence import build_snapshot
                     state, table = self.aggregator.swap()
                     flush_arrays, table, raw = self.aggregator.compute_flush(
-                        state, table, self.cfg.percentiles, want_raw=True)
+                        state, table, self.cfg.percentiles, want_raw=True,
+                        history=self.history)
                     # stage the tail's forward payload BEFORE serializing
                     # the spill: the tail snapshot then carries the unit
                     # with its envelope, the restart replays it once, and
@@ -3277,7 +3340,8 @@ class Server:
                         hostname=self.hostname, spill=spill_bytes,
                         spill_entries=spill_n,
                         forward_meta=self._forward_meta_snapshot(),
-                        watches=self._watch_snapshot()))
+                        watches=self._watch_snapshot(),
+                        history=self._history_snapshot()))
                 except Exception:
                     log.exception("final checkpoint failed; last periodic "
                                   "checkpoint remains newest")

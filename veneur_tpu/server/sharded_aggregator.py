@@ -380,7 +380,7 @@ class ShardedAggregator(Aggregator):
                             state)
 
     def compute_flush(self, state, table, percentiles,
-                      want_raw: bool = False):
+                      want_raw: bool = False, history=None):
         import jax.numpy as jnp
 
         from veneur_tpu.aggregation.step import (
@@ -407,7 +407,7 @@ class ShardedAggregator(Aggregator):
             len(idx["status"]), len(idx["set"]), len(idx["histogram"]),
             len(qs)))
         result = combine_flush_scalars(out)
-        if want_raw:
+        if want_raw or history is not None:
             from veneur_tpu.aggregation.step import unpack_flush as _unpack
             r = _unpack(np.asarray(_gather_sharded_raw(
                 state, idx["set"], idx["histogram"])),
@@ -423,5 +423,13 @@ class ShardedAggregator(Aggregator):
                 "h_max": r["h_max"],
                 "h_recip": r["recip_hi"].astype(np.float64) + r["recip_lo"],
             }
-            return result, table, raw
+            if history is not None:
+                # Host-fed ring write: the sharded flush already
+                # materializes result+raw, so the same frame the
+                # forwarder/archive sees feeds the standalone
+                # write_window jit — byte-identical window bytes to the
+                # single-device fused path by construction.
+                history.record_frame(table, result, raw)
+            if want_raw:
+                return result, table, raw
         return result, table

@@ -71,9 +71,10 @@ class WatchEngine:
                  max_subscribers: int = 64, webhook_url: str = "",
                  retry_policy=None, evaluated=None, fired=None,
                  suppressed=None, dropped=None, eval_ns=None,
-                 active=None) -> None:
+                 active=None, history=None) -> None:
         self._server = server
         self.spec = server.aggregator.spec
+        self._history = history          # HistoryWriter | None
         self.max_active = max(1, int(max_active))
         self._c_evaluated = evaluated
         self._c_fired = fired
@@ -161,16 +162,21 @@ class WatchEngine:
             self._g_active.set(float(by_kind.get(k, 0)), kind=k)
 
     # -- flush-worker hooks (non-blocking by contract) ------------------------
-    def offer(self, state, table, set_shift: int, ts: int) -> None:
+    def offer(self, state, table, set_shift: int, ts: int,
+              hist_seq: Optional[int] = None) -> None:
         """Hand one DETACHED interval to the engine thread. Called by
         server._do_flush after compute_flush (which does not donate, so
-        the state reference stays valid for this thread's launch)."""
+        the state reference stays valid for this thread's launch).
+        `hist_seq` is the history-ring window seq this interval landed
+        in (the flush wrote it before offering), pinned HERE because a
+        later flush may advance the ring before the engine thread
+        evaluates; None when the history tier is off."""
         if self._stop.is_set():
             return
         with self._lock:
             if not self._watches:
                 return
-        job = (state, table, int(set_shift), int(ts))
+        job = (state, table, int(set_shift), int(ts), hist_seq)
         try:
             self._jobs.put_nowait(job)
         except queue_mod.Full:  # vtlint: disable=accounting-flow -- the unaccounted branch is a raced-empty queue followed by a successful re-put: nothing was lost on it
@@ -220,9 +226,10 @@ class WatchEngine:
                 if self._stop.is_set():
                     return
                 continue
-            state, table, set_shift, ts = job
+            state, table, set_shift, ts, hist_seq = job
             try:
-                self._evaluate_interval(state, table, set_shift, ts)
+                self._evaluate_interval(state, table, set_shift, ts,
+                                        hist_seq)
             except Exception:  # noqa: BLE001 — the engine must survive
                 log.exception("watch evaluation failed; interval counted "
                               "as skipped")
@@ -303,8 +310,54 @@ class WatchEngine:
                 vals.append(v)
         return w.reduce(vals)
 
+    def _delta_baselines(self, watches,
+                         hist_seq: Optional[int]) -> Optional[dict]:
+        """Previous-interval baselines for delta watches, read from the
+        HISTORY RING in one batched device gather: {wid: value | None}.
+        None (no dict) when the tier is off / unarmed / there is no
+        previous window — callers then fall back to the watch's own
+        retained last_value (the pre-history behavior)."""
+        if (self._history is None or hist_seq is None or hist_seq < 1
+                or not self._history.armed):
+            return None
+        deltas = [w for w in watches if w.kind == "delta"]
+        if not deltas:
+            return None
+        from fnmatch import fnmatchcase
+        keys = self._history.iter_keys()
+        items: List[tuple] = []
+        slots: Dict[int, List[int]] = {}
+        for w in deltas:
+            allowed = w.metric_kinds or ("counter", "gauge", "status")
+            tags_j = ",".join(w.tags) if w.tags is not None else None
+            matched = []
+            for k, key, row in keys:
+                kind, name, jt = key
+                if k > 2 or kind not in allowed:
+                    continue
+                if tags_j is not None and jt != tags_j:
+                    continue
+                if w.mode == "name":
+                    ok = name == w.arg
+                elif w.mode == "prefix":
+                    ok = name.startswith(w.arg)
+                else:
+                    ok = fnmatchcase(name, w.arg)
+                if ok:
+                    matched.append(len(items))
+                    items.append((k, row))
+            slots[w.wid] = matched
+        out: Dict[int, Optional[float]] = {}
+        vals = self._history.read_values(hist_seq - 1, items)
+        for w in deltas:
+            vs = [float(vals[i]) for i in slots[w.wid]
+                  if math.isfinite(vals[i])]
+            out[w.wid] = w.reduce(vs)
+        return out
+
     def _evaluate_interval(self, state, table, set_shift: int,
-                           ts: int) -> None:
+                           ts: int, hist_seq: Optional[int] = None
+                           ) -> None:
         t0 = time.perf_counter_ns()
         with self._lock:
             watches = sorted(self._watches.values(), key=lambda w: w.wid)
@@ -315,6 +368,9 @@ class WatchEngine:
         if plan is not None:
             packed = self._launch(state, plan)
             res = self._materialize(packed, plan, set_shift)
+        # delta lookback: the ring window written by the PREVIOUS flush
+        # is the baseline of record when the history tier is on
+        baselines = self._delta_baselines(watches, hist_seq)
         stale = bool(getattr(self._server, "reshard_active", False))
         events: List[dict] = []
         n_eval: Dict[str, int] = {}
@@ -325,7 +381,11 @@ class WatchEngine:
                 if self._watches.get(w.wid) is not w:
                     continue   # deleted (or replaced) mid-interval
                 value = self._value_for(w, plan, res)
-                transition, suppressed = w.observe(value, ts)
+                if w.kind == "delta" and baselines is not None:
+                    transition, suppressed = w.observe(
+                        value, ts, prev_override=baselines.get(w.wid))
+                else:
+                    transition, suppressed = w.observe(value, ts)
                 n_eval[w.kind] = n_eval.get(w.kind, 0) + 1
                 if suppressed:
                     n_supp[w.kind] = n_supp.get(w.kind, 0) + 1

@@ -177,6 +177,11 @@ def _recovered(op: str, value: float, threshold: float,
     return value >= threshold + hysteresis
 
 
+# sentinel distinguishing "no override passed" from an explicit None
+# baseline (ring window absent) in Watch.observe
+_UNSET = object()
+
+
 class Watch:
     """One registration + its alert state. Mutated only on the watch
     engine thread (register/delete/restore swap whole dicts under the
@@ -220,7 +225,7 @@ class Watch:
             return None
         return max(values) if self.op in (">", ">=") else min(values)
 
-    def observe(self, raw: Optional[float], ts: int
+    def observe(self, raw: Optional[float], ts: int, prev_override=_UNSET
                 ) -> Tuple[Optional[Tuple[str, str]], bool]:
         """Advance one evaluated interval. Returns `(transition,
         suppressed)`: transition is `(old_status, new_status)` or None;
@@ -228,7 +233,15 @@ class Watch:
         a transition (debounce pending, or already ALERT inside the
         hysteresis hold). Exactly one of fired (a transition into
         ALERT) / suppressed is possible per breaching interval, which
-        is the accounting invariant the storm tests pin."""
+        is the accounting invariant the storm tests pin.
+
+        `prev_override` (delta watches): the previous interval's value
+        as read back from the HISTORY RING (engine._delta_baselines) —
+        the ring, not privately retained Python state, is the baseline
+        of record when the history tier is on. None means the ring has
+        no resident previous window (gap semantics, same as a lost
+        baseline). Without the override the pre-history behavior is
+        unchanged."""
         ts = int(ts)
         if raw is not None:
             # canonicalize to float so the persisted state (the delta
@@ -252,7 +265,14 @@ class Watch:
             return None, False
         self.empty_streak = 0
         if self.kind == "delta":
-            prev, self.last_value = self.last_value, raw
+            if prev_override is _UNSET:
+                prev, self.last_value = self.last_value, raw
+            else:
+                # ring-sourced baseline; keep last_value maintained so
+                # the persisted state (and any history-off fallback
+                # interval) stays coherent
+                prev = prev_override
+                self.last_value = raw
             if prev is None:
                 # first datapoint primes the baseline; nothing to compare
                 self.value = None
