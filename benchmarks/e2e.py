@@ -2323,13 +2323,358 @@ def config14_range_dashboard(scale=1.0):
     }
 
 
+# -- config 15: multi-tenant storm — fairness, quarantine, restart -----------
+
+def config15_tenant_storm(scale=1.0):
+    """Seeded production-replay tenant storm (README §Multi-tenancy).
+    Two same-seed passes of identical traffic (steady + diurnal ramp +
+    one tenant flash-crowding to ~5x its share), baseline vs fairness
+    armed, then a tag explosion, a rolling restart mid-storm, and
+    quarantine decay. Gates, all booleans: the byte streams are
+    identical (seeded-reproducible); per-tenant sent == admitted + shed
+    EXACTLY in both passes, folded across all rings, and across the
+    restart; isolated tenants shed nothing in either pass and their
+    p99 value error is unchanged vs baseline while the noisy tenant is
+    throttled; /healthz stays 200 and /readyz flips/recovers on
+    interval during the flash crowd; the runaway tenant demotes, K
+    post-demotion rows count EXACTLY K, quarantine state survives the
+    restart, and decay re-admits it."""
+    import shutil
+    import tempfile
+    import urllib.error
+    import urllib.request
+
+    from benchmarks.replay import ReplayGenerator
+    from veneur_tpu.reliability.overload import HEALTHY, SHEDDING
+    from veneur_tpu.sinks.debug import DebugMetricSink
+
+    NOISY = "acme"            # DEFAULT_TENANTS[0]: the flash-crowd tenant
+    RUNAWAY = "crux"          # the tag-explosion tenant
+    ISOLATED = ("blue", "dex", "default")
+    seed = 150_150
+    steady_n = max(2_000, int(10_000 * scale))
+    diurnal_n = max(1_000, int(4_000 * scale))
+    flash_n = max(4_000, int(20_000 * scale))
+    post_n = max(1_000, int(3_000 * scale))
+    interval_s = 2.0
+    # above any legitimate tenant's steady key count (<= 512 names x 4
+    # kinds) so only the explosion can demote
+    q_max_keys = 3_500
+    explode_n = q_max_keys + 1_500
+    exact_k = 250
+
+    cfg = dict(
+        http_address="127.0.0.1:0", num_readers=1, reader_rings=2,
+        tenant_enabled=True,
+        # per-tenant burst = rate x mult = 0.3 x flash_n: the largest
+        # isolated tenant sends ~0.1 x flash_n in the flash segment, so
+        # its burst covers it outright at ANY injection speed, while the
+        # noisy tenant's ~0.77 x flash_n cannot fit even with refill —
+        # isolation is structural, not timing-dependent
+        tenant_fair_rate=flash_n / 10.0, tenant_fair_burst_mult=3.0,
+        tenant_quarantine_max_keys=q_max_keys,
+        tenant_quarantine_decay=0.25,
+        tenant_quarantine_readmit_frac=0.5,
+        overload_enabled=True, overload_native_admission=True,
+        overload_poll_interval_s=0.05, overload_hold_s=0.3,
+        tpu_counter_capacity=1 << 14, tpu_batch_counter=1 << 14,
+        tpu_histo_capacity=1 << 14, tpu_batch_histo=1 << 13,
+        tpu_gauge_capacity=1 << 13, tpu_batch_gauge=1 << 12,
+        tpu_set_capacity=1 << 12, tpu_batch_set=1 << 11)
+
+    def _inject(srv, grams):
+        """Lossless feed through the REAL admission choke point
+        (ring_push), deterministic round-robin placement. Paced so a
+        ring can never overflow post-admission — a ring-full drop after
+        the admitted count would break exactness."""
+        eng = srv.aggregator.eng
+        nr = max(1, eng.n_rings)
+        counters = srv.aggregator.reader_counters
+        for i, g in enumerate(grams):
+            eng.rings_inject(i % nr, g)
+            if (i & 0xFFF) == 0xFFF and counters()["ring_depth"] > 32_000:
+                while counters()["ring_depth"] > 8_000:
+                    time.sleep(0.005)
+
+    def _settle(srv, timeout=DRAIN_TIMEOUT):
+        """Wait until the rings are empty and parse counts stop moving,
+        then give the overload poller a few ticks to fold the per-ring
+        per-tenant deltas into the tenancy ledger."""
+        deadline = time.time() + timeout
+        last = -1
+        while time.time() < deadline:
+            done = srv.aggregator.processed
+            if srv.aggregator.reader_counters()["ring_depth"] == 0 \
+                    and done == last:
+                break
+            last = done
+            time.sleep(0.05)
+        time.sleep(0.35)
+
+    def _totals(ten):
+        return ({t: n for (t,), n in ten.admitted_snapshot()},
+                {t: n for (t,), n in ten.shed_snapshot()})
+
+    def _delta(now, base):
+        return {t: now.get(t, 0) - base.get(t, 0)
+                for t in set(now) | set(base)}
+
+    def _timer_oracle(grams):
+        vals: dict = {}
+        for g in grams:
+            head, _, rest = g.partition(b":")
+            v, _, kind_tags = rest.partition(b"|")
+            if kind_tags.split(b"|", 1)[0] == b"ms":
+                vals.setdefault(head.decode(), []).append(float(v))
+        return vals
+
+    def _p99_errs(sink, oracle):
+        """Worst per-tenant relative p99 error across that tenant's
+        well-sampled timer names."""
+        flushed = {m.name: m.value for m in sink.flushed}
+        errs: dict = {}
+        for name, v in oracle.items():
+            if len(v) < 30:
+                continue
+            got = flushed.get(name + ".99percentile")
+            if got is None:
+                continue
+            exact = midpoint_quantile(np.asarray(v), 0.99)
+            if exact > 0:
+                errs.setdefault(name.split(".")[1], []).append(
+                    abs(got - exact) / exact)
+        return {t: float(np.max(e)) for t, e in errs.items() if e}
+
+    def _accounting_exact(ledger, adm, shd, tenants=None):
+        names = tenants if tenants is not None else ledger.keys()
+        return all(ledger.get(t, 0) == adm.get(t, 0) + shd.get(t, 0)
+                   for t in names)
+
+    # -- pass A: baseline — same traffic, admission held HEALTHY -------------
+    phase("baseline")
+    gen_a = ReplayGenerator(seed)
+    sink_a = DebugMetricSink()
+    srv = _mk_server([sink_a], udp=True, **cfg)
+    try:
+        srv._overload._signals = lambda: {}
+        _warm(srv, [b"replay.warm.m0:1.0|ms"], sinks=[sink_a])
+        grams_a = (gen_a.steady(steady_n) + gen_a.diurnal(diurnal_n)
+                   + gen_a.flash_crowd(flash_n))
+        adm0, shd0 = _totals(srv.tenancy)
+        _inject(srv, grams_a)
+        _settle(srv)
+        _flush_checked(srv, timeout=WARM_TIMEOUT)
+        time.sleep(0.3)
+        adm_a, shd_a = _totals(srv.tenancy)
+        adm_a, shd_a = _delta(adm_a, adm0), _delta(shd_a, shd0)
+        errs_a = _p99_errs(sink_a, _timer_oracle(grams_a))
+    finally:
+        srv.shutdown()
+    checksum_a = gen_a.checksum()
+    ledger_storm = gen_a.ledger()
+
+    # -- pass B: fairness armed — flash crowd under forced SHEDDING ----------
+    phase("noisy")
+    ckpt_root = tempfile.mkdtemp(prefix="veneur-tenant-ckpt-")
+    gen = ReplayGenerator(seed)
+    sink_b = DebugMetricSink()
+    srv = _mk_server([sink_b], udp=True, checkpoint_dir=ckpt_root,
+                     checkpoint_interval_flushes=100_000,
+                     checkpoint_on_shutdown=True, **cfg)
+    restarted = False
+    try:
+        ov = srv._overload
+        ov._signals = lambda: {}
+        port = srv.http_port
+
+        def probe(path):
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+                    return r.status
+            except urllib.error.HTTPError as e:
+                return e.code
+
+        _warm(srv, [b"replay.warm.m0:1.0|ms"], sinks=[sink_b])
+        health_codes, ready_log = [], []
+        poll_stop = threading.Event()
+
+        def poll_http():
+            while not poll_stop.is_set():
+                t = time.monotonic()
+                health_codes.append(probe("/healthz"))
+                ready_log.append((t, probe("/readyz")))
+                poll_stop.wait(0.05)
+
+        poller = threading.Thread(target=poll_http, daemon=True)
+        poller.start()
+
+        adm0, shd0 = _totals(srv.tenancy)
+        grams_b1 = gen.steady(steady_n) + gen.diurnal(diurnal_n)
+        _inject(srv, grams_b1)
+        _settle(srv)
+
+        phase("flash")
+        ov._signals = lambda: {"tenant_storm": 0.90}
+        t_force = time.monotonic()
+        while ov.state < SHEDDING \
+                and time.monotonic() - t_force < 5.0:
+            time.sleep(0.01)
+        flash = gen.flash_crowd(flash_n)
+        # spread the crowd over ~1.5 flush intervals so the readyz
+        # latency gates measure against a sustained storm, not a blip
+        chunk = max(1, len(flash) // 30)
+        t0f = time.monotonic()
+        for i in range(0, len(flash), chunk):
+            _inject(srv, flash[i:i + chunk])
+            target = t0f + 1.5 * interval_s * min(
+                1.0, (i + chunk) / len(flash))
+            now = time.monotonic()
+            if target > now:
+                time.sleep(target - now)
+        _settle(srv)
+        t_load_off = time.monotonic()
+        ov._signals = lambda: {}
+        while ov.state > HEALTHY \
+                and time.monotonic() - t_load_off < 4 * interval_s:
+            time.sleep(0.02)
+        time.sleep(0.25)
+        poll_stop.set()
+        poller.join()
+
+        _flush_checked(srv, timeout=WARM_TIMEOUT)
+        time.sleep(0.3)
+        checksum_b_storm = gen.checksum()   # same point as checksum_a
+        adm_b, shd_b = _totals(srv.tenancy)
+        adm_b, shd_b = _delta(adm_b, adm0), _delta(shd_b, shd0)
+        errs_b = _p99_errs(sink_b, _timer_oracle(grams_b1 + flash))
+
+        # readiness latency vs the controller's own transition stamps
+        t_shed = next((ts for ts, _f, to in ov.transitions
+                       if to >= SHEDDING and ts >= t_force - 1), None)
+        t_flip = next((t for t, c in ready_log if c != 200), None)
+        t_back = next((t for t, c in ready_log
+                       if t > t_load_off and c == 200), None)
+        flip_s = (t_flip - t_shed) if t_shed and t_flip else None
+        recover_s = (t_back - t_load_off) if t_back else None
+
+        # -- quarantine: explosion -> demotion -> exact-K accounting ---------
+        phase("quarantine")
+        _inject(srv, gen.tag_explosion(explode_n, RUNAWAY))
+        _settle(srv)
+        table = srv.aggregator.tenant_table()
+        demoted = bool(table.get(RUNAWAY, {}).get("demoted"))
+        rows0 = dict(srv.tenancy.demoted_rows_snapshot())
+        _inject(srv, gen.tag_explosion(exact_k, RUNAWAY))
+        _settle(srv)
+        rows1 = dict(srv.tenancy.demoted_rows_snapshot())
+        exact_rows_ok = (rows1.get((RUNAWAY,), 0)
+                         - rows0.get((RUNAWAY,), 0)) == exact_k
+        healthy_demotions = sum(n for (t,), n in rows1.items()
+                                if t != RUNAWAY)
+
+        # -- rolling restart mid-storm ---------------------------------------
+        phase("restart")
+        srv.shutdown()   # final fold + shutdown checkpoint (tenants chunk)
+        restarted = True
+        adm_b1, shd_b1 = _totals(srv.tenancy)
+        adm_b1, shd_b1 = _delta(adm_b1, adm0), _delta(shd_b1, shd0)
+        rows_b1 = dict(srv.tenancy.demoted_rows_snapshot()) \
+            .get((RUNAWAY,), 0)
+
+        sink_c = DebugMetricSink()
+        srv = _mk_server([sink_c], udp=True, checkpoint_dir=ckpt_root,
+                         checkpoint_interval_flushes=100_000,
+                         checkpoint_on_shutdown=False,
+                         restore_on_start=True, **cfg)
+        srv._overload._signals = lambda: {}
+        survived = bool(srv.aggregator.tenant_table()
+                        .get(RUNAWAY, {}).get("demoted"))
+        rows_restored = (dict(srv.tenancy.demoted_rows_snapshot())
+                         .get((RUNAWAY,), 0) == rows_b1)
+        adm0c, shd0c = _totals(srv.tenancy)
+        _inject(srv, gen.steady(post_n))
+        _settle(srv)
+
+        # -- decay re-admission (no runaway traffic across flushes) ----------
+        phase("readmit")
+        readmitted = False
+        for _ in range(4):
+            _flush_checked(srv, timeout=WARM_TIMEOUT)
+            time.sleep(0.25)
+            if not srv.aggregator.tenant_table() \
+                    .get(RUNAWAY, {}).get("demoted", True):
+                readmitted = True
+                break
+        srv.shutdown()
+        adm_c, shd_c = _totals(srv.tenancy)
+        adm_c, shd_c = _delta(adm_c, adm0c), _delta(shd_c, shd0c)
+    finally:
+        if not restarted:
+            srv.shutdown()
+        shutil.rmtree(ckpt_root, ignore_errors=True)
+
+    ledger_all = gen.ledger()
+    noisy_sent_b = (adm_b.get(NOISY, 0) + shd_b.get(NOISY, 0))
+    # unchanged = same worst relative p99 error, to 1% absolute slack
+    # (device scatter order is not bit-stable between runs); armed only
+    # when every isolated tenant had a well-sampled timer in BOTH passes
+    # (reduced --scale runs can leave the oracle too sparse)
+    p99_gate_armed = all(t in errs_a and t in errs_b for t in ISOLATED)
+    iso_p99_unchanged = all(
+        abs(errs_a.get(t, 0.0) - errs_b.get(t, 0.0)) <= 0.01
+        for t in ISOLATED if t in errs_a and t in errs_b)
+    return {
+        "config": 15, "name": "tenant_storm",
+        "seed": seed,
+        "datagrams_storm": sum(ledger_storm.values()),
+        "sent": ledger_all,
+        "replay_reproducible": checksum_b_storm == checksum_a,
+        "accounting_exact_baseline": _accounting_exact(
+            ledger_storm, adm_a, shd_a),
+        "accounting_exact_noisy": noisy_sent_b == ledger_storm.get(NOISY, 0),
+        "baseline_all_admitted": sum(shd_a.values()) == 0,
+        "noisy_shed": shd_b.get(NOISY, 0),
+        "noisy_throttled": shd_b.get(NOISY, 0) > 0,
+        "isolated_shed": {t: shd_b.get(t, 0) for t in ISOLATED},
+        "isolated_zero_shed": all(shd_b.get(t, 0) == 0 for t in ISOLATED),
+        "isolated_p99_err_baseline": {t: round(errs_a.get(t, 0.0), 5)
+                                      for t in ISOLATED},
+        "isolated_p99_err_noisy": {t: round(errs_b.get(t, 0.0), 5)
+                                   for t in ISOLATED},
+        "isolated_p99_unchanged": iso_p99_unchanged,
+        "p99_gate_armed": p99_gate_armed,
+        "healthz_all_200": all(c == 200 for c in health_codes),
+        "readyz_flip_seconds": round(flip_s, 3)
+        if flip_s is not None else None,
+        "readyz_flip_within_interval": flip_s is not None
+        and flip_s <= interval_s,
+        "readyz_recover_seconds": round(recover_s, 3)
+        if recover_s is not None else None,
+        "readyz_recover_within_2_intervals": recover_s is not None
+        and recover_s <= 2 * interval_s,
+        "runaway_demoted": demoted,
+        "demoted_rows_exact_k": exact_rows_ok,
+        "healthy_tenant_demotions": healthy_demotions,
+        "quarantine_survived_restart": survived,
+        "demoted_rows_restored": rows_restored,
+        "accounting_exact_across_restart": all(
+            ledger_all.get(t, 0)
+            == adm_b1.get(t, 0) + shd_b1.get(t, 0)
+            + adm_c.get(t, 0) + shd_c.get(t, 0)
+            for t in ledger_all),
+        "readmitted_after_decay": readmitted,
+    }
+
+
 CONFIGS = {1: config1_counter_replay, 2: config2_zipf_timers,
            3: config3_set_cardinality, 4: config4_global_merge,
            5: config5_span_firehose, 6: config6_cardinality_stress,
            7: config7_checkpoint_restore, 8: config8_overload_storm,
            9: config9_duplicate_storm, 10: config10_wire_to_flush_firehose,
            11: config11_collective_merge, 12: config12_elastic_resize,
-           13: config13_watch_storm, 14: config14_range_dashboard}
+           13: config13_watch_storm, 14: config14_range_dashboard,
+           15: config15_tenant_storm}
 
 # Per-config subprocess budget: backend init + first XLA compiles of the
 # config's size buckets (~tens of seconds each on the tunneled chip) +

@@ -1,0 +1,232 @@
+"""Seeded production-replay storm generator (README §Multi-tenancy).
+
+Deterministic multi-tenant traffic for the tenant-storm e2e config
+(benchmarks/e2e.py config15) and the long-haul soak: given a seed, the
+generator emits the EXACT same datagram sequence — per-tenant Zipf name
+mixes, diurnal rate ramps, flash crowds, and one-tenant tag explosions
+— so two runs with the same seed produce identical per-tenant sent
+counts and byte streams (pinned by `checksum()` and the CLI below).
+The harness owns timing, injection, rolling restarts, and concurrent
+query/watch/range storms; this module owns only the reproducible
+traffic plan, which is what makes the acceptance gates same-seed
+comparable (noisy run vs baseline run).
+
+Determinism contract: one numpy PCG64 stream per generator, consumed
+only by the segment methods in call order. Never branch on wall-clock
+or on anything the server returns — the byte stream must be a pure
+function of (seed, call sequence).
+
+CLI (reproducibility check — two invocations must print one line,
+byte-identical):
+
+  python -m benchmarks.replay --seed 7 --segments steady:2000,flash:1000
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import hashlib
+import json
+import sys
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantProfile:
+    """One tenant's steady-state shape: its share of total traffic, its
+    name-space size, and the Zipf skew of its name mix."""
+    name: str
+    share: float          # fraction of steady-state datagrams
+    n_names: int = 256    # distinct metric names in its steady mix
+    zipf_a: float = 1.3   # name-popularity skew (>1; higher = peakier)
+
+
+# the default cast: one big tenant, two mid tenants, a small one, and
+# untagged traffic that must land on the default tenant
+DEFAULT_TENANTS = (
+    TenantProfile("acme", 0.40, n_names=512),
+    TenantProfile("blue", 0.25, n_names=256),
+    TenantProfile("crux", 0.20, n_names=256),
+    TenantProfile("dex", 0.10, n_names=64),
+    TenantProfile("", 0.05, n_names=64),       # untagged -> default
+)
+
+_KINDS = (b"c", b"g", b"ms", b"s")
+# counters dominate like production statsd; sets stay rare (HLL rows)
+_KIND_P = (0.55, 0.20, 0.20, 0.05)
+
+
+class ReplayGenerator:
+    """Seeded datagram-sequence factory. Each segment method returns a
+    list of single-datagram byte strings and adds to the exact
+    per-tenant `sent` ledger (the accounting gates compare this ledger
+    against the engine's admitted + shed fold)."""
+
+    def __init__(self, seed: int,
+                 tenants: Tuple[TenantProfile, ...] = DEFAULT_TENANTS,
+                 tag: str = "tenant:"):
+        self.seed = int(seed)
+        self.rng = np.random.Generator(np.random.PCG64(int(seed)))
+        self.tenants = tuple(tenants)
+        self.tag = tag
+        shares = np.array([t.share for t in tenants], np.float64)
+        self._shares = shares / shares.sum()
+        self.sent: Dict[str, int] = {self._ledger_name(t.name): 0
+                                     for t in tenants}
+        self._explosion_next: Dict[str, int] = {}
+        self._sha = hashlib.sha256()
+
+    # -- internals -----------------------------------------------------------
+    @staticmethod
+    def _ledger_name(name: str) -> str:
+        return name or "default"
+
+    def _suffix(self, tenant: str) -> bytes:
+        if not tenant:
+            return b"|#env:prod"
+        return b"|#" + self.tag.encode() + tenant.encode() + b",env:prod"
+
+    def _value(self, kind: bytes) -> bytes:
+        if kind == b"c":
+            return b"1"
+        if kind == b"g":
+            return b"%d" % self.rng.integers(0, 1000)
+        if kind == b"ms":
+            # log-normal latencies: the p99-error gate needs a heavy
+            # tail per tenant, not a constant
+            return b"%.3f" % float(np.exp(self.rng.normal(3.0, 0.8)))
+        return b"u%d" % self.rng.integers(0, 10_000)
+
+    def _datagram(self, prof: TenantProfile, name_idx: int) -> bytes:
+        kind = _KINDS[self.rng.choice(len(_KINDS), p=_KIND_P)]
+        led = self._ledger_name(prof.name)
+        d = b"replay.%s.m%d:%s|%s%s" % (
+            led.encode(), name_idx, self._value(kind), kind,
+            self._suffix(prof.name))
+        self.sent[led] += 1
+        self._sha.update(d)
+        return d
+
+    def _name_idx(self, prof: TenantProfile) -> int:
+        # Zipf draw folded into the tenant's fixed name space: the
+        # steady mix revisits hot names, exactly what the fairness path
+        # sees in production (and what keeps quarantine quiet)
+        return int(self.rng.zipf(prof.zipf_a) - 1) % prof.n_names
+
+    def _pick(self, p=None) -> TenantProfile:
+        return self.tenants[self.rng.choice(len(self.tenants),
+                                            p=self._shares if p is None
+                                            else p)]
+
+    # -- segments ------------------------------------------------------------
+    def steady(self, n: int) -> List[bytes]:
+        """Production steady state: every tenant at its profile share,
+        Zipf name mixes, mixed metric kinds."""
+        return [self._datagram(p := self._pick(), self._name_idx(p))
+                for _ in range(n)]
+
+    def diurnal(self, n: int, cycles: float = 2.0) -> List[bytes]:
+        """Diurnal ramp: tenant shares breathe sinusoidally (each tenant
+        phase-shifted), so relative pressure shifts continuously — the
+        controller must keep re-weighting, not settle once."""
+        out = []
+        k = len(self.tenants)
+        phases = 2 * np.pi * np.arange(k) / k
+        for i in range(n):
+            t = 2 * np.pi * cycles * i / max(1, n)
+            p = self._shares * (1.0 + 0.75 * np.sin(t + phases))
+            p = np.clip(p, 1e-4, None)
+            p = p / p.sum()
+            prof = self._pick(p)
+            out.append(self._datagram(prof, self._name_idx(prof)))
+        return out
+
+    def flash_crowd(self, n: int, tenant: Optional[str] = None,
+                    boost: float = 5.0) -> List[bytes]:
+        """Flash crowd: one tenant spikes to ~`boost`x its steady share
+        while everyone else keeps their absolute mix — the noisy-
+        neighbor isolation gate's traffic shape."""
+        tenant = tenant if tenant is not None else self.tenants[0].name
+        idx = next(i for i, t in enumerate(self.tenants)
+                   if t.name == tenant)
+        p = self._shares.copy()
+        p[idx] *= boost
+        p = p / p.sum()
+        out = []
+        for _ in range(n):
+            prof = self._pick(p)
+            out.append(self._datagram(prof, self._name_idx(prof)))
+        return out
+
+    def tag_explosion(self, n: int, tenant: str) -> List[bytes]:
+        """Runaway-cardinality tenant: every datagram mints a FRESH
+        metric name (a deploy gone wrong, a uuid in a name) — the
+        quarantine detector's trigger. The unique counter persists
+        across calls so repeated segments keep escalating."""
+        idx = next(i for i, t in enumerate(self.tenants)
+                   if t.name == tenant)
+        prof = self.tenants[idx]
+        base = self._explosion_next.get(tenant, 0)
+        out = [self._datagram(prof, prof.n_names + base + i)
+               for i in range(n)]
+        self._explosion_next[tenant] = base + n
+        return out
+
+    # -- reproducibility -----------------------------------------------------
+    def checksum(self) -> str:
+        """sha256 over every datagram emitted so far, in order — the
+        same-seed identity check the CLI and the e2e gate pin."""
+        return self._sha.hexdigest()
+
+    def ledger(self) -> Dict[str, int]:
+        return dict(self.sent)
+
+
+SEGMENTS = ("steady", "diurnal", "flash", "explosion")
+
+
+def run_plan(seed: int, plan: List[Tuple[str, int]],
+             tenants: Tuple[TenantProfile, ...] = DEFAULT_TENANTS,
+             tag: str = "tenant:"):
+    """Execute a [(segment, n)] plan; returns (generator, datagrams)."""
+    gen = ReplayGenerator(seed, tenants=tenants, tag=tag)
+    grams: List[bytes] = []
+    for seg, n in plan:
+        if seg == "steady":
+            grams.extend(gen.steady(n))
+        elif seg == "diurnal":
+            grams.extend(gen.diurnal(n))
+        elif seg == "flash":
+            grams.extend(gen.flash_crowd(n))
+        elif seg == "explosion":
+            grams.extend(gen.tag_explosion(n, tenants[0].name))
+        else:
+            raise ValueError(f"unknown segment {seg!r} "
+                             f"(want one of {SEGMENTS})")
+    return gen, grams
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="replay")
+    ap.add_argument("--seed", type=int, required=True)
+    ap.add_argument("--segments", default="steady:2000",
+                    help="comma list of segment:count "
+                         f"(segments: {', '.join(SEGMENTS)})")
+    args = ap.parse_args(argv)
+    plan = []
+    for part in args.segments.split(","):
+        seg, _, cnt = part.partition(":")
+        plan.append((seg.strip(), int(cnt or 1000)))
+    gen, grams = run_plan(args.seed, plan)
+    print(json.dumps({"seed": args.seed, "datagrams": len(grams),
+                      "sent": gen.ledger(),
+                      "sha256": gen.checksum()},
+                     sort_keys=True, separators=(",", ":")))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -402,6 +402,131 @@ def test_grpc_envelope_rejections_accounted_and_not_acked():
         srv.shutdown()
 
 
+# -- tenant-tag extraction corpus (multi-tenant fairness) --------------------
+# Tenant identity is extracted from RAW datagram bytes at the ring
+# admission boundary (dogstatsd.cpp tenant_extract) and mirrored in
+# Python (reliability/tenancy.py extract_tenant). Every malformation
+# must resolve to the default tenant — never a drop, never a crash —
+# and the two implementations must agree byte-for-byte: a divergence
+# would charge the same datagram to different tenants depending on
+# which ingest path carried it.
+
+TENANT_CORPUS = [
+    # (datagram, expected tenant; None = default)
+    (b"a:1|c|#tenant:acme", "acme"),
+    (b"a:1|c|#env:prod,tenant:acme,zone:b", "acme"),
+    (b"a:1|c|#tenant:ab|@0.5", "ab"),                 # value ends at |
+    (b"a:1|c|#tenant:ab\nb:2|c", "ab"),               # value ends at newline
+    (b"a:1|c|#tenant:ac", "ac"),                      # value ends at EOD
+    (b"a:1|c|#tenant:" + b"x" * 64, "x" * 64),        # exactly at the cap
+    (b"caf\xc3\xa9:1|c|#tenant:caf\xc3\xa9",
+     b"caf\xc3\xa9".decode("utf-8")),                 # valid multibyte
+    # missing tag entirely
+    (b"a:1|c", None),
+    (b"a:1|c|#env:prod", None),
+    # duplicate tags: the FIRST well-formed occurrence wins, even when
+    # a later one differs — tenants cannot self-reassign mid-datagram
+    (b"a:1|c|#tenant:a,tenant:b", "a"),
+    # ...and a first occurrence with a bad value resolves the datagram
+    # to default (anomaly => default, never keep scanning: a crafted
+    # datagram must not pick which of its candidate values is charged)
+    (b"a:1|c|#tenant:,tenant:x", None),
+    # empty / oversized / invalid-UTF-8 values
+    (b"a:1|c|#tenant:", None),
+    (b"a:1|c|#tenant:,env:x", None),
+    (b"a:1|c|#tenant:" + b"x" * 65, None),
+    (b"a:1|c|#tenant:\xff\xfe", None),
+    (b"a:1|c|#tenant:\xc0\xaf", None),                # C0 lead byte
+    (b"a:1|c|#tenant:ab\xe2\x28", None),              # broken continuation
+    # the tag must sit at a tag-section boundary ('#' or ','), not in
+    # the metric name or inside another tag's value
+    (b"tenant:acme:1|c", None),
+    (b"a:1|c|#xtenant:evil", None),
+    (b"a:1|c|#note:tenant:evil", None),
+    (b"a:1|c|#xtenant:evil,tenant:good", "good"),
+    # tag split across a truncated datagram (full socket buffer)
+    (b"a:1|c|#tena", None),
+    (b"a:1|c|#tenant", None),
+    (b"a:1|c|#,tenant:ok", "ok"),
+]
+
+
+def test_tenant_extract_corpus_and_parity():
+    """Every corpus row resolves as specified, in the Python mirror AND
+    (when buildable) the C++ extractor — byte-for-byte agreement."""
+    from veneur_tpu import native
+    from veneur_tpu.reliability.tenancy import extract_tenant
+    have_native = native.available()
+    for data, want in TENANT_CORPUS:
+        got = extract_tenant("tenant:", data)
+        assert got == want, (data, got, want)
+        if have_native:
+            got_c = native.tenant_extract("tenant:", data)
+            assert got_c == want, ("native", data, got_c, want)
+
+
+def test_tenant_extract_random_parity():
+    """Random structured fuzz around the tag: the two extractors must
+    agree on arbitrary byte soup, not just the hand-picked corpus."""
+    from veneur_tpu import native
+    from veneur_tpu.reliability.tenancy import extract_tenant
+    if not native.available():
+        pytest.skip("native engine not buildable")
+    rng = np.random.default_rng(21)
+    frags = [b"#", b",", b"|", b"tenant:", b"tenant", b":", b"\n",
+             b"\xff", b"\xc3\xa9", b"a", b"zz", b"" ]
+    for _ in range(2000):
+        n = int(rng.integers(0, 12))
+        data = b"m:1|c" + b"".join(
+            frags[int(rng.integers(0, len(frags)))] for _ in range(n))
+        py = extract_tenant("tenant:", data)
+        cc = native.tenant_extract("tenant:", data)
+        assert py == cc, (data, py, cc)
+
+
+def test_tenant_corpus_every_row_accounted():
+    """The corpus through the REAL ring admission boundary: every
+    datagram lands in exactly one tenant's admitted count (admission
+    off => everything admits, but per-tenant accounting still runs),
+    and malformed identities all land on default."""
+    from veneur_tpu import native
+    if not native.available():
+        pytest.skip("native engine not buildable")
+    from veneur_tpu.aggregation.host import BatchSpec
+    from veneur_tpu.aggregation.state import TableSpec
+    spec = TableSpec(counter_capacity=256, gauge_capacity=64,
+                     status_capacity=16, set_capacity=32,
+                     histo_capacity=64)
+    bspec = BatchSpec(counter=256, gauge=128, status=16, set=64, histo=256)
+    eng = native.NativeIngest(spec, bspec)
+    eng.tenant_config(True)
+    eng.rings_start(2, fds=None, max_len=4096, ring_cap=4096)
+    try:
+        want: dict = {}
+        for i, (data, tenant) in enumerate(TENANT_CORPUS):
+            assert eng.rings_inject(i % 2, data)
+            want[tenant or "default"] = want.get(tenant or "default", 0) + 1
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            d = eng.admission_drain().get("tenants", {})
+            if d:
+                break
+            time.sleep(0.05)
+        got = {t: sum(ent.get("admitted", {}).values())
+               + sum(ent.get("shed", {}).values())
+               for t, ent in d.items()}
+        # late stragglers: fold any second drain
+        time.sleep(0.2)
+        for t, ent in eng.admission_drain().get("tenants", {}).items():
+            got[t] = got.get(t, 0) \
+                + sum(ent.get("admitted", {}).values()) \
+                + sum(ent.get("shed", {}).values())
+        assert got == want, (got, want)
+        assert sum(got.values()) == len(TENANT_CORPUS)
+    finally:
+        eng.readers_stop()
+
+
 def test_server_accounts_every_corpus_rejection():
     """End to end: the full malformed corpus over real UDP. Every
     datagram must land in processed or in the registered drop counter

@@ -1,0 +1,212 @@
+"""@slow long-haul tenant soak: one seeded multi-segment production
+replay (benchmarks/replay.py) driven through a REAL multi-ring server,
+revalidating the repo's standing invariants under tenant churn — exact
+pipeline accounting (processed == injected, per-ring stats fold), exact
+per-tenant admission accounting (sent == admitted + shed at every
+segment boundary), noisy-neighbor isolation at SHEDDING, quarantine
+demote → checkpoint/restart survival → decay re-admission, and /healthz
+never leaving 200. The fast versions of each individual invariant live
+in tests/test_tenancy.py and benchmarks/e2e.py config15; this file is
+the everything-at-once endurance pass the tier-1 budget excludes."""
+
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from benchmarks.replay import ReplayGenerator
+from veneur_tpu import native
+from veneur_tpu.reliability.overload import HEALTHY, SHEDDING
+from veneur_tpu.server.server import Server
+from veneur_tpu.sinks.debug import DebugMetricSink
+
+from tests.test_server import _wait_until, small_config
+
+pytestmark = [
+    pytest.mark.slow,
+    pytest.mark.skipif(not native.available(),
+                       reason="native engine not buildable"),
+]
+
+SEED = 424_242
+Q_MAX_KEYS = 3_500          # above any legitimate tenant's key count
+FLASH_N = 6_000
+
+
+def _cfg(**kw):
+    defaults = dict(
+        interval="5s", http_address="127.0.0.1:0",
+        reader_rings=2,
+        tenant_enabled=True,
+        tenant_fair_rate=FLASH_N / 10.0, tenant_fair_burst_mult=3.0,
+        tenant_quarantine_max_keys=Q_MAX_KEYS,
+        tenant_quarantine_decay=0.25, tenant_quarantine_readmit_frac=0.5,
+        overload_enabled=True, overload_native_admission=True,
+        overload_poll_interval_s=0.05, overload_hold_s=0.3,
+        tpu_counter_capacity=16384, tpu_gauge_capacity=4096,
+        tpu_status_capacity=64, tpu_set_capacity=4096,
+        tpu_histo_capacity=8192, tpu_batch_counter=8192,
+        tpu_batch_gauge=4096, tpu_batch_status=64, tpu_batch_set=4096,
+        tpu_batch_histo=8192)
+    defaults.update(kw)
+    return small_config(**defaults)
+
+
+def _inject(srv, grams):
+    """Lossless feed through the real admission choke point, paced so a
+    ring can never overflow post-admission (see e2e config15)."""
+    eng = srv.aggregator.eng
+    nr = max(1, eng.n_rings)
+    counters = srv.aggregator.reader_counters
+    for i, g in enumerate(grams):
+        eng.rings_inject(i % nr, g)
+        if (i & 0xFFF) == 0xFFF and counters()["ring_depth"] > 32_000:
+            while counters()["ring_depth"] > 8_000:
+                time.sleep(0.005)
+
+
+def _settle(srv, timeout=120.0):
+    deadline = time.time() + timeout
+    last = -1
+    while time.time() < deadline:
+        done = srv.aggregator.processed
+        if srv.aggregator.reader_counters()["ring_depth"] == 0 \
+                and done == last:
+            break
+        last = done
+        time.sleep(0.05)
+    time.sleep(0.35)            # poller folds per-tenant deltas
+
+
+def _totals(ten):
+    return ({t: n for (t,), n in ten.admitted_snapshot()},
+            {t: n for (t,), n in ten.shed_snapshot()})
+
+
+def _assert_ledger_exact(srv, ledger, base=None):
+    adm, shd = _totals(srv.tenancy)
+    base_adm, base_shd = base or ({}, {})
+    for tenant, sent in ledger.items():
+        got = adm.get(tenant, 0) - base_adm.get(tenant, 0) \
+            + shd.get(tenant, 0) - base_shd.get(tenant, 0)
+        assert got == sent, (tenant, got, sent)
+    return adm, shd
+
+
+def _healthz(srv):
+    port = srv._httpd.server_address[1]
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=10) as r:
+            return r.status
+    except urllib.error.HTTPError as e:
+        return e.code
+
+
+def test_tenant_soak_replay_restart_readmit(tmp_path):
+    cfg = dict(checkpoint_dir=str(tmp_path / "ckpt"),
+               checkpoint_on_shutdown=True)
+    gen = ReplayGenerator(SEED)
+    srv = Server(_cfg(**cfg), metric_sinks=[DebugMetricSink()])
+    srv.start()
+    injected = 0
+    try:
+        ov = srv._overload
+        ov._signals = lambda: {}
+
+        # -- segment 1: steady + diurnal, HEALTHY ------------------------
+        grams = gen.steady(15_000) + gen.diurnal(8_000)
+        _inject(srv, grams)
+        injected += len(grams)
+        _settle(srv)
+        assert _healthz(srv) == 200
+        base = _assert_ledger_exact(srv, gen.ledger())
+        assert not dict(srv.tenancy.shed_snapshot())   # nothing shed
+        # pipeline exactness: every injected datagram parsed, per-ring
+        # stats fold to the host totals
+        assert srv.aggregator.processed == injected
+        rows = srv.aggregator.ring_stats_per_ring()
+        assert sum(r["datagrams"] for r in rows) \
+            == srv.aggregator.reader_counters()["datagrams"] == injected
+
+        # -- segment 2: flash crowd under forced SHEDDING ----------------
+        led0 = gen.ledger()
+        flash = gen.flash_crowd(FLASH_N)         # acme ~0.77 of this
+        ov._signals = lambda: {"soak_storm": 0.90}
+        _wait_until(lambda: ov.state == SHEDDING, 10, "SHEDDING")
+        _inject(srv, flash)
+        injected += len(flash)
+        _settle(srv)
+        assert _healthz(srv) == 200
+        ov._signals = lambda: {}
+        led1 = gen.ledger()
+        seg = {t: led1[t] - led0.get(t, 0) for t in led1}
+        adm, shd = _assert_ledger_exact(srv, seg, base=base)
+        # the flash tenant was throttled to its bucket; everyone whose
+        # segment volume fits the burst kept their full budget
+        assert shd.get("acme", 0) > 0
+        for quiet in ("blue", "crux", "dex", "default"):
+            assert shd.get(quiet, 0) == 0, (quiet, shd)
+        _wait_until(lambda: ov.state == HEALTHY, 15, "recovery")
+
+        # -- segment 3: tag explosion -> quarantine ----------------------
+        boom = gen.tag_explosion(Q_MAX_KEYS + 1_000, "crux")
+        _inject(srv, boom)
+        injected += len(boom)
+        _settle(srv)
+        _wait_until(
+            lambda: srv.tenancy.quarantined_tenants() == ["crux"],
+            15, "crux quarantined")
+        rows0 = dict(srv.tenancy.demoted_rows_snapshot()).get(("crux",), 0)
+        assert rows0 > 0
+        exact_k = 300
+        more = gen.tag_explosion(exact_k, "crux")
+        _inject(srv, more)
+        injected += len(more)
+        _settle(srv)
+        _wait_until(
+            lambda: dict(srv.tenancy.demoted_rows_snapshot())
+            .get(("crux",), 0) == rows0 + exact_k, 15,
+            "exactly K more demoted rows")
+        # demoted traffic is measured, not dropped: still admitted AND
+        # still parsed — only the storm's shed datagrams skipped the
+        # parser, and their count is exact
+        _assert_ledger_exact(srv, gen.ledger())
+        total_shed = sum(dict(srv.tenancy.shed_snapshot()).values())
+        assert srv.aggregator.processed == injected - total_shed
+        snap_before = srv.tenancy.snapshot_state()
+    finally:
+        srv.shutdown()          # final checkpoint carries the sidecar
+
+    # -- segment 4: restart; quarantine survives, then decays off -------
+    rows_at_shutdown = dict(snap_before["demoted_rows"])
+    srv2 = Server(_cfg(restore_on_start=True, **cfg),
+                  metric_sinks=[DebugMetricSink()])
+    srv2.start()
+    try:
+        srv2._overload._signals = lambda: {}
+        assert srv2.tenancy.quarantined_tenants() == ["crux"]
+        assert dict(srv2.tenancy.demoted_rows_snapshot()) == \
+            {(t,): n for t, n in rows_at_shutdown.items()}
+        assert _healthz(srv2) == 200
+
+        post = gen.steady(3_000)
+        _inject(srv2, post)
+        _settle(srv2)
+        # fresh counters: this server has seen exactly `post`
+        adm, shd = _totals(srv2.tenancy)
+        assert sum(adm.values()) + sum(shd.values()) == len(post)
+        assert not dict(srv2.tenancy.shed_snapshot())
+
+        # decay re-admission: each flush folds the key window and decays
+        # the estimate; crux must leave quarantine within a few flushes
+        for _ in range(5):
+            srv2.trigger_flush(wait=True)
+            time.sleep(0.3)     # poller refreshes the mirror table
+            if "crux" not in srv2.tenancy.quarantined_tenants():
+                break
+        assert "crux" not in srv2.tenancy.quarantined_tenants()
+        assert _healthz(srv2) == 200
+    finally:
+        srv2.shutdown()

@@ -502,6 +502,46 @@ CASES = [
         """},
     ),
     (
+        # surface 3, tenant flavor: per-tenant shed/demote deltas ride
+        # the same destructive per-ring drain contract, and a tenant
+        # drop path without a counter is a silent fairness-accounting
+        # hole (per-tenant sent == admitted + shed is the storm
+        # harness's gate)
+        "accounting-flow",
+        lambda p: accounting_flow.run(p, targets=["pkg"], send_targets={},
+                                      ring_targets=["pkg"]),
+        # positive: tenant drain read off ONE ring outside a fold, and
+        # a tenant shed branch that exits without counting the drop
+        {"pkg/tenantq.py": """
+            import queue
+            def tenant_shed_totals(eng):
+                return eng.ring_tenant_drain_one(0)
+
+            def shed_datagram(q, item):
+                try:
+                    q.put_nowait(item)
+                except queue.Full:
+                    return None
+        """},
+        # negative: drain folded across all rings, every shed branch
+        # bumps the per-tenant counter
+        {"pkg/tenantq.py": """
+            import queue
+            def tenant_shed_totals(eng, n_rings):
+                total = {}
+                for r in range(n_rings):
+                    for t, n in eng.ring_tenant_drain_one(r).items():
+                        total[t] = total.get(t, 0) + n
+                return total
+
+            def shed_datagram(q, item, tenant_shed):
+                try:
+                    q.put_nowait(item)
+                except queue.Full:
+                    tenant_shed[item.tenant] += 1
+        """},
+    ),
+    (
         "reshard-quiesce",
         lambda p: reshard_quiesce.run(p, roots=["veneur_tpu"]),
         # positive: a shard-map mutator called (and .n_shards mutated)

@@ -42,7 +42,10 @@ _REJECT_NAMES = ("invalid", "drop", "reject", "shed", "error")
 # "caller must fold" contract this surface enforces on their callers).
 RING_DRAINS = frozenset({
     "vrm_admission_counters", "vrm_counters", "vrm_ring_stats",
-    "ring_admission_drain_one", "ring_counters_one", "ring_stats_one"})
+    "ring_admission_drain_one", "ring_counters_one", "ring_stats_one",
+    # tenant shed/demote deltas ride the same destructive per-ring drain
+    # contract: one ring read outside a fold loses the others' counts
+    "vrm_tenant_counters", "ring_tenant_drain_one"})
 RING_TARGETS = (
     "veneur_tpu/native/__init__.py",
     "veneur_tpu/server/server.py",

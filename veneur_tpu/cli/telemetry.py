@@ -85,6 +85,35 @@ def watch_table(samples) -> list:
             for r in rows]
 
 
+def tenant_table(samples) -> list:
+    """Render the multi-tenant fairness family (veneur.tenant.*,
+    tenant=<name> label) as one aligned row per tenant — the operator's
+    noisy-neighbor balance sheet: admitted vs shed per tenant, plus the
+    quarantine flag and demoted-row total (README §Multi-tenancy).
+    Empty when tenancy is off."""
+    per_tenant: dict = {}
+    cols: list = []
+    for name, labels, value in samples:
+        # exposition names arrive underscore-mangled (veneur_tenant_*)
+        if not name.startswith("veneur_tenant_") or "tenant" not in labels:
+            continue
+        stat = name[len("veneur_tenant_"):]
+        if stat.endswith("_total"):
+            stat = stat[:-len("_total")]
+        if stat not in cols:
+            cols.append(stat)
+        per_tenant.setdefault(labels["tenant"], {})[stat] = value
+    if not per_tenant:
+        return []
+    rows = [["tenant"] + cols]
+    for tenant in sorted(per_tenant):
+        rows.append([tenant] + [f"{per_tenant[tenant].get(c, 0):g}"
+                                for c in cols])
+    widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
+    return ["  ".join(f"{cell:>{w}}" for cell, w in zip(r, widths))
+            for r in rows]
+
+
 def dump_once(fetch, as_json: bool, out=None) -> int:
     """One scrape → sorted text (or JSON) on `out`. Returns an exit
     code: 1 on fetch failure, 0 otherwise (an empty exposition is a
@@ -119,6 +148,12 @@ def dump_once(fetch, as_json: bool, out=None) -> int:
     if table:
         print("", file=out)
         print("standing watches:", file=out)
+        for line in table:
+            print(f"  {line}", file=out)
+    table = tenant_table(samples)
+    if table:
+        print("", file=out)
+        print("tenants:", file=out)
         for line in table:
             print(f"  {line}", file=out)
     return 0

@@ -179,6 +179,23 @@ class Config:
     #                                    Python-side behavior: the native
     #                                    path bypasses admission)
 
+    # multi-tenant fairness + quarantine (veneur_tpu/reliability/
+    # tenancy.py; README §Multi-tenancy). Off by default: no identity
+    # extraction, no per-tenant buckets, no quarantine — prior behavior
+    # exactly.
+    tenant_enabled: bool = False       # master switch for tenancy
+    tenant_tag: str = "tenant:"        # datagram tag carrying the identity
+    tenant_weights: dict = dataclasses.field(
+        default_factory=dict)          # {tenant: weight}; unlisted -> 1.0
+    tenant_fair_rate: float = 0.0      # admitted pkts/s per unit weight at
+    #                                    SHEDDING+ (0 = fairness buckets off)
+    tenant_fair_burst_mult: float = 2.0    # bucket depth = rate * mult
+    tenant_quarantine_max_keys: int = 0    # distinct-key budget per tenant
+    #                                    per flush window (0 = quarantine off)
+    tenant_quarantine_decay: float = 0.5   # key-estimate decay per flush
+    tenant_quarantine_readmit_frac: float = 0.5  # re-admit when the decayed
+    #                                    estimate falls under frac * budget
+
     # TCP statsd hardening: connection cap + per-connection idle
     # deadline (a slowloris peer must not pin reader threads forever).
     tcp_max_connections: int = 0       # concurrent conns (0 = unlimited)

@@ -170,6 +170,35 @@ def _build_and_load():
         lib.vrm_stats.argtypes = [ctypes.c_void_p,
                                   ctypes.POINTER(ctypes.c_uint64)]
         lib.vrm_stop.argtypes = [ctypes.c_void_p]
+        lib.vt_tenant_config.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_double, ctypes.c_uint32, ctypes.c_double,
+            ctypes.c_double]
+        lib.vt_tenant_params.argtypes = [
+            ctypes.c_void_p, ctypes.c_double, ctypes.c_char_p, ctypes.c_int]
+        lib.vt_tenant_names.restype = ctypes.c_int
+        lib.vt_tenant_names.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                        ctypes.c_int]
+        lib.vt_tenant_table.restype = ctypes.c_int
+        lib.vt_tenant_table.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                        ctypes.c_int]
+        lib.vt_tenant_restore.restype = ctypes.c_int
+        lib.vt_tenant_restore.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                          ctypes.c_int]
+        lib.vt_set_tenant.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                      ctypes.c_int]
+        lib.vt_tenant_rows.restype = ctypes.c_int
+        lib.vt_tenant_rows.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_int]
+        lib.vt_tenant_extract.restype = ctypes.c_int
+        lib.vt_tenant_extract.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_int]
+        lib.vrm_tenant_counters.restype = ctypes.c_int
+        lib.vrm_tenant_counters.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_int]
         _lib = lib
     except Exception as e:  # noqa: BLE001 — any failure => python fallback
         _load_err = str(e)
@@ -205,6 +234,25 @@ def hash64_batch(members: List[bytes]) -> "np.ndarray":
     return out
 
 
+def tenant_extract(tag: str, data: bytes) -> Optional[str]:
+    """The C++ engine's tenant-tag extraction (vt_tenant_extract) exposed
+    standalone: the value of the first well-formed `tag` occurrence in the
+    raw datagram, or None for every default-tenant outcome (missing tag,
+    empty/oversized/invalid-UTF-8 value, tag split by truncation). Tests
+    fuzz this against reliability/tenancy.py extract_tenant for parity.
+    Raises when the engine isn't built — callers gate on available()."""
+    _build_and_load()
+    if _lib is None:
+        raise RuntimeError(f"native ingest unavailable: {_load_err}")
+    tag_b = tag.encode("utf-8", "surrogateescape")
+    out = ctypes.create_string_buffer(256)
+    n = _lib.vt_tenant_extract(tag_b, len(tag_b), data, len(data), out,
+                               len(out))
+    if n <= 0:
+        return None
+    return out.raw[:n].decode("utf-8", "surrogateescape")
+
+
 def route_digest(kind: str, name: str, joined_tags: str) -> int:
     """The C++ engine's routing digest (fnv1a-32 over name, kind, joined
     tags) — must be byte-identical to collective.keytable.route_digest;
@@ -218,6 +266,21 @@ def route_digest(kind: str, name: str, joined_tags: str) -> int:
     tags_b = joined_tags.encode("utf-8", "surrogateescape")
     return int(_lib.vt_route_digest(name_b, len(name_b), kind_b,
                                     len(kind_b), tags_b, len(tags_b)))
+
+
+def _tenant_merge(acc: dict, one: dict) -> None:
+    """Accumulate one ring's per-tenant drain into a host-wide fold
+    (ring_tenant_drain_one layout: nested admitted/shed class dicts plus
+    a demoted_rows scalar)."""
+    for tenant, ent in one.items():
+        dst = acc.setdefault(tenant, {})
+        for side in ("admitted", "shed"):
+            for cls, n in ent.get(side, {}).items():
+                d = dst.setdefault(side, {})
+                d[cls] = d.get(cls, 0) + n
+        if ent.get("demoted_rows"):
+            dst["demoted_rows"] = (dst.get("demoted_rows", 0)
+                                   + ent["demoted_rows"])
 
 
 class NativeIngest:
@@ -553,16 +616,20 @@ class NativeIngest:
         if m:
             adm = [0, 0, 0]
             shed = [0, 0, 0]
-            out = (ctypes.c_uint64 * 6)()
+            tenants: dict = {}
             for i in range(self._n_rings):
-                _lib.vrm_admission_counters(m, i, out)
+                one = self.ring_admission_drain_one(i)
                 for c in range(3):
-                    adm[c] += out[c]
-                    shed[c] += out[3 + c]
-            return {
+                    adm[c] += one["admitted"].get(names[c], 0)
+                    shed[c] += one["shed"].get(names[c], 0)
+                _tenant_merge(tenants, one.get("tenants", {}))
+            d = {
                 "admitted": {names[i]: adm[i] for i in range(3) if adm[i]},
                 "shed": {names[i]: shed[i] for i in range(3) if shed[i]},
             }
+            if tenants:
+                d["tenants"] = tenants
+            return d
         r = getattr(self, "_readers", None)
         if not r:
             return {"admitted": {}, "shed": {}}
@@ -690,12 +757,169 @@ class NativeIngest:
 
     def ring_admission_drain_one(self, ring: int) -> dict:
         """Drain-and-reset ring i's exact per-class admission deltas
-        (admission_drain layout). Callers must fold across ALL rings —
-        use admission_drain() for the exact host-wide sum."""
+        (admission_drain layout), plus — when the tenant table is live —
+        a "tenants" sub-dict of per-tenant admitted/shed/demoted_rows
+        deltas drained through the SAME per-ring fold point. Callers must
+        fold across ALL rings — use admission_drain() for the exact
+        host-wide sum."""
         out = (ctypes.c_uint64 * 6)()
         _lib.vrm_admission_counters(self._rings, ring, out)
         names = ("self", "high", "low")
-        return {
+        d = {
             "admitted": {names[i]: out[i] for i in range(3) if out[i]},
             "shed": {names[i]: out[3 + i] for i in range(3) if out[3 + i]},
         }
+        if getattr(self, "_tenant_names", None) is not None:
+            tenants = self.ring_tenant_drain_one(ring)
+            if tenants:
+                d["tenants"] = tenants
+        return d
+
+    # -- multi-tenant identity / fairness / quarantine ----------------------
+
+    def tenant_config(self, enabled: bool, tag: str = "tenant:",
+                      burst_mult: float = 2.0, q_max_keys: int = 0,
+                      q_decay: float = 0.5,
+                      q_readmit_frac: float = 0.5) -> None:
+        """Create/configure the tenant table on the master parser. Must
+        run before rings_start — the tag is read lock-free on the
+        admission path. Interns the default tenant as id 0."""
+        tag_b = tag.encode("utf-8", "surrogateescape")
+        _lib.vt_tenant_config(self._h, 1 if enabled else 0, tag_b,
+                              len(tag_b), float(burst_mult),
+                              int(q_max_keys), float(q_decay),
+                              float(q_readmit_frac))
+        if getattr(self, "_tenant_names", None) is None:
+            self._tenant_names = {0: "default"}
+
+    def tenant_params(self, base_rate: float, weights: dict) -> None:
+        """Per-poll push: base admit rate (tokens/s per unit weight; <=0
+        disables the fairness buckets) and {tenant: weight} overrides.
+        Unknown names are interned so weights precede first traffic."""
+        blob = "".join(
+            f"{name}\t{float(w)}\n" for name, w in weights.items()
+        ).encode("utf-8", "surrogateescape")
+        _lib.vt_tenant_params(self._h, float(base_rate), blob, len(blob))
+
+    def _tenant_refresh_names(self) -> None:
+        """Drain newly interned (id, name) pairs into the local map."""
+        cap = 4096
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            n = _lib.vt_tenant_names(self._h, buf, cap)
+            if n >= 0:
+                break
+            cap = -n * 2
+        raw = buf.raw
+        off = 0
+        for _ in range(n):
+            tid = int.from_bytes(raw[off:off + 4], "little", signed=True)
+            ln = int.from_bytes(raw[off + 4:off + 6], "little")
+            self._tenant_names[tid] = raw[off + 6:off + 6 + ln].decode(
+                "utf-8", "surrogateescape")
+            off += 6 + ln
+
+    def _tenant_name(self, tid: int) -> str:
+        name = self._tenant_names.get(tid)
+        if name is None:
+            self._tenant_refresh_names()
+            name = self._tenant_names.get(tid, f"tenant#{tid}")
+        return name
+
+    def tenant_table(self) -> dict:
+        """Non-destructive snapshot of every interned tenant:
+        {name: {"demoted": bool, "key_est": float}} (checkpoint +
+        quarantine telemetry source)."""
+        cap = 1 << 16
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            n = _lib.vt_tenant_table(self._h, buf, cap)
+            if n >= 0:
+                break
+            cap = -n * 2
+        raw = buf.raw
+        out = {}
+        off = 0
+        for _ in range(n):
+            tid = int.from_bytes(raw[off:off + 4], "little", signed=True)
+            demoted = raw[off + 4] != 0
+            est = np.frombuffer(raw[off + 5:off + 13], "<f8")[0]
+            ln = int.from_bytes(raw[off + 13:off + 15], "little")
+            name = raw[off + 15:off + 15 + ln].decode(
+                "utf-8", "surrogateescape")
+            off += 15 + ln
+            self._tenant_names[tid] = name
+            out[name] = {"demoted": demoted, "key_est": float(est)}
+        return out
+
+    def tenant_restore(self, entries) -> int:
+        """Restore quarantine state from a checkpoint: entries is an
+        iterable of (name, demoted, key_est) in snapshot order — names
+        re-intern in that order, reproducing the snapshot's ids. Returns
+        entries applied."""
+        parts = []
+        for name, demoted, est in entries:
+            nb = name.encode("utf-8", "surrogateescape")
+            parts.append(bytes([1 if demoted else 0]))
+            parts.append(np.float64(est).tobytes())
+            parts.append(len(nb).to_bytes(2, "little"))
+            parts.append(nb)
+        blob = b"".join(parts)
+        n = int(_lib.vt_tenant_restore(self._h, blob, len(blob)))
+        self._tenant_refresh_names()
+        return n
+
+    def set_tenant(self, name: str) -> None:
+        """Python-feed-path parse context: subsequent feed() calls parse
+        as `name` (empty -> default tenant). The ring engine resolves
+        identity itself in ring_push; this is for the fallback path and
+        tests."""
+        nb = name.encode("utf-8", "surrogateescape")
+        _lib.vt_set_tenant(self._h, nb, len(nb))
+
+    def tenant_rows_drain(self) -> dict:
+        """Drain-and-reset the master parser's exact demoted-row counts
+        ({tenant: rows}) staged by the Python feed path."""
+        cap = 64
+        while True:
+            ids = (ctypes.c_int32 * cap)()
+            counts = (ctypes.c_uint64 * cap)()
+            n = _lib.vt_tenant_rows(self._h, ids, counts, cap)
+            if n >= 0:
+                break
+            cap = -n * 2
+        return {self._tenant_name(ids[i]): int(counts[i])
+                for i in range(n)}
+
+    def ring_tenant_drain_one(self, ring: int) -> dict:
+        """Drain-and-reset ring i's exact per-tenant deltas:
+        {tenant: {"admitted": {class: n}, "shed": {class: n},
+        "demoted_rows": n}} with zero entries omitted. Callers must fold
+        across ALL rings (ring_admission_drain_one / admission_drain do)."""
+        cap = getattr(self, "_tenant_cap", 64)
+        while True:
+            ids = (ctypes.c_int32 * cap)()
+            counts = (ctypes.c_uint64 * (cap * 7))()
+            n = _lib.vrm_tenant_counters(self._rings, ring, ids, counts,
+                                         cap)
+            if n >= 0:
+                break
+            cap = -n * 2
+        self._tenant_cap = cap
+        names = ("self", "high", "low")
+        out = {}
+        for i in range(n):
+            row = counts[i * 7:(i + 1) * 7]
+            adm = {names[c]: int(row[c]) for c in range(3) if row[c]}
+            shed = {names[c]: int(row[3 + c]) for c in range(3)
+                    if row[3 + c]}
+            ent = {}
+            if adm:
+                ent["admitted"] = adm
+            if shed:
+                ent["shed"] = shed
+            if row[6]:
+                ent["demoted_rows"] = int(row[6])
+            if ent:
+                out[self._tenant_name(ids[i])] = ent
+        return out

@@ -21,6 +21,7 @@
 // Exposed as a C ABI for ctypes; see veneur_tpu/native/__init__.py.
 
 #include <algorithm>
+#include <array>
 #include <atomic>
 #include <cerrno>
 #include <chrono>
@@ -144,6 +145,157 @@ inline uint64_t metro64(const char* p, size_t n, uint64_t seed = 1337) {
 enum Kind { K_COUNTER = 0, K_GAUGE = 1, K_HISTO = 2, K_SET = 3, K_TIMER = 4 };
 enum Scope { S_MIXED = 0, S_LOCAL = 1, S_GLOBAL = 2 };
 
+// ---------------------------------------------------------------------------
+// Multi-tenant identity + fairness (reliability/tenancy.py mirror).
+// One TenantTable lives on the MASTER parser and is shared by every ring:
+// tenant ids are interned once, entry pointers are stable for the process
+// lifetime (vector of unique_ptr, grown under mu), and the weighted token
+// buckets are host-wide — SO_REUSEPORT flow hashing can concentrate one
+// tenant on one ring, so splitting a tenant's budget per ring would let
+// placement, not weight, decide its fair share.
+
+constexpr size_t kTenantValueMax = 64;   // oversized values -> default
+constexpr int32_t kMaxTenants = 4096;    // intern cap; overflow -> default
+
+// strict UTF-8 validation: an invalid tenant value maps to the default
+// tenant instead of interning arbitrary bytes as an identity
+inline bool utf8_valid(const char* p, size_t n) {
+  size_t i = 0;
+  while (i < n) {
+    uint8_t c = (uint8_t)p[i];
+    size_t need;
+    if (c < 0x80) { i++; continue; }
+    if ((c & 0xE0) == 0xC0) { need = 1; if (c < 0xC2) return false; }
+    else if ((c & 0xF0) == 0xE0) need = 2;
+    else if ((c & 0xF8) == 0xF0) { need = 3; if (c > 0xF4) return false; }
+    else return false;
+    if (i + need >= n) return false;
+    for (size_t k = 1; k <= need; k++)
+      if (((uint8_t)p[i + k] & 0xC0) != 0x80) return false;
+    i += need + 1;
+  }
+  return true;
+}
+
+struct TenantEntry {
+  std::string name;
+  double weight = 1.0;           // guarded by TenantTable.mu
+  // weighted token bucket (guarded by TenantTable.mu)
+  double tokens = 0.0;
+  std::chrono::steady_clock::time_point last;
+  bool primed = false;
+  // tag-explosion detector: additive-error distinct-key estimate. The
+  // per-window count is exact (every new-key alloc bumps it); the
+  // carried estimate decays geometrically at each flush reset, so the
+  // additive error vs the true live-key count is bounded by the decay
+  // tail — the cheap end of the 2004.10332 counter family.
+  std::atomic<uint64_t> window_keys{0};
+  std::atomic<double> key_est{0.0};
+  std::atomic<bool> demoted{false};
+};
+
+struct TenantTable {
+  std::mutex mu;                       // entries growth, by_name, buckets
+  std::atomic<bool> enabled{false};
+  std::string tag;                     // e.g. "tenant:"; set once, pre-rings
+  std::atomic<double> base_rate{0.0};  // admitted/s per unit weight
+  double burst_mult = 2.0;             // guarded by mu
+  uint32_t q_max_keys = 0;             // 0 = quarantine off; set once
+  double q_decay = 0.5;                // guarded by mu
+  double q_readmit_frac = 0.5;         // guarded by mu
+  std::vector<std::unique_ptr<TenantEntry>> entries;  // id -> entry
+  std::unordered_map<std::string, int32_t> by_name;
+  std::vector<int32_t> fresh;          // interned since the last name drain
+  TenantEntry* dflt = nullptr;         // entries[0], stable once created
+};
+
+// Locate a well-formed `tag` value inside the raw datagram's tag section
+// (the occurrence must follow '#' or ','; first occurrence wins, so
+// duplicate tags resolve deterministically). Returns false — mapping the
+// datagram to the default tenant — for missing tags, tags split across a
+// truncated datagram, and empty/oversized/invalid-UTF-8 values: every
+// anomaly is still admitted-and-accounted, never silently dropped.
+inline bool tenant_extract(const std::string& tag, const char* p, size_t n,
+                           const char** v, size_t* vlen) {
+  if (tag.empty() || n <= tag.size()) return false;
+  const char* cur = p;
+  size_t rem = n;
+  while (rem >= tag.size()) {
+    const char* hit =
+        (const char*)memmem(cur, rem, tag.data(), tag.size());
+    if (!hit) return false;
+    if (hit > p && (hit[-1] == '#' || hit[-1] == ',')) {
+      const char* val = hit + tag.size();
+      size_t vmax = (size_t)(p + n - val);
+      size_t len = 0;
+      while (len < vmax && val[len] != ',' && val[len] != '|' &&
+             val[len] != '\n')
+        len++;
+      if (len == 0 || len > kTenantValueMax || !utf8_valid(val, len))
+        return false;
+      *v = val;
+      *vlen = len;
+      return true;
+    }
+    cur = hit + 1;
+    rem = (size_t)(p + n - cur);
+  }
+  return false;
+}
+
+// Intern (or look up) a tenant name; *te gets the stable entry pointer.
+// At the kMaxTenants cap new names collapse onto the default tenant —
+// identity cardinality must stay bounded even under a hostile name flood.
+inline int32_t tenant_intern(TenantTable& tt, const char* name, size_t n,
+                             TenantEntry** te) {
+  std::lock_guard<std::mutex> lk(tt.mu);
+  std::string key(name, n);
+  auto it = tt.by_name.find(key);
+  if (it != tt.by_name.end()) {
+    *te = tt.entries[it->second].get();
+    return it->second;
+  }
+  if ((int32_t)tt.entries.size() >= kMaxTenants) {
+    *te = tt.dflt;
+    return 0;
+  }
+  int32_t id = (int32_t)tt.entries.size();
+  auto e = std::make_unique<TenantEntry>();
+  e->name = key;
+  *te = e.get();
+  tt.entries.push_back(std::move(e));
+  tt.by_name.emplace(std::move(key), id);
+  tt.fresh.push_back(id);
+  return id;
+}
+
+// TokenBucket.allow with rate = base_rate * weight (reliability/
+// tenancy.py TenantFairness.allow). Host-wide: one bucket per tenant
+// regardless of which ring the datagram landed on.
+inline bool tenant_allow(TenantTable& tt, TenantEntry& e,
+                         std::chrono::steady_clock::time_point now) {
+  std::lock_guard<std::mutex> lk(tt.mu);
+  double rate = tt.base_rate.load(std::memory_order_relaxed) * e.weight;
+  if (rate <= 0.0) return true;
+  double burst = rate * tt.burst_mult;
+  if (burst < 1.0) burst = 1.0;
+  if (!e.primed) {
+    e.tokens = burst;
+    e.last = now;
+    e.primed = true;
+  }
+  double dt = std::chrono::duration<double>(now - e.last).count();
+  e.last = now;
+  double t = e.tokens + dt * rate;
+  if (t > burst) t = burst;
+  if (t >= 1.0) {
+    e.tokens = t - 1.0;
+    return true;
+  }
+  e.tokens = t;
+  return false;
+}
+
 struct KindTable {
   uint32_t capacity = 0;
   uint32_t n_shards = 1;
@@ -205,6 +357,19 @@ struct Parser {
   std::unordered_map<std::string, int32_t> local_cache;
 
   Parser& rt() { return master ? *master : *this; }
+
+  // Multi-tenant identity (master only; rings route via rt()). The
+  // cur_* fields are per-parser parse context: set before each vt_feed
+  // (by the ring worker under stage_mu, or by vt_set_tenant on the
+  // Python feed path) and read only inside parse_line/slot_for.
+  std::unique_ptr<TenantTable> tenants;
+  int32_t cur_tenant = 0;
+  TenantEntry* cur_entry = nullptr;
+  bool cur_demoted = false;
+  // demoted-row accounting per tenant id; written during parse (under
+  // stage_mu in the ring engine, under the GIL on the Python feed
+  // path), drained by vrm_tenant_counters / vt_tenant_rows
+  std::unordered_map<int32_t, uint64_t> demoted_rows;
 
   // staging (fixed batch capacities; slot sentinel fill done by Python)
   uint32_t bc, bg, bs, bh;
@@ -300,6 +465,21 @@ struct Parser {
       m.new_keys.push_back(NewKey{kind, slot, scope,
                                   (uint8_t)(alloc_imported ? 1 : 0),
                                   std::string(name, name_len), joined});
+      // tag-explosion detector: every distinct-key allocation charges
+      // the owning tenant's window counter; crossing the budget demotes
+      // it (subsequent datagrams collapse onto rollup keys instead of
+      // evicting healthy tenants' hot keys out of shard capacity)
+      if (cur_entry) {
+        uint64_t w =
+            cur_entry->window_keys.fetch_add(1, std::memory_order_relaxed)
+            + 1;
+        TenantTable* tt = m.tenants.get();
+        if (tt && tt->q_max_keys &&
+            !cur_entry->demoted.load(std::memory_order_relaxed) &&
+            cur_entry->key_est.load(std::memory_order_relaxed) +
+                    (double)w > (double)tt->q_max_keys)
+          cur_entry->demoted.store(true, std::memory_order_relaxed);
+      }
     }
     int32_t slot = it->second;
     lk.unlock();
@@ -449,6 +629,25 @@ struct Parser {
       p = next;
     }
     if (!found_tags) joined.clear();
+
+    // quarantine demotion: a demoted tenant's rows collapse onto ONE
+    // rollup key per kind — name, tags, and route digest all rewritten
+    // so the slot space this tenant can touch is bounded while its
+    // traffic stays measured (demoted_rows is the exact row count)
+    if (cur_demoted && cur_entry) {
+      static const char kRollup[] = "veneur.tenant.rollup";
+      name = kRollup;
+      name_len = sizeof(kRollup) - 1;
+      scope = S_MIXED;
+      joined.clear();
+      TenantTable* tt = rt().tenants.get();
+      if (tt) joined.append(tt->tag);
+      joined.append(cur_entry->name);
+      h = fnv32(name, name_len, FNV32_OFFSET);
+      h = fnv32(kind_str, kind_str_len, h);
+      h = fnv32(joined.data(), joined.size(), h);
+      demoted_rows[cur_tenant]++;
+    }
 
     switch (kind) {
       case K_COUNTER: {
@@ -801,6 +1000,24 @@ void vt_reset(void* hp) {
     p->gauges.init(p->gauges.capacity, n);
     p->sets.init(p->sets.capacity, n);
     p->histos.init(p->histos.capacity, n);
+  }
+  // tenant quarantine decay: fold this window's exact distinct-key count
+  // into the carried estimate (est = est*decay + window) and re-admit a
+  // demoted tenant once its estimate has decayed under the re-admission
+  // fraction of the budget — the flush boundary is the detector's clock
+  if (p->tenants) {
+    TenantTable& tt = *p->tenants;
+    std::lock_guard<std::mutex> tlk(tt.mu);
+    for (auto& e : tt.entries) {
+      uint64_t w = e->window_keys.exchange(0, std::memory_order_relaxed);
+      double est =
+          e->key_est.load(std::memory_order_relaxed) * tt.q_decay +
+          (double)w;
+      e->key_est.store(est, std::memory_order_relaxed);
+      if (tt.q_max_keys && e->demoted.load(std::memory_order_relaxed) &&
+          est <= tt.q_readmit_frac * (double)tt.q_max_keys)
+        e->demoted.store(false, std::memory_order_relaxed);
+    }
   }
 }
 
@@ -1251,6 +1468,11 @@ struct Admission {
   // exact per-class accounting: [self, high, low]
   uint64_t admitted[3] = {0, 0, 0};
   uint64_t shed[3] = {0, 0, 0};
+  // exact per-(tenant, class) accounting (guarded by the owning mutex):
+  // [admitted self/high/low, shed self/high/low]. Populated whenever the
+  // tenant table is enabled — tenant accounting stays exact even with
+  // class admission off.
+  std::unordered_map<int32_t, std::array<uint64_t, 6>> per_tenant;
 };
 
 struct ReaderGroup {
@@ -1355,6 +1577,38 @@ bool admit_datagram(Admission& a, const char* p, size_t n,
     ok = bucket_allow(a, 0, now);
   }
   if (ok) a.admitted[cls]++; else a.shed[cls]++;
+  return ok;
+}
+
+// Tenant-aware admission ladder: the per-class decision above, with the
+// tenant's weighted bucket layered under it at SHEDDING(2)+ — a tenant
+// over its fair share is throttled to its bucket while isolated tenants
+// keep their full budget (low-class traffic that the class ladder would
+// shed outright at SHEDDING+ instead runs the tenant bucket). Per-class
+// counters bump only when class admission is enabled (preserving the
+// pre-tenant counter contract); per-(tenant, class) counters bump
+// whenever a tenant entry is attached.
+bool admit_datagram2(Admission& a, TenantTable* tt, TenantEntry* te,
+                     int32_t tenant, const char* p, size_t n,
+                     std::chrono::steady_clock::time_point now) {
+  int cls = classify_datagram(a, p, n);
+  bool fair =
+      tt && te && tt->base_rate.load(std::memory_order_relaxed) > 0.0;
+  bool ok;
+  if (!a.enabled || a.state <= 0 || cls == CLS_SELF) {
+    ok = true;
+  } else if (cls == CLS_HIGH) {
+    ok = a.state < 3 || bucket_allow(a, 1, now);
+    if (ok && fair && a.state >= 2) ok = tenant_allow(*tt, *te, now);
+  } else if (a.state >= 2) {
+    ok = fair ? tenant_allow(*tt, *te, now) : false;
+  } else {
+    ok = bucket_allow(a, 0, now);
+  }
+  if (a.enabled) {
+    if (ok) a.admitted[cls]++; else a.shed[cls]++;
+  }
+  if (te) a.per_tenant[tenant][(ok ? 0 : 3) + cls]++;
   return ok;
 }
 
@@ -1584,6 +1838,16 @@ namespace {
 
 struct MultiRing;
 
+// One queued datagram plus the tenant identity resolved at admission time
+// (ring_push), so the worker parses under the same identity the admission
+// decision was charged to — re-extracting at parse time could disagree
+// after a weights push or intern-cap overflow.
+struct Dgram {
+  std::string data;
+  TenantEntry* te = nullptr;
+  int32_t tenant = 0;
+};
+
 struct Ring {
   Parser parser;                 // staging + key cache; tables -> master
   int fd = -1;                   // dup()ed socket; -1 = inject-only ring
@@ -1594,8 +1858,11 @@ struct Ring {
   std::mutex mu;                 // ring deque + counters + admission
   std::condition_variable cv;        // ring became non-empty
   std::condition_variable space_cv;  // staging emitted / resumed
-  std::deque<std::string> ring;
+  std::deque<Dgram> ring;
   size_t ring_cap = 65536;
+  // ring-local tenant-id replica (guarded by mu): hits skip the shared
+  // intern table's mutex, mirroring the key-table local_cache pattern
+  std::unordered_map<std::string, std::pair<int32_t, TenantEntry*>> tcache;
   uint64_t datagrams = 0;        // guarded by mu
   uint64_t toolong = 0;          // guarded by mu
   uint64_t ring_dropped = 0;     // guarded by mu
@@ -1636,14 +1903,42 @@ bool ring_push(Ring* r, const char* data, size_t n, bool kernel_trunc) {
       r->toolong++;
       return false;
     }
-    if (r->adm.enabled &&
-        !admit_datagram(r->adm, data, n, std::chrono::steady_clock::now()))
+    // tenant identity resolves here, before admission, so the fairness
+    // decision and the per-tenant shed count land on the same identity.
+    // Lock order r->mu -> tt.mu (tenant_intern / tenant_allow); nothing
+    // takes them in reverse.
+    TenantTable* tt = r->parser.rt().tenants.get();
+    TenantEntry* te = nullptr;
+    int32_t tenant = 0;
+    if (tt && tt->enabled.load(std::memory_order_relaxed)) {
+      te = tt->dflt;
+      const char* v = nullptr;
+      size_t vlen = 0;
+      if (tenant_extract(tt->tag, data, n, &v, &vlen)) {
+        std::string key(v, vlen);
+        auto it = r->tcache.find(key);
+        if (it != r->tcache.end()) {
+          tenant = it->second.first;
+          te = it->second.second;
+        } else {
+          tenant = tenant_intern(*tt, v, vlen, &te);
+          // an intern-cap overflow maps onto the default tenant; don't
+          // cache that as this name's identity (the cap could in theory
+          // be lifted by a restore re-interning in a different order)
+          if (tenant != 0 || key == te->name)
+            r->tcache.emplace(std::move(key), std::make_pair(tenant, te));
+        }
+      }
+    }
+    if ((r->adm.enabled || te) &&
+        !admit_datagram2(r->adm, tt, te, tenant, data, n,
+                         std::chrono::steady_clock::now()))
       return false;
     if (r->ring.size() >= r->ring_cap) {
       r->ring_dropped++;
       return false;
     }
-    r->ring.emplace_back(data, n);
+    r->ring.push_back(Dgram{std::string(data, n), te, tenant});
     if ((uint64_t)r->ring.size() > r->ring_highwater)
       r->ring_highwater = (uint64_t)r->ring.size();
   }
@@ -1688,7 +1983,7 @@ void vrm_reader_main(MultiRing* mr, Ring* r) {
 // emit; the swap-boundary pause parks it the same way.
 void vrm_worker_main(MultiRing* mr, Ring* r) {
   pin_self(r->pin_core);
-  std::string local;
+  Dgram local;
   size_t off = 0;
   bool have = false;
   while (!mr->stop.load(std::memory_order_relaxed)) {
@@ -1711,9 +2006,16 @@ void vrm_worker_main(MultiRing* mr, Ring* r) {
     {
       std::unique_lock<std::mutex> lk(r->stage_mu);
       if (!mr->pause.load(std::memory_order_relaxed)) {
+        // parse context: the tenant resolved at admission time, with the
+        // demotion flag re-read per attempt so a parked datagram resumes
+        // under the tenant's current quarantine state
+        r->parser.cur_tenant = local.tenant;
+        r->parser.cur_entry = local.te;
+        r->parser.cur_demoted =
+            local.te && local.te->demoted.load(std::memory_order_relaxed);
         int consumed = 0;
-        full = vt_feed(&r->parser, local.data(), (int)local.size(),
-                       (int)off, &consumed) != 0;
+        full = vt_feed(&r->parser, local.data.data(),
+                       (int)local.data.size(), (int)off, &consumed) != 0;
         off = (size_t)consumed;
         if (!full) have = false;
         parsed = true;
@@ -1992,6 +2294,246 @@ void vrm_stats(void* h, uint64_t* out) {
   vt_stats(mr->master, out);
   out[0] += pr;
   out[1] += pe;
+}
+
+// ---- tenant identity / fairness / quarantine ABI ----
+//
+// vt_tenant_config must run before rings start (tt.tag is read lock-free
+// on the admission path); everything else is safe at any time. All vt_*
+// tenant calls target the MASTER parser handle.
+
+// Create (or reconfigure) the tenant table. Interns "default" as id 0.
+void vt_tenant_config(void* hp, int enabled, const char* tag, int tag_len,
+                      double burst_mult, uint32_t q_max_keys,
+                      double q_decay, double q_readmit_frac) {
+  auto* p = (Parser*)hp;
+  if (!p->tenants) {
+    p->tenants = std::make_unique<TenantTable>();
+    auto e = std::make_unique<TenantEntry>();
+    e->name = "default";
+    p->tenants->dflt = e.get();
+    p->tenants->entries.push_back(std::move(e));
+    p->tenants->by_name.emplace("default", 0);
+  }
+  TenantTable& tt = *p->tenants;
+  {
+    std::lock_guard<std::mutex> lk(tt.mu);
+    tt.tag.assign(tag ? tag : "", tag && tag_len > 0 ? (size_t)tag_len : 0);
+    tt.burst_mult = burst_mult > 0.0 ? burst_mult : 2.0;
+    tt.q_max_keys = q_max_keys;
+    tt.q_decay = q_decay >= 0.0 && q_decay < 1.0 ? q_decay : 0.5;
+    tt.q_readmit_frac = q_readmit_frac > 0.0 ? q_readmit_frac : 0.5;
+  }
+  tt.enabled.store(enabled != 0, std::memory_order_release);
+}
+
+// Per-poll push: base admit rate (tokens/s per unit weight; <=0 disables
+// the fairness buckets) plus a "name\tweight\n" blob. A weight change
+// re-primes that tenant's bucket; unknown names are interned so weights
+// can be configured ahead of first traffic.
+void vt_tenant_params(void* hp, double base_rate, const char* blob,
+                      int len) {
+  auto* p = (Parser*)hp;
+  if (!p->tenants) return;
+  TenantTable& tt = *p->tenants;
+  tt.base_rate.store(base_rate, std::memory_order_relaxed);
+  const char* q = blob;
+  const char* end = blob + (blob && len > 0 ? len : 0);
+  while (q && q < end) {
+    const char* nl = (const char*)memchr(q, '\n', (size_t)(end - q));
+    size_t n = nl ? (size_t)(nl - q) : (size_t)(end - q);
+    const char* tab = (const char*)memchr(q, '\t', n);
+    if (tab && tab > q) {
+      std::string wstr(tab + 1, n - (size_t)(tab - q) - 1);
+      double w = strtod(wstr.c_str(), nullptr);
+      TenantEntry* te = nullptr;
+      tenant_intern(tt, q, (size_t)(tab - q), &te);
+      if (te) {
+        std::lock_guard<std::mutex> lk(tt.mu);
+        if (te->weight != w) {
+          te->weight = w;
+          te->primed = false;
+        }
+      }
+    }
+    q += n + 1;
+  }
+}
+
+// Drain names interned since the last call as [i32 id][u16 len][name]*.
+// Returns the entry count, or -bytes_needed (nothing drained) when cap
+// is too small.
+int vt_tenant_names(void* hp, char* buf, int cap) {
+  auto* p = (Parser*)hp;
+  if (!p->tenants) return 0;
+  TenantTable& tt = *p->tenants;
+  std::lock_guard<std::mutex> lk(tt.mu);
+  size_t need = 0;
+  for (int32_t id : tt.fresh) need += 6 + tt.entries[id]->name.size();
+  if (need > (size_t)(cap > 0 ? cap : 0)) return -(int)need;
+  char* w = buf;
+  int n = 0;
+  for (int32_t id : tt.fresh) {
+    const std::string& nm = tt.entries[id]->name;
+    uint16_t l = (uint16_t)nm.size();
+    memcpy(w, &id, 4);
+    memcpy(w + 4, &l, 2);
+    memcpy(w + 6, nm.data(), nm.size());
+    w += 6 + nm.size();
+    n++;
+  }
+  tt.fresh.clear();
+  return n;
+}
+
+// Non-destructive snapshot of every tenant for checkpoint / telemetry:
+// [i32 id][u8 demoted][f64 key_est][u16 len][name]* in id order. The
+// estimate folds in the current window so a checkpoint taken mid-flush
+// carries the full count. Returns entries or -bytes_needed.
+int vt_tenant_table(void* hp, char* buf, int cap) {
+  auto* p = (Parser*)hp;
+  if (!p->tenants) return 0;
+  TenantTable& tt = *p->tenants;
+  std::lock_guard<std::mutex> lk(tt.mu);
+  size_t need = 0;
+  for (auto& e : tt.entries) need += 15 + e->name.size();
+  if (need > (size_t)(cap > 0 ? cap : 0)) return -(int)need;
+  char* w = buf;
+  int n = 0;
+  for (auto& e : tt.entries) {
+    int32_t id = n;
+    uint8_t dem = e->demoted.load(std::memory_order_relaxed) ? 1 : 0;
+    double est = e->key_est.load(std::memory_order_relaxed) +
+                 (double)e->window_keys.load(std::memory_order_relaxed);
+    uint16_t l = (uint16_t)e->name.size();
+    memcpy(w, &id, 4);
+    memcpy(w + 4, &dem, 1);
+    memcpy(w + 5, &est, 8);
+    memcpy(w + 13, &l, 2);
+    memcpy(w + 15, e->name.data(), e->name.size());
+    w += 15 + e->name.size();
+    n++;
+  }
+  return n;
+}
+
+// Restore quarantine state from a checkpoint: [u8 demoted][f64 key_est]
+// [u16 len][name]* — names are (re-)interned in blob order, so a table
+// restored into a fresh process reproduces the same id assignment it was
+// snapshotted with. Returns entries applied.
+int vt_tenant_restore(void* hp, const char* blob, int len) {
+  auto* p = (Parser*)hp;
+  if (!p->tenants || !blob) return 0;
+  TenantTable& tt = *p->tenants;
+  const char* q = blob;
+  const char* end = blob + (len > 0 ? len : 0);
+  int n = 0;
+  while (q + 11 <= end) {
+    uint8_t dem = (uint8_t)*q;
+    double est;
+    uint16_t l;
+    memcpy(&est, q + 1, 8);
+    memcpy(&l, q + 9, 2);
+    q += 11;
+    if (q + l > end) break;
+    TenantEntry* te = nullptr;
+    tenant_intern(tt, q, (size_t)l, &te);
+    q += l;
+    if (te) {
+      te->key_est.store(est, std::memory_order_relaxed);
+      te->demoted.store(dem != 0, std::memory_order_relaxed);
+    }
+    n++;
+  }
+  return n;
+}
+
+// Python-feed-path parse context (the ring engine sets it per datagram in
+// vrm_worker_main): subsequent vt_feed calls parse as `name`. Empty name
+// or disabled table -> default tenant / no tenant context.
+void vt_set_tenant(void* hp, const char* name, int name_len) {
+  auto* p = (Parser*)hp;
+  TenantTable* tt = p->rt().tenants.get();
+  if (!tt || !tt->enabled.load(std::memory_order_relaxed)) {
+    p->cur_tenant = 0;
+    p->cur_entry = nullptr;
+    p->cur_demoted = false;
+    return;
+  }
+  if (!name || name_len <= 0) {
+    p->cur_tenant = 0;
+    p->cur_entry = tt->dflt;
+  } else {
+    TenantEntry* te = nullptr;
+    p->cur_tenant = tenant_intern(*tt, name, (size_t)name_len, &te);
+    p->cur_entry = te;
+  }
+  p->cur_demoted =
+      p->cur_entry && p->cur_entry->demoted.load(std::memory_order_relaxed);
+}
+
+// Drain this parser's exact demoted-row counts as parallel id/count
+// arrays. Returns entries, or -entries_needed (nothing drained) when cap
+// is too small. Python-feed-path counterpart of vrm_tenant_counters.
+int vt_tenant_rows(void* hp, int32_t* ids, uint64_t* counts, int cap) {
+  auto* p = (Parser*)hp;
+  if (p->demoted_rows.empty()) return 0;
+  if ((int)p->demoted_rows.size() > cap)
+    return -(int)p->demoted_rows.size();
+  int n = 0;
+  for (auto& kv : p->demoted_rows) {
+    ids[n] = kv.first;
+    counts[n] = kv.second;
+    n++;
+  }
+  p->demoted_rows.clear();
+  return n;
+}
+
+// Standalone extraction (no parser handle) so tests can fuzz the exact
+// C++ tenant_extract against the Python mirror. Returns the value length
+// copied into out, 0 for default-tenant outcomes, -len_needed on a small
+// cap.
+int vt_tenant_extract(const char* tag, int tag_len, const char* data,
+                      int len, char* out, int cap) {
+  std::string t(tag ? tag : "", tag && tag_len > 0 ? (size_t)tag_len : 0);
+  const char* v = nullptr;
+  size_t vlen = 0;
+  if (!data || len <= 0 || !tenant_extract(t, data, (size_t)len, &v, &vlen))
+    return 0;
+  if (vlen > (size_t)(cap > 0 ? cap : 0)) return -(int)vlen;
+  memcpy(out, v, vlen);
+  return (int)vlen;
+}
+
+// Drain-and-reset ring i's exact per-(tenant, class) admission deltas and
+// its parser's demoted-row deltas, merged per tenant id. Output stride 7:
+// [admitted self, high, low, shed self, high, low, demoted_rows]. Returns
+// tenant count, or -count_needed (NOTHING drained) when cap is too small.
+// Callers must fold across ALL rings, like vrm_admission_counters.
+int vrm_tenant_counters(void* h, int ring, int32_t* ids, uint64_t* counts,
+                        int cap) {
+  auto* mr = (MultiRing*)h;
+  Ring* r = mr->rings[ring].get();
+  // r->mu guards adm.per_tenant, stage_mu guards parser.demoted_rows;
+  // scoped_lock avoids ordering against the worker's r->mu -> stage_mu
+  std::scoped_lock lk(r->mu, r->stage_mu);
+  std::unordered_map<int32_t, std::array<uint64_t, 7>> acc;
+  for (auto& kv : r->adm.per_tenant) {
+    auto& row = acc[kv.first];
+    for (int i = 0; i < 6; i++) row[i] += kv.second[i];
+  }
+  for (auto& kv : r->parser.demoted_rows) acc[kv.first][6] += kv.second;
+  if ((int)acc.size() > cap) return -(int)acc.size();
+  int n = 0;
+  for (auto& kv : acc) {
+    ids[n] = kv.first;
+    memcpy(counts + (size_t)n * 7, kv.second.data(), 7 * sizeof(uint64_t));
+    n++;
+  }
+  r->adm.per_tenant.clear();
+  r->parser.demoted_rows.clear();
+  return n;
 }
 
 void vrm_stop(void* h) {

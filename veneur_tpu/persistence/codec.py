@@ -129,6 +129,12 @@ def encode_to_dir(dirpath: str, snap: dict, fsync: bool = True) -> int:
                        json.dumps(snap["watches"],
                                   separators=(",", ":")).encode(),
                        None, None))
+    # tenant quarantine + demoted-row sidecar (JSON; same rule)
+    if snap.get("tenants"):
+        chunks.append(("tenants",
+                       json.dumps(snap["tenants"],
+                                  separators=(",", ":")).encode(),
+                       None, None))
     # history ring sidecar (veneur_tpu/history/): one JSON meta chunk
     # (spec + seq + key index) plus one raw-bytes chunk per ring array.
     # Same unknown-chunk rule — old readers skip all of them.
@@ -286,6 +292,12 @@ def load_dir(dirpath: str) -> dict:
             watches = json.loads(chunks["watches"])
         except ValueError as e:
             raise CorruptSnapshot(f"{dirpath}: watches chunk: {e}")
+    tenants = None
+    if chunks.get("tenants"):
+        try:
+            tenants = json.loads(chunks["tenants"])
+        except ValueError as e:
+            raise CorruptSnapshot(f"{dirpath}: tenants chunk: {e}")
     history = None
     if chunks.get("history"):
         try:
@@ -314,6 +326,7 @@ def load_dir(dirpath: str) -> dict:
         "forward": forward,
         "watches": watches,
         "history": history,
+        "tenants": tenants,
     }
 
 

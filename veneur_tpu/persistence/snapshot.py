@@ -59,7 +59,8 @@ def build_snapshot(spec, table: KeyTable, result: Dict[str, np.ndarray],
                    spill_entries: int = 0,
                    forward_meta: Optional[dict] = None,
                    watches: Optional[dict] = None,
-                   history: Optional[dict] = None) -> dict:
+                   history: Optional[dict] = None,
+                   tenants: Optional[dict] = None) -> dict:
     """`result`/`raw` are compute_flush's outputs for the interval being
     checkpointed (want_raw=True — both backends emit identical raw keys).
     `table` is the interval's detached KeyTable."""
@@ -122,4 +123,7 @@ def build_snapshot(spec, table: KeyTable, result: Dict[str, np.ndarray],
         # history ring sidecar (veneur_tpu/history/): key index + raw
         # window arrays, restored byte-exact; None/absent = tier off
         "history": history,
+        # tenant quarantine table + exact demoted-row totals
+        # (veneur_tpu/reliability/tenancy.py); None/absent = tier off
+        "tenants": tenants,
     }

@@ -131,6 +131,7 @@ class OverloadController:
                  timer_sample_rate: float = 0.5,
                  set_shift: int = 2,
                  shed_priority_tags: Iterable = (),
+                 tenancy=None,
                  clock: Callable[[], float] = time.monotonic):
         self._signals = signals
         self._clock = clock
@@ -144,6 +145,10 @@ class OverloadController:
         self.timer_sample_rate = float(timer_sample_rate)
         self.set_shift = int(set_shift)
         self.classifier = PriorityClassifier(shed_priority_tags)
+        # optional reliability/tenancy.py TenantFairness: layers the
+        # weighted per-tenant bucket under the class ladder at SHEDDING+
+        # and receives per-(tenant, class) counts for every decision
+        self.tenancy = tenancy
         self._buckets: Dict[str, TokenBucket] = {}
         # accounting: exact per-class admit/shed counters. The lock only
         # guards the increments — imports arrive on gRPC/HTTP threads
@@ -238,20 +243,32 @@ class OverloadController:
         """Admission decision for one raw wire packet at an ingest
         boundary. Token buckets are keyed per (source, class) so a
         flood of low-priority traffic cannot starve high-priority
-        packets out of their own bucket."""
+        packets out of their own bucket. With tenancy configured, the
+        tenant's weighted bucket layers under the class ladder at
+        SHEDDING+ (mirror of dogstatsd.cpp admit_datagram2): low-class
+        traffic the ladder would shed outright instead runs the
+        tenant's bucket, so isolated tenants keep their budget while a
+        noisy one is throttled to its share."""
         cls = self.classifier.classify(data)
         s = self.state
+        ten = self.tenancy
+        tenant = ten.resolve(data) if ten is not None else None
+        fair = ten is not None and ten.base_rate > 0
         if s == HEALTHY or cls == CLASS_SELF:
             ok = True
         elif cls == CLASS_HIGH:
             ok = s < CRITICAL or self._bucket_allow(source + "/high")
+            if ok and fair and s >= SHEDDING:
+                ok = ten.allow(tenant)
         elif s >= SHEDDING:
-            ok = False
+            ok = ten.allow(tenant) if fair else False
         else:  # low priority under PRESSURED
             ok = self._bucket_allow(source)
         with self._lock:
             d = self.admitted if ok else self.shed
             d[cls] = d.get(cls, 0) + 1
+        if ten is not None:
+            ten.count(tenant, cls, ok)
         return ok
 
     def import_blocked(self) -> bool:
@@ -330,6 +347,10 @@ class OverloadController:
             for cls, n in drained.get("shed", {}).items():
                 if n:
                     self.shed[cls] = self.shed.get(cls, 0) + int(n)
+        # per-tenant deltas (already summed across rings by the drain
+        # fold) route to the tenancy ledger, same exactness contract
+        if self.tenancy is not None and drained.get("tenants"):
+            self.tenancy.fold_native(drained["tenants"])
 
     # -- poller thread -------------------------------------------------------
     def start(self, poll_interval: float,

@@ -330,14 +330,17 @@ class NativeAggregator(Aggregator):
     # -- native UDP reader group ---------------------------------------------
     def readers_start(self, fds, max_len: int = 65536,
                       ring_cap: int = 65536, n_rings: int = 1,
-                      pin_cores=None) -> None:
+                      pin_cores=None, force_rings: bool = False) -> None:
         """Start the native readers. n_rings == 1 keeps the proven
         single-ring vr_* engine (N reader threads -> one ring -> this
         thread's pump); n_rings > 1 starts the multi-ring vrm_* engine:
         one ring + parser + packed arena row per reader core, fds
         distributed round-robin across rings (each SO_REUSEPORT fd owns
-        its ring), optional sched_affinity pinning per ring."""
-        if n_rings <= 1:
+        its ring), optional sched_affinity pinning per ring.
+        force_rings routes even a 1-ring config through the vrm engine —
+        tenant fairness lives only there (the vr_* path stays
+        tenant-blind), so a tenancy-enabled server must set it."""
+        if n_rings <= 1 and not force_rings:
             self.eng.readers_start(fds, max_len=max_len, ring_cap=ring_cap)
             return
         # every fd must own a ring (vrm readers are 1:1 with rings) — a
@@ -439,6 +442,24 @@ class NativeAggregator(Aggregator):
     def admission_drain(self) -> dict:
         """Exact per-class {admitted, shed} deltas since the last drain."""
         return self.eng.admission_drain()
+
+    # -- tenant fairness/quarantine push-down (reliability/tenancy.py) -------
+    def tenant_config(self, *a, **kw) -> None:
+        """One-shot tenant-table creation; must land before rings start."""
+        self.eng.tenant_config(*a, **kw)
+
+    def tenant_params(self, base_rate: float, weights) -> None:
+        self.eng.tenant_params(base_rate, weights)
+
+    def tenant_table(self) -> dict:
+        """Non-destructive {tenant: {demoted, key_est}} engine snapshot."""
+        return self.eng.tenant_table()
+
+    def tenant_restore(self, entries) -> int:
+        return self.eng.tenant_restore(entries)
+
+    def tenant_rows_drain(self) -> dict:
+        return self.eng.tenant_rows_drain()
 
     def readers_stop(self) -> None:
         self.eng.readers_stop()
@@ -686,6 +707,11 @@ class NativeShardedAggregator(ShardedAggregator):
     readers_start = NativeAggregator.readers_start
     admission_set = NativeAggregator.admission_set
     admission_drain = NativeAggregator.admission_drain
+    tenant_config = NativeAggregator.tenant_config
+    tenant_params = NativeAggregator.tenant_params
+    tenant_table = NativeAggregator.tenant_table
+    tenant_restore = NativeAggregator.tenant_restore
+    tenant_rows_drain = NativeAggregator.tenant_rows_drain
     reader_counters = NativeAggregator.reader_counters
     ring_stats = NativeAggregator.ring_stats
     ring_stats_per_ring = NativeAggregator.ring_stats_per_ring

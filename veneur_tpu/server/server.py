@@ -556,6 +556,23 @@ class Server:
         self._overload = None
         self._restore_complete = not (cfg.checkpoint_dir
                                       and cfg.restore_on_start)
+        # -- multi-tenant fairness (veneur_tpu/reliability/tenancy.py) ----
+        # Off by default: no identity extraction anywhere. With tenancy
+        # on, the TenantFairness ledger exists even without the overload
+        # controller — identity and accounting are useful on their own;
+        # the fairness buckets only bite at SHEDDING+ via the controller.
+        self.tenancy = None
+        self._tenant_restore_entries = None
+        if cfg.tenant_enabled:
+            from veneur_tpu.reliability.tenancy import TenantFairness
+            self.tenancy = TenantFairness(
+                tag=cfg.tenant_tag,
+                weights=cfg.tenant_weights,
+                base_rate=cfg.tenant_fair_rate,
+                burst_mult=cfg.tenant_fair_burst_mult,
+                quarantine_max_keys=cfg.tenant_quarantine_max_keys,
+                quarantine_decay=cfg.tenant_quarantine_decay,
+                quarantine_readmit_frac=cfg.tenant_quarantine_readmit_frac)
         if cfg.overload_enabled:
             from veneur_tpu.reliability.overload import OverloadController
             self._overload = OverloadController(
@@ -569,7 +586,8 @@ class Server:
                 admit_burst=cfg.overload_admit_burst,
                 timer_sample_rate=cfg.overload_timer_sample_rate,
                 set_shift=cfg.overload_set_shift,
-                shed_priority_tags=cfg.shed_priority_tags)
+                shed_priority_tags=cfg.shed_priority_tags,
+                tenancy=self.tenancy)
 
         # -- elastic live resharding (veneur_tpu/reshard/) ----------------
         # Off by default: no coordinator, and the flush-path gate is a
@@ -940,6 +958,30 @@ class Server:
                    labelnames=("kind",),
                    help="samples statistically subsumed (not staged) by "
                         "degraded timer sampling / set subsampling")
+        # multi-tenant fairness — [] while tenancy is disabled keeps the
+        # labeled families out of the exposition entirely
+        M.callback("veneur.tenant.admitted_total",
+                   lambda: (self.tenancy.admitted_snapshot()
+                            if self.tenancy is not None else []),
+                   kind="counter", labelnames=("tenant",),
+                   help="datagrams admitted past admission, by tenant")
+        M.callback("veneur.tenant.shed_total",
+                   lambda: (self.tenancy.shed_snapshot()
+                            if self.tenancy is not None else []),
+                   kind="counter", labelnames=("tenant",),
+                   help="datagrams refused by admission, by tenant")
+        M.callback("veneur.tenant.quarantined",
+                   lambda: (self.tenancy.quarantined_snapshot()
+                            if self.tenancy is not None else []),
+                   labelnames=("tenant",),
+                   help="1 while the tenant is demoted to aggregate-only "
+                        "rollup rows by the tag-explosion detector")
+        M.callback("veneur.tenant.demoted_rows_total",
+                   lambda: (self.tenancy.demoted_rows_snapshot()
+                            if self.tenancy is not None else []),
+                   kind="counter", labelnames=("tenant",),
+                   help="rows collapsed onto per-tenant rollup keys "
+                        "while quarantined (exact)")
 
     # -- registry collector helpers -----------------------------------------
     def _ring_stats(self) -> dict:
@@ -1122,9 +1164,56 @@ class Server:
         try:
             state, rate, burst, tags = ov.native_admission_params()
             self.aggregator.admission_set(True, state, rate, burst, tags)
+            # the drain's "tenants" sub-dict routes through
+            # fold_native_counts into the tenancy ledger, so per-tenant
+            # counts ride the same exactly-once fold as the class counts
             ov.fold_native_counts(self.aggregator.admission_drain())
+            self._sync_native_tenancy(drain=False)
         except Exception as e:
             log.warning("native admission sync failed: %s", e)
+
+    def _push_tenant_config(self) -> None:
+        """One-time tenant push-down, BEFORE rings start (the tag is
+        read lock-free on the C++ admission path): create the engine
+        table, replay checkpointed quarantine state, seed weights."""
+        ten = self.tenancy
+        fn = getattr(self.aggregator, "tenant_config", None)
+        if ten is None or fn is None:
+            return
+        try:
+            fn(**ten.native_config())
+            if self._tenant_restore_entries:
+                self.aggregator.tenant_restore(
+                    self._tenant_restore_entries)
+                self._tenant_restore_entries = None
+            base_rate, weights = ten.native_params()
+            self.aggregator.tenant_params(base_rate, weights)
+        except Exception as e:
+            log.warning("tenant config push-down failed: %s", e)
+
+    def _sync_native_tenancy(self, drain: bool) -> None:
+        """Per-tick tenant sync with the C++ engine: push base rate +
+        weights, refresh the quarantine mirror from the engine table.
+        With `drain`, also fold the per-tenant admission deltas into
+        the tenancy ledger directly — used only when no overload
+        controller owns the admission_drain fold (tenancy without
+        overload, or overload_native_admission off)."""
+        ten = self.tenancy
+        if ten is None or not self._native_readers_active \
+                or not hasattr(self.aggregator, "tenant_params"):
+            return
+        try:
+            base_rate, weights = ten.native_params()
+            self.aggregator.tenant_params(base_rate, weights)
+            ten.update_table(self.aggregator.tenant_table())
+            if drain:
+                drained = self.aggregator.admission_drain()
+                if self._overload is not None:
+                    self._overload.fold_native_counts(drained)
+                elif drained.get("tenants"):
+                    ten.fold_native(drained["tenants"])
+        except Exception as e:
+            log.warning("tenant sync failed: %s", e)
 
     # -- tag exclusion wiring (server.go:1467-1510) -------------------------
     def _wire_excluded_tags(self):
@@ -1870,6 +1959,12 @@ class Server:
                 self._threads.append(lt)
 
         if native_reader_fds:
+            # tenant identity/quarantine live in the multi-ring engine's
+            # admission path: config must land before any ring thread
+            # exists, and a 1-ring tenant config still routes through the
+            # vrm engine (force_rings) instead of the tenant-blind vr one
+            if self.tenancy is not None:
+                self._push_tenant_config()
             # +1 so the kernel flags (MSG_TRUNC) any datagram OVER the
             # limit; the C++ reader drops it whole and counts toolong —
             # the same guard as the Python reader / the reference
@@ -1877,12 +1972,15 @@ class Server:
                 native_reader_fds,
                 max_len=(self.cfg.metric_max_length or 65536) + 1,
                 n_rings=n_rings,
-                pin_cores=list(self.cfg.reader_pin_cores) or None)
+                pin_cores=list(self.cfg.reader_pin_cores) or None,
+                force_rings=self.tenancy is not None)
             self._native_readers_active = True
             # arm ring admission from the first datagram — the poller's
             # first tick is up to poll_interval away
             if self._overload is not None:
                 self._sync_native_admission(self._overload)
+            else:
+                self._sync_native_tenancy(drain=False)
 
         # SSF span listeners (networking.go:198 StartSSF)
         self.span_pipeline.start()
@@ -2161,7 +2259,8 @@ class Server:
                 spill_entries=spill_n,
                 forward_meta=self._forward_meta_snapshot(),
                 watches=self._watch_snapshot(),
-                history=self._history_snapshot())
+                history=self._history_snapshot(),
+                tenants=self._tenant_snapshot())
             self._ckpt_writer.submit(snap)
         except Exception:
             log.exception("checkpoint snapshot build failed; interval "
@@ -2176,6 +2275,14 @@ class Server:
         if self.watch_engine is None:
             return None
         return self.watch_engine.snapshot()
+
+    def _tenant_snapshot(self) -> Optional[dict]:
+        """Tenant quarantine state (engine table mirror + exact
+        demoted-row totals) for the checkpoint's sidecar chunk. None
+        (chunk omitted) when tenancy is off."""
+        if self.tenancy is None:
+            return None
+        return self.tenancy.snapshot_state()
 
     def _history_snapshot(self) -> Optional[dict]:
         """History ring (device arrays + host key index) for the
@@ -2266,6 +2373,13 @@ class Server:
                 # a spec mismatch keeps the fresh ring (history is a
                 # cache of flushed intervals, never source of truth)
                 self.history.restore(snap["history"])
+            if snap.get("tenants") and self.tenancy is not None:
+                # quarantine state survives the restart: the entries are
+                # stashed here and pushed into the engine right after
+                # tenant_config creates its table (rings start later in
+                # start(), so demotion resumes from the first datagram)
+                self._tenant_restore_entries = \
+                    self.tenancy.restore_state(snap["tenants"])
             self._c_ckpt_restores.inc()
             log.info("restored %d metrics from %s (interval_ts=%d)",
                      n, path, snap["interval_ts"])
@@ -2299,6 +2413,13 @@ class Server:
         # in _flush_worker (state already swapped; next interval clean)
         FAULTS.inject(FLUSH_WORKER)
         flush_t0 = time.perf_counter()
+        # tenant ledger/mirror sync when the overload poller isn't
+        # already folding it each tick (tenancy without the controller,
+        # or native admission push-down disabled)
+        if self.tenancy is not None and not (
+                self._overload is not None
+                and self.cfg.overload_native_admission):
+            self._sync_native_tenancy(drain=True)
         # stamp with the interval's swap time, not the job's run time — a
         # queued interval must not shift into the next time bucket
         ts = int(swapped_at)
@@ -3208,13 +3329,30 @@ class Server:
                 self._packets_toolong_py += rc["toolong"]
                 # final admission drain for the same reason: shed/admit
                 # decisions since the last poll tick must land in the
-                # registry before the counters become unreachable
+                # registry before the counters become unreachable (the
+                # drain's "tenants" sub-dict rides along, so per-tenant
+                # accounting survives a rolling restart exactly)
                 if self._overload is not None:
                     try:
                         self._overload.fold_native_counts(
                             self.aggregator.admission_drain())
                     except Exception:
                         log.exception("native admission drain failed")
+                elif self.tenancy is not None:
+                    try:
+                        drained = self.aggregator.admission_drain()
+                        if drained.get("tenants"):
+                            self.tenancy.fold_native(drained["tenants"])
+                    except Exception:
+                        log.exception("tenant drain failed")
+                # the quarantine mirror must be current before the
+                # shutdown checkpoint snapshots it below
+                if self.tenancy is not None:
+                    try:
+                        self.tenancy.update_table(
+                            self.aggregator.tenant_table())
+                    except Exception:
+                        log.exception("tenant table snapshot failed")
             self._native_readers_active = False
         for s in self._sockets:
             try:
@@ -3341,7 +3479,8 @@ class Server:
                         spill_entries=spill_n,
                         forward_meta=self._forward_meta_snapshot(),
                         watches=self._watch_snapshot(),
-                        history=self._history_snapshot()))
+                        history=self._history_snapshot(),
+                        tenants=self._tenant_snapshot()))
                 except Exception:
                     log.exception("final checkpoint failed; last periodic "
                                   "checkpoint remains newest")
