@@ -589,15 +589,22 @@ def config5_span_firehose(scale=1.0):
 
 
 def config6_cardinality_stress(scale=1.0):
-    """10M unique names across every metric type — SURVEY §7's declared
-    hardest part. Measures what no other config isolates: host key-
-    dictionary throughput (first-touch alloc vs steady-state hit),
-    capacity-drop accounting at deliberate slot-table saturation (the
-    counter table is sized to 90% of the counter names; the report
-    asserts the dropped count is EXACTLY the over-capacity attempts),
-    packed H2D feed bandwidth, and flush wall time at full live
-    cardinality through the columnar frame path (per-metric object
-    labeling would be ~20s host time at 10M; see flusher.MetricFrame)."""
+    """10M LIVE names across every metric type — SURVEY §7's declared
+    hardest part, absorbed by the self-adjusting key tables (README
+    §Key tables) instead of the old fixed-90% saturation drill. The
+    counter table starts at ~1/8 of the counter name space and a
+    "cardinality march" feeds ever-larger prefixes with a flush between
+    steps, so the manager's high-water doubling grows it live to the
+    full population; the first march step deliberately overshoots the
+    initial capacity so the report can assert the dropped count is
+    EXACTLY the over-capacity attempts. Beyond the growth story the
+    config still measures host key-dictionary throughput (first-touch
+    alloc vs steady-state hit), packed H2D feed bandwidth, and flush
+    wall time at full live cardinality through the columnar frame path
+    (per-metric object labeling would be ~20s host time at 10M; see
+    flusher.MetricFrame). Gates: drop_fraction < 1% always; the
+    grow-pause-fits-one-flush-interval gate arms on TPU only (a CPU
+    grow pause is dominated by the XLA recompile for the new shape)."""
     from veneur_tpu.sinks.blackhole import BlackholeMetricSink
 
     names_total = max(50_000, int(10_000_000 * scale))
@@ -616,7 +623,9 @@ def config6_cardinality_stress(scale=1.0):
     if n_s > set_row_cap:
         n_c += n_s - set_row_cap
         n_s = set_row_cap
-    cap_c = int(n_c * 0.9)   # deliberate 10% counter saturation
+    # counters carry the growth story: start at ~n_c/8 (power of two)
+    # and let the flush-boundary grow ladder reach the full population
+    cap_c0 = 1 << max(12, (n_c // 8).bit_length())
 
     def build_payloads():
         per = 200
@@ -640,20 +649,27 @@ def config6_cardinality_stress(scale=1.0):
         return payloads
 
     payloads = build_payloads()
+    n_pay_c = n_c // 200            # pure-counter payload prefix
     sink = BlackholeMetricSink()
     srv = _mk_server(
         [sink],
-        tpu_counter_capacity=cap_c, tpu_gauge_capacity=n_g + 64,
-        tpu_set_capacity=n_s + 64, tpu_histo_capacity=n_t + 64,
+        table_grow_enabled=True,
+        table_max_capacity=max(1 << 24, 4 * n_c),
+        tpu_counter_capacity=cap_c0,
+        # static kinds carry >15% headroom so the 85% high-water mark
+        # never triggers growth the bench didn't script
+        tpu_gauge_capacity=int(n_g * 1.25) + 64,
+        tpu_set_capacity=int(n_s * 1.25) + 64,
+        tpu_histo_capacity=int(n_t * 1.25) + 64,
         tpu_status_capacity=64,
         tpu_batch_counter=1 << 16, tpu_batch_gauge=1 << 15,
         tpu_batch_set=1 << 14, tpu_batch_histo=1 << 14,
         tpu_compact_every=8)
     try:
         _warm(srv, [b"warm.c6:1|c"])
-        key_drops = n_c - cap_c     # per pass: every over-capacity name
         stats = {}
         import jax
+        on_tpu = jax.default_backend() == "tpu"
 
         def _device_sync():
             # jax dispatch is async: _drain returns when parsing/staging
@@ -661,6 +677,49 @@ def config6_cardinality_stress(scale=1.0):
             # device. Without this barrier pass A's compute bleeds into
             # pass B's timer (observed 7x skew at 1M names on CPU).
             jax.block_until_ready(jax.tree.leaves(srv.aggregator.state))
+
+        def _feed_counters(k):      # first k pure-counter payloads
+            done0 = (srv.aggregator.processed
+                     + srv.aggregator.dropped_capacity)
+            _feed_queue(srv, payloads[:k])
+            _drain(srv, done0 + k * 200)
+            _device_sync()
+
+        # -- cardinality march: grow live to the full population ------
+        # each step feeds a prefix sized against the CURRENT capacity
+        # (over the high-water mark, under the slot count → no drops),
+        # then flushes; the manager doubles the counter table at that
+        # swap. Only the first step overshoots the slot count, so total
+        # drops are exactly that step's over-capacity attempts.
+        phase("march")
+        march_attempts = 0
+        overshoot_expected = None
+        pause_ns = []
+        cap = srv.aggregator.spec.counter_capacity
+        assert cap == cap_c0
+        # march until the FULL population sits under the high-water
+        # mark — stopping at bare residency would leave steady-state
+        # demand over 85% and the first cycle flush would re-grow
+        # (an unscripted compile inside the measured window)
+        while cap * 0.85 < n_c + 64:
+            if overshoot_expected is None:
+                k = min(int(cap * 1.10), n_c) // 200
+                overshoot_expected = max(0, k * 200 - cap)
+            else:
+                k = min(int(cap * 0.97), n_c) // 200
+            k = min(k, n_pay_c)
+            _feed_counters(k)
+            march_attempts += k * 200
+            # every march flush pays the compile for the grown spec —
+            # the grow pause the report records is exactly this swap
+            _flush_checked(srv, timeout=3 * WARM_TIMEOUT)
+            newcap = srv.aggregator.spec.counter_capacity
+            if newcap == cap:
+                break               # demand already fits: march done
+            pause_ns.append(srv.tables.last_grow_swap_ns)
+            cap = newcap
+        assert cap * 0.85 >= n_c + 64, f"march stalled at capacity {cap}"
+        grow_flushes = len(pause_ns)
 
         for cycle in range(2):      # cycle 0 absorbs every compile
             phase(f"cycle{cycle}")
@@ -688,30 +747,48 @@ def config6_cardinality_stress(scale=1.0):
             stats = dict(t_alloc=t_alloc, t_hit=t_hit, t_flush=t_flush,
                          h2d=h2d, rows=sink.frames_rows - rows0)
 
-        live = names_total - key_drops
-        # defaults from _mk_server: 3 aggregates + 3 percentiles per timer
-        expected_rows = cap_c + n_g + n_s + 6 * n_t
+        # defaults from _mk_server: 3 aggregates + 3 percentiles per
+        # timer. Every name is resident now — growth absorbed the full
+        # population, so no capacity truncation term remains.
+        expected_rows = n_c + n_g + n_s + 6 * n_t
         dropped = srv.aggregator.dropped_capacity
-        total_attempts = 2 * 2 * names_total   # 2 cycles x 2 passes
+        total_attempts = march_attempts + 2 * 2 * names_total
         # self-telemetry shares the pipeline by design (the reference
         # always tallies flush totals back into itself, flusher.go:300-336)
-        # and the saturated counter table drops its counter-typed names —
-        # so accounting is checked to a band of a few dozen self-metrics
-        # around the exact over-capacity prediction, with the raw error
-        # reported. The warm-up key costs one slot in cycle 0 (+2).
-        drop_err = dropped - (2 * 2 * key_drops + 2)
+        # and its counter-typed names contend for slots in the one
+        # over-full march interval — so accounting is checked to a band
+        # of a few dozen self-metrics around the exact over-capacity
+        # prediction, with the raw error reported.
+        drop_err = dropped - overshoot_expected
         rows_err = stats["rows"] - expected_rows
+        drop_fraction = dropped / total_attempts
+        pause_ms = max(pause_ns) / 1e6 if pause_ns else 0.0
         return {
             "config": 6, "name": "cardinality_10M_stress",
-            "names": names_total, "live_keys": live,
+            "names": names_total, "live_keys": names_total,
             "mix": {"counter": n_c, "gauge": n_g, "timer": n_t,
                     "set": n_s},
+            "counter_capacity_initial": cap_c0,
+            "counter_capacity_final": cap,
+            "grow_flushes": grow_flushes,
+            "grow_events": srv.tables.grow_events,
+            "grows": dict(srv.tables.grows),
+            # the grow pause IS the swap pause (README §Key tables); the
+            # one-flush-interval bound is gated on TPU where the ingest
+            # program for the grown spec is pre-built off the swap path —
+            # a CPU pause is dominated by the XLA recompile instead
+            "grow_pause_ms_max": round(pause_ms, 2),
+            "grow_pause_gate_armed": on_tpu,
+            "grow_pause_le_interval": ((pause_ms / 1e3 <= 10.0)
+                                       if on_tpu else None),
             "samples_per_sec": round(
                 2 * names_total / (stats["t_alloc"] + stats["t_hit"]), 1),
-            "alloc_keys_per_sec": round(live / stats["t_alloc"], 1),
+            "alloc_keys_per_sec": round(
+                names_total / stats["t_alloc"], 1),
             "hit_samples_per_sec": round(
                 names_total / stats["t_hit"], 1),
-            "drop_fraction": round(dropped / total_attempts, 5),
+            "drop_fraction": round(drop_fraction, 5),
+            "drop_fraction_lt_1pct": drop_fraction < 0.01,
             "drop_accounting_err_keys": drop_err,
             "drop_accounting_exact": 0 <= drop_err <= 64,
             "flush_rows": stats["rows"],
@@ -2384,14 +2461,16 @@ def config15_tenant_storm(scale=1.0):
 
     def _inject(srv, grams):
         """Lossless feed through the REAL admission choke point
-        (ring_push), deterministic round-robin placement. Paced so a
-        ring can never overflow post-admission — a ring-full drop after
-        the admitted count would break exactness."""
+        (ring_push), deterministic round-robin placement. A full ring
+        answers INJECT_BACKPRESSURE — nothing counted — so the retry
+        loop is exact; the depth check keeps the pacing coarse."""
+        from veneur_tpu.native import INJECT_BACKPRESSURE
         eng = srv.aggregator.eng
         nr = max(1, eng.n_rings)
         counters = srv.aggregator.reader_counters
         for i, g in enumerate(grams):
-            eng.rings_inject(i % nr, g)
+            while eng.rings_inject(i % nr, g) == INJECT_BACKPRESSURE:
+                time.sleep(0.002)
             if (i & 0xFFF) == 0xFFF and counters()["ring_depth"] > 32_000:
                 while counters()["ring_depth"] > 8_000:
                     time.sleep(0.005)

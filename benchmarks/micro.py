@@ -298,9 +298,12 @@ def bench_pipeline_pump_mc(seconds, n_rings=4):
 
         def one_round():
             nonlocal sent
+            from veneur_tpu.native import INJECT_BACKPRESSURE
             target = agg.processed + per_round
             for i, buf in enumerate(bufs):
-                agg.eng.rings_inject(i % rings, buf)
+                while agg.eng.rings_inject(
+                        i % rings, buf) == INJECT_BACKPRESSURE:
+                    time.sleep(0.001)   # ring full: uncounted, retry
             sent += len(bufs)
             # generous: round 1 pays the R-row arena program compile
             # inside the first pump; later rounds finish in ms

@@ -484,3 +484,149 @@ def test_elastic_resize_receiver_crash_mid_transfer():
     assert crashed["replays"] == 1 and crashed["dup_suppressed"] >= 1
     assert not clean["failed"] and clean["replays"] == 0
     assert FAULTS.fired(RESHARD_FOLD) == 1
+
+
+# -- self-adjusting key tables under crashes (ISSUE 20 satellites) -----------
+
+def _send_chunked(addr, lines, per=25):
+    """_send_udp in reader-buffer-sized datagrams: the grow drills feed
+    400 distinct names, which joined into one datagram would truncate
+    at the UDP read size."""
+    import time as _time
+    for i in range(0, len(lines), per):
+        _send_udp(addr, lines[i:i + per])
+        _time.sleep(0.002)
+
+
+def test_grow_kill_before_sidecar_checkpoint_regrows_cleanly(tmp_path):
+    """Crash between the grow swap and its sidecar checkpoint (the
+    checkpoint write is faulted): the restart finds no snapshot, cold
+    starts at config capacities without a torn table, and the very next
+    over-water flush re-plans the same grow — demand is re-observed,
+    never lost."""
+    from veneur_tpu.reliability.faults import CHECKPOINT_WRITE
+    from veneur_tpu.persistence import list_checkpoints
+
+    base = dict(native_ingest=False, table_grow_enabled=True,
+                checkpoint_dir=str(tmp_path / "ckpt"),
+                checkpoint_interval_flushes=1,
+                checkpoint_on_shutdown=False)
+    lines = [b"gkr.c%d:1|c" % i for i in range(400)]
+    srv1 = Server(small_config(**base), metric_sinks=[DebugMetricSink()])
+    srv1.start()
+    try:
+        _send_chunked(srv1.local_addr(), lines)
+        _wait_processed(srv1, 256)          # capacity drops excluded
+        _wait_until(lambda: srv1.aggregator.dropped_capacity == 144)
+        FAULTS.arm(CHECKPOINT_WRITE, error=True, times=1)
+        assert srv1.trigger_flush()         # grows AND fails the ckpt
+        assert srv1.aggregator.spec.counter_capacity == 512
+        assert FAULTS.fired(CHECKPOINT_WRITE) == 1
+        assert srv1._ckpt_writer.wait_idle(30.0)
+        assert list_checkpoints(base["checkpoint_dir"]) == []
+    finally:
+        srv1.shutdown()
+
+    sink = DebugMetricSink()
+    srv2 = Server(small_config(restore_on_start=True, **base),
+                  metric_sinks=[sink])
+    srv2.start()
+    try:
+        assert srv2.aggregator.spec.counter_capacity == 256
+        assert srv2.tables.grows == {}
+        _send_chunked(srv2.local_addr(), lines)
+        _wait_processed(srv2, 256)
+        _wait_until(lambda: srv2.aggregator.dropped_capacity == 144)
+        assert srv2.trigger_flush()
+        assert srv2.aggregator.spec.counter_capacity == 512
+        assert srv2.tables.grows == {"counter": 1}
+        assert sum(1 for m in sink.flushed
+                   if m.name.startswith("gkr.")) == 256
+    finally:
+        srv2.shutdown()
+
+
+def test_grow_kill_after_sidecar_checkpoint_restores_grown(tmp_path):
+    """Kill right after the grow interval's checkpoint landed (no
+    graceful shutdown snapshot): restore adopts the sidecar capacities
+    BEFORE folding, the restored rows fold without drops, and the grow
+    accounting survives the restart."""
+    base = dict(native_ingest=False, table_grow_enabled=True,
+                checkpoint_dir=str(tmp_path / "ckpt"),
+                checkpoint_interval_flushes=1,
+                checkpoint_on_shutdown=False)
+    lines = [b"gks.c%d:1|c" % i for i in range(400)]
+    srv1 = Server(small_config(**base), metric_sinks=[DebugMetricSink()])
+    srv1.start()
+    try:
+        _send_chunked(srv1.local_addr(), lines)
+        _wait_processed(srv1, 256)
+        _wait_until(lambda: srv1.aggregator.dropped_capacity == 144)
+        assert srv1.trigger_flush()         # grow + sidecar checkpoint
+        assert srv1.aggregator.spec.counter_capacity == 512
+        assert srv1._ckpt_writer.wait_idle(30.0)
+        assert srv1._ckpt_writer.writes == 1
+    finally:
+        srv1.shutdown()                     # kill: no final snapshot
+
+    sink = DebugMetricSink()
+    srv2 = Server(small_config(restore_on_start=True, **base),
+                  metric_sinks=[sink])
+    srv2.start()
+    try:
+        # sidecar adopted before fold: grown capacity, zero fold drops
+        assert srv2.aggregator.spec.counter_capacity == 512
+        assert srv2.tables.grows == {"counter": 1}
+        assert srv2._c_ckpt_restores.value() == 1
+        assert srv2.aggregator.dropped_capacity == 0
+        # the full 400-name population now fits in one interval: the
+        # 256 restored rows accumulate on top of the fresh feed
+        _send_chunked(srv2.local_addr(), lines)
+        _wait_until(lambda: len(srv2.aggregator.table.tables["counter"]
+                               .by_key) == 400,
+                    what="400 names resident after refeed")
+        assert srv2.aggregator.dropped_capacity == 0
+        assert srv2.trigger_flush()
+        got = {m.name: m.value for m in sink.flushed
+               if m.name.startswith("gks.")}
+        assert len(got) == 400
+        assert sum(1 for v in got.values() if v == 2.0) == 256
+        assert sum(1 for v in got.values() if v == 1.0) == 144
+    finally:
+        srv2.shutdown()
+
+
+def test_grow_during_reshard_is_409_and_flush_hook_defers():
+    """A reshard owns the swap boundary: trigger_table_grow raises
+    GrowConflict (.status == 409) and the flush hook skips planning —
+    the grow happens on the first flush AFTER the move completes."""
+    from types import SimpleNamespace
+    from veneur_tpu.tables.growth import GrowConflict
+
+    srv = Server(small_config(native_ingest=False,
+                              table_grow_enabled=True),
+                 metric_sinks=[DebugMetricSink()])
+    srv.start()
+    try:
+        _send_chunked(srv.local_addr(),
+                      [b"g409.c%d:1|c" % i for i in range(400)])
+        _wait_processed(srv, 256)
+        _wait_until(lambda: srv.aggregator.dropped_capacity == 144)
+        srv.reshard = SimpleNamespace(
+            active=True, complete_pending_folds=lambda *a, **k: None)
+        with pytest.raises(GrowConflict) as exc:
+            srv.trigger_table_grow({"counter": 512})
+        assert exc.value.status == 409
+        assert srv.trigger_flush()          # planning deferred, no grow
+        assert srv.aggregator.spec.counter_capacity == 256
+        assert srv.tables.grows == {}
+        srv.reshard = None                  # move complete: next flush
+        assert srv.trigger_flush()          # re-observes the demand
+        _send_chunked(srv.local_addr(),
+                      [b"g409.c%d:1|c" % i for i in range(400)])
+        _wait_until(lambda: srv.aggregator.dropped_capacity > 144)
+        assert srv.trigger_flush()
+        assert srv.aggregator.spec.counter_capacity == 512
+        assert srv.tables.grows == {"counter": 1}
+    finally:
+        srv.shutdown()

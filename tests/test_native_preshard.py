@@ -262,10 +262,12 @@ def test_multiring_swap_quiesce_under_concurrent_inject():
     stop = threading.Event()
 
     def injector(t):
+        from veneur_tpu.native import INJECT_BACKPRESSURE
         for i in range(n_per_thread):
             ln = b"mr.t%d.k%d:1|c" % (t, i % 19)
-            while not agg.eng.rings_inject((t * 2 + i) % 4, ln):
-                time.sleep(0.001)   # ring momentarily full
+            while agg.eng.rings_inject((t * 2 + i) % 4,
+                                       ln) == INJECT_BACKPRESSURE:
+                time.sleep(0.001)   # ring full: uncounted, retry exact
             sent[t] += 1
         stop.set() if sent[0] + sent[1] == 2 * n_per_thread else None
 

@@ -27,7 +27,8 @@ from veneur_tpu.analysis import (PASSES, ambiguous_paths, accounting_flow,
                                  hot_path_alloc, jax_hot_path,
                                  lock_discipline, metric_names,
                                  reshard_quiesce, run_passes,
-                                 snapshot_schema, timer_sync)
+                                 snapshot_schema, table_grow_quiesce,
+                                 timer_sync)
 from veneur_tpu.analysis.core import (Project, filter_suppressed,
                                       reasonless_suppressions)
 
@@ -579,6 +580,40 @@ CASES = [
                     self.n_shards = n_shards
         """},
     ),
+    (
+        "table-grow-quiesce",
+        lambda p: table_grow_quiesce.run(p, roots=["veneur_tpu"]),
+        # positive: a capacity mutator called (and .spec reassigned)
+        # outside the documented grow helper
+        {"veneur_tpu/srv.py": """
+            class Agg:
+                def grow(self, eng, caps):
+                    eng.capacity_set(*caps)
+                    self.spec = caps
+
+            def raw_grow(eng, n):
+                eng.vt_capacity_set(0, n)
+        """},
+        # negative: the grow helper itself, the ctypes binding layer,
+        # and construction-time spec assignment
+        {"veneur_tpu/tables/growth.py": """
+            def grow_swap(server, new_spec):
+                eng = getattr(server.aggregator, "eng", None)
+                if eng is not None:
+                    eng.capacity_set(1, 2, 3, 4)
+                return server.aggregator.swap()
+        """,
+         "veneur_tpu/native/__init__.py": """
+            class NativeIngest:
+                def capacity_set(self, counter, gauge, set_, histo):
+                    self._lib.vt_capacity_set(0, counter)
+        """,
+         "veneur_tpu/srv.py": """
+            class Agg:
+                def __init__(self, spec):
+                    self.spec = spec
+        """},
+    ),
 ]
 
 _IDS = [c[0] for c in CASES]
@@ -714,12 +749,12 @@ def test_run_passes_json_schema_stability(tmp_path):
         {"name", "doc", "findings", "runtime_s"}]
 
 
-def test_registry_covers_all_eleven_passes():
+def test_registry_covers_all_twelve_passes():
     assert list(PASSES) == [
         "hot-path-alloc", "drop-accounting", "ambiguous-paths",
         "bare-except", "metric-names", "snapshot-schema",
         "jax-hot-path", "lock-discipline", "accounting-flow",
-        "timer-sync", "reshard-quiesce"]
+        "timer-sync", "reshard-quiesce", "table-grow-quiesce"]
     for name, mod in PASSES.items():
         assert mod.NAME == name and mod.DOC
 

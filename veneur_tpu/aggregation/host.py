@@ -114,6 +114,11 @@ class KeyTable:
     the dict key with the kind.
     """
 
+    # optional tables.pressure.TablePressure — attached by the backend's
+    # swap() when table pressure management is enabled; stays None (one
+    # predicted-not-taken branch on the MISS path only) otherwise
+    pressure = None
+
     def __init__(self, spec: TableSpec, n_shards: int = 1):
         self.spec = spec
         self.n_shards = n_shards
@@ -149,6 +154,11 @@ class KeyTable:
         slot = t.by_key.get(key)
         if slot is not None:
             return slot
+        if self.pressure is not None:
+            # miss path only — the pressure ladder (tables/pressure.py)
+            # may redirect the key to a rollup/merge slot or admit it
+            return self.pressure.admit(t, key, digest, name, tags, scope,
+                                       kind, hostname, imported, joined_tags)
         return t.alloc(key, digest, name, tags, scope, kind,
                        hostname=hostname, imported=imported,
                        joined_tags=joined_tags)

@@ -26,7 +26,7 @@ from veneur_tpu.analysis import (ambiguous_paths, accounting_flow,
                                  hot_path_alloc, jax_hot_path,
                                  lock_discipline, metric_names,
                                  reshard_quiesce, snapshot_schema,
-                                 timer_sync)
+                                 table_grow_quiesce, timer_sync)
 from veneur_tpu.analysis.core import (REPO, Finding, Project,
                                       filter_suppressed,
                                       reasonless_suppressions)
@@ -47,6 +47,7 @@ PASSES = {
         accounting_flow,
         timer_sync,
         reshard_quiesce,
+        table_grow_quiesce,
     )
 }
 

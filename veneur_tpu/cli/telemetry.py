@@ -114,6 +114,36 @@ def tenant_table(samples) -> list:
             for r in rows]
 
 
+def keytable_table(samples) -> list:
+    """Render the self-adjusting key-table family (veneur.table.*,
+    kind=<table kind> label) as one aligned row per kind — the
+    operator's capacity/pressure balance sheet: current capacity, grow
+    count, and the exact evicted/merged/demoted accounting that proves
+    no row was lost silently (README §Key tables). Empty when growth
+    is off."""
+    per_kind: dict = {}
+    cols: list = []
+    for name, labels, value in samples:
+        # exposition names arrive underscore-mangled (veneur_table_*)
+        if not name.startswith("veneur_table_") or "kind" not in labels:
+            continue
+        stat = name[len("veneur_table_"):]
+        if stat.endswith("_total"):
+            stat = stat[:-len("_total")]
+        if stat not in cols:
+            cols.append(stat)
+        per_kind.setdefault(labels["kind"], {})[stat] = value
+    if not per_kind:
+        return []
+    rows = [["kind"] + cols]
+    for kind in sorted(per_kind):
+        rows.append([kind] + [f"{per_kind[kind].get(c, 0):g}"
+                              for c in cols])
+    widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
+    return ["  ".join(f"{cell:>{w}}" for cell, w in zip(r, widths))
+            for r in rows]
+
+
 def dump_once(fetch, as_json: bool, out=None) -> int:
     """One scrape → sorted text (or JSON) on `out`. Returns an exit
     code: 1 on fetch failure, 0 otherwise (an empty exposition is a
@@ -154,6 +184,12 @@ def dump_once(fetch, as_json: bool, out=None) -> int:
     if table:
         print("", file=out)
         print("tenants:", file=out)
+        for line in table:
+            print(f"  {line}", file=out)
+    table = keytable_table(samples)
+    if table:
+        print("", file=out)
+        print("key tables:", file=out)
         for line in table:
             print(f"  {line}", file=out)
     return 0

@@ -408,6 +408,15 @@ class Config:
     history_windows: int = 90
     history_decimation_tiers: int = 3
     history_max_keys: int = 1 << 20
+    # self-adjusting key tables (veneur_tpu/tables/): per-kind capacity
+    # growth at the flush swap boundary up to table_max_capacity rows
+    # per kind, idle-key census TTL for exact eviction accounting, and
+    # the SALSA merge-cell rung of the pressure ladder (Python key
+    # tables only; counters). All default-off.
+    table_grow_enabled: bool = False
+    table_max_capacity: int = 1 << 24
+    table_idle_ttl_s: float = 300.0
+    table_salsa_enabled: bool = False
 
     def parse_interval(self) -> float:
         return parse_duration(self.interval)

@@ -24,6 +24,13 @@ _SRC = os.path.join(_DIR, "dogstatsd.cpp")
 _lib = None
 _load_err: Optional[str] = None
 
+# rings_inject verdicts (dogstatsd.cpp ring_push2): BACKPRESSURE means a
+# full ring refused the datagram WITHOUT counting it — pace and retry;
+# REJECTED means it was counted (toolong or admission shed) and is gone.
+INJECT_OK = 1
+INJECT_REJECTED = 0
+INJECT_BACKPRESSURE = -1
+
 
 def _build_and_load():
     global _lib, _load_err
@@ -92,6 +99,10 @@ def _build_and_load():
             ctypes.POINTER(ctypes.c_int)]
         lib.vt_reset.argtypes = [ctypes.c_void_p]
         lib.vt_shard_map_set.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+        lib.vt_capacity_set.argtypes = [ctypes.c_void_p] + \
+            [ctypes.c_uint32] * 4
+        lib.vt_table_stats.argtypes = [ctypes.c_void_p,
+                                       ctypes.POINTER(ctypes.c_uint64)]
         lib.vt_stats.argtypes = [ctypes.c_void_p,
                                  ctypes.POINTER(ctypes.c_uint64)]
         lib.vr_start.restype = ctypes.c_void_p
@@ -158,6 +169,10 @@ def _build_and_load():
         lib.vrm_resume.argtypes = [ctypes.c_void_p]
         lib.vrm_reset.argtypes = [ctypes.c_void_p]
         lib.vrm_shard_map_set.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+        lib.vrm_capacity_set.argtypes = [ctypes.c_void_p] + \
+            [ctypes.c_uint32] * 4
+        lib.vrm_table_stats.argtypes = [ctypes.c_void_p,
+                                        ctypes.POINTER(ctypes.c_uint64)]
         lib.vrm_counters.argtypes = [ctypes.c_void_p, ctypes.c_int,
                                      ctypes.POINTER(ctypes.c_uint64)]
         lib.vrm_ring_stats.argtypes = [ctypes.c_void_p, ctypes.c_int,
@@ -490,6 +505,35 @@ class NativeIngest:
         else:
             _lib.vt_shard_map_set(self._h, int(n_shards))
 
+    def capacity_set(self, counter: int, gauge: int, set_: int,
+                     histo: int):
+        """Stage new per-kind table capacities (0 = keep current); they
+        take effect at the next reset() (i.e. inside the swap quiesce),
+        never immediately. Only veneur_tpu/tables/growth.py may call
+        this — vtlint's table-grow-quiesce pass enforces the boundary."""
+        r = getattr(self, "_rings", None)
+        if r:
+            _lib.vrm_capacity_set(r, int(counter), int(gauge), int(set_),
+                                  int(histo))
+        else:
+            _lib.vt_capacity_set(self._h, int(counter), int(gauge),
+                                 int(set_), int(histo))
+
+    def table_stats(self) -> dict:
+        """Per-kind key-table occupancy for the growth planner:
+        {kind: (allocated, dropped, capacity)} over the engine's four
+        tables. Locks the key tables shared — safe alongside ring
+        parsing."""
+        s = (ctypes.c_uint64 * 12)()
+        r = getattr(self, "_rings", None)
+        if r:
+            _lib.vrm_table_stats(r, s)
+        else:
+            _lib.vt_table_stats(self._h, s)
+        kinds = ("counter", "gauge", "set", "histo")
+        return {k: (int(s[i * 3]), int(s[i * 3 + 1]), int(s[i * 3 + 2]))
+                for i, k in enumerate(kinds)}
+
     def stats(self) -> dict:
         s = (ctypes.c_uint64 * 3)()
         r = getattr(self, "_rings", None)
@@ -679,11 +723,18 @@ class NativeIngest:
                                      ring_cap, pin_arr)
         self._n_rings = n_rings
 
-    def rings_inject(self, ring: int, data: bytes) -> bool:
+    def rings_inject(self, ring: int, data: bytes) -> int:
         """Queue one datagram onto ring i through the same toolong/
-        admission/ring-cap accounting as the socket path. False when the
-        datagram was counted-and-dropped."""
-        return bool(_lib.vrm_inject(self._rings, ring, data, len(data)))
+        admission/ring-cap accounting as the socket path. Returns a
+        verdict: INJECT_OK (1) queued; INJECT_REJECTED (0) counted and
+        dropped (toolong or admission shed — the datagrams == toolong +
+        admitted + shed identity holds); INJECT_BACKPRESSURE (-1) the
+        ring is full and NOTHING was counted — the caller still owns the
+        datagram and should pace, then retry. Retrying a BACKPRESSURE
+        verdict never double-counts (the old bool return counted the
+        datagram before the ring-full check, so pace-and-retry loops
+        inflated the received count)."""
+        return int(_lib.vrm_inject(self._rings, ring, data, len(data)))
 
     def rings_wait(self, max_wait_ms: int) -> int:
         """Block (GIL released) until a ring stalls on full staging or

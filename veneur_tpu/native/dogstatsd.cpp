@@ -344,6 +344,14 @@ struct Parser {
   // buffer-swap boundary so no packed batch ever straddles two maps.
   // 0 = nothing staged.
   uint32_t pending_shards = 0;
+  // staged per-kind capacity change (live key-table growth,
+  // veneur_tpu/tables/growth.py): counter/gauge/set/histo, 0 = nothing
+  // staged. Same discipline as pending_shards — set under key_mu by
+  // vt_capacity_set, applied by vt_reset while the tables are empty, so
+  // no slot ever straddles two capacities and the per-shard slot
+  // rebase (slot = shard * per_shard + local) changes only between
+  // intervals.
+  uint32_t pending_caps[4] = {0, 0, 0, 0};
 
   // Multi-ring sharing: ring parsers keep their own staging lanes and
   // scratch but route every key-table/new-key/special access to the
@@ -1001,6 +1009,17 @@ void vt_reset(void* hp) {
     p->sets.init(p->sets.capacity, n);
     p->histos.init(p->histos.capacity, n);
   }
+  // staged per-kind growth applies after any shard-map change so a
+  // combined stage lands as (new shards, new caps) in one quiesce
+  if (p->pending_caps[0] | p->pending_caps[1] | p->pending_caps[2] |
+      p->pending_caps[3]) {
+    KindTable* ts[4] = {&p->counters, &p->gauges, &p->sets, &p->histos};
+    for (int i = 0; i < 4; i++) {
+      if (p->pending_caps[i])
+        ts[i]->init(p->pending_caps[i], ts[i]->n_shards);
+      p->pending_caps[i] = 0;
+    }
+  }
   // tenant quarantine decay: fold this window's exact distinct-key count
   // into the carried estimate (est = est*decay + window) and re-admit a
   // demoted tenant once its estimate has decayed under the re-admission
@@ -1029,6 +1048,39 @@ void vt_shard_map_set(void* hp, uint32_t n_shards) {
   auto* p = (Parser*)hp;
   std::unique_lock<std::shared_mutex> lk(p->key_mu);
   p->pending_shards = n_shards ? n_shards : 1;
+}
+
+// Stage new per-kind capacities (0 = keep current). Takes effect at the
+// next vt_reset — i.e. inside the caller's swap quiesce — never
+// immediately. The swap-boundary sequencing lives in
+// veneur_tpu/tables/growth.py; call it from there only (the
+// table-grow-quiesce vtlint pass enforces this).
+void vt_capacity_set(void* hp, uint32_t cc, uint32_t gc, uint32_t sc,
+                     uint32_t hc) {
+  auto* p = (Parser*)hp;
+  std::unique_lock<std::shared_mutex> lk(p->key_mu);
+  p->pending_caps[0] = cc;
+  p->pending_caps[1] = gc;
+  p->pending_caps[2] = sc;
+  p->pending_caps[3] = hc;
+}
+
+// Per-kind occupancy snapshot for the growth planner: 3 u64 per kind in
+// counter/gauge/set/histo order — [allocated slots, cumulative dropped,
+// capacity]. Takes key_mu shared; safe to call from the pipeline thread
+// while ring workers parse.
+void vt_table_stats(void* hp, uint64_t* out) {
+  auto* p = (Parser*)hp;
+  std::shared_lock<std::shared_mutex> lk(p->key_mu);
+  const KindTable* ts[4] = {&p->counters, &p->gauges, &p->sets,
+                            &p->histos};
+  for (int i = 0; i < 4; i++) {
+    uint64_t used = 0;
+    for (uint32_t nf : ts[i]->next_free) used += nf;
+    out[i * 3 + 0] = used;
+    out[i * 3 + 1] = ts[i]->dropped;
+    out[i * 3 + 2] = ts[i]->capacity;
+  }
 }
 
 // Batch FNV-1a 64 over concatenated byte strings (offsets has n+1
@@ -1894,14 +1946,25 @@ void pin_self(int core) {
 // Shared push for the socket reader and the inject path so bench traffic
 // hits the same invariant: every arriving datagram is counted exactly once
 // as toolong, admitted, or shed (ring-full drops are post-admission and
-// counted separately). Returns true when queued.
-bool ring_push(Ring* r, const char* data, size_t n, bool kernel_trunc) {
+// counted separately). Returns 1 when queued, 0 when counted-and-
+// rejected (toolong / admission shed / ring-full drop).
+//
+// With `backpressure` (the inject path), a full ring returns -1 with NO
+// counting at all: the caller holds the datagram and retries, and
+// counting here would double-count it on the retry (the PR 19 footgun).
+// The socket reader never passes backpressure — a kernel-delivered
+// datagram cannot be retried, so a full ring must count it dropped.
+int ring_push2(Ring* r, const char* data, size_t n, bool kernel_trunc,
+               bool backpressure) {
   {
     std::lock_guard<std::mutex> lk(r->mu);
+    // only the worker pops, so under r->mu the ring can only shrink —
+    // checking before counting is race-free
+    if (backpressure && r->ring.size() >= r->ring_cap) return -1;
     r->datagrams++;
     if (kernel_trunc || n >= (size_t)r->max_len) {
       r->toolong++;
-      return false;
+      return 0;
     }
     // tenant identity resolves here, before admission, so the fairness
     // decision and the per-tenant shed count land on the same identity.
@@ -1933,17 +1996,21 @@ bool ring_push(Ring* r, const char* data, size_t n, bool kernel_trunc) {
     if ((r->adm.enabled || te) &&
         !admit_datagram2(r->adm, tt, te, tenant, data, n,
                          std::chrono::steady_clock::now()))
-      return false;
+      return 0;
     if (r->ring.size() >= r->ring_cap) {
       r->ring_dropped++;
-      return false;
+      return 0;
     }
     r->ring.push_back(Dgram{std::string(data, n), te, tenant});
     if ((uint64_t)r->ring.size() > r->ring_highwater)
       r->ring_highwater = (uint64_t)r->ring.size();
   }
   r->cv.notify_one();
-  return true;
+  return 1;
+}
+
+bool ring_push(Ring* r, const char* data, size_t n, bool kernel_trunc) {
+  return ring_push2(r, data, n, kernel_trunc, false) == 1;
 }
 
 void vrm_reader_main(MultiRing* mr, Ring* r) {
@@ -2093,10 +2160,14 @@ int vrm_n_rings(void* h) { return (int)((MultiRing*)h)->rings.size(); }
 // Queue one datagram onto ring i through the same toolong/admission/
 // ring-cap accounting as the socket path (benches and tests use this for
 // deterministic ring placement — SO_REUSEPORT flow hashing is opaque).
-// Returns 1 when queued, 0 when counted-and-dropped.
+// Verdicts: 1 = queued, 0 = counted-and-rejected (toolong or admission
+// shed — the datagrams == toolong + admitted + shed identity holds),
+// -1 = backpressure: the ring is full and NOTHING was counted — the
+// caller still owns the datagram and paces/retries without inflating
+// any counter.
 int vrm_inject(void* h, int ring, const char* data, int len) {
   auto* mr = (MultiRing*)h;
-  return ring_push(mr->rings[ring].get(), data, (size_t)len, false) ? 1 : 0;
+  return ring_push2(mr->rings[ring].get(), data, (size_t)len, false, true);
 }
 
 // Block the pipeline thread until a ring stalls on full staging (or the
@@ -2220,6 +2291,23 @@ void vrm_reset(void* h) {
 void vrm_shard_map_set(void* h, uint32_t n_shards) {
   auto* mr = (MultiRing*)h;
   vt_shard_map_set(mr->master, n_shards);
+}
+
+// Multi-ring capacity staging: the rings route every table access to the
+// master, so staging there covers all of them; the local replica caches
+// hold (key -> slot) entries that the vrm_reset inside the same quiesce
+// clears before any ring can hit an old-capacity slot.
+void vrm_capacity_set(void* h, uint32_t cc, uint32_t gc, uint32_t sc,
+                      uint32_t hc) {
+  auto* mr = (MultiRing*)h;
+  vt_capacity_set(mr->master, cc, gc, sc, hc);
+}
+
+// Master-table occupancy snapshot (vt_table_stats layout): the rings
+// share the master's slot space, so this IS the multi-ring occupancy.
+void vrm_table_stats(void* h, uint64_t* out) {
+  auto* mr = (MultiRing*)h;
+  vt_table_stats(mr->master, out);
 }
 
 // Per-ring counter snapshot: [0]=datagrams, [1]=ring_dropped,

@@ -135,6 +135,14 @@ def encode_to_dir(dirpath: str, snap: dict, fsync: bool = True) -> int:
                        json.dumps(snap["tenants"],
                                   separators=(",", ":")).encode(),
                        None, None))
+    # self-adjusting key-table sidecar (veneur_tpu/tables/): live
+    # per-kind capacities + growth accounting (JSON; same rule). Named
+    # "keytables" — "tables" is the key-table metadata rows chunk.
+    if snap.get("keytables"):
+        chunks.append(("keytables",
+                       json.dumps(snap["keytables"],
+                                  separators=(",", ":")).encode(),
+                       None, None))
     # history ring sidecar (veneur_tpu/history/): one JSON meta chunk
     # (spec + seq + key index) plus one raw-bytes chunk per ring array.
     # Same unknown-chunk rule — old readers skip all of them.
@@ -298,6 +306,12 @@ def load_dir(dirpath: str) -> dict:
             tenants = json.loads(chunks["tenants"])
         except ValueError as e:
             raise CorruptSnapshot(f"{dirpath}: tenants chunk: {e}")
+    keytables = None
+    if chunks.get("keytables"):
+        try:
+            keytables = json.loads(chunks["keytables"])
+        except ValueError as e:
+            raise CorruptSnapshot(f"{dirpath}: keytables chunk: {e}")
     history = None
     if chunks.get("history"):
         try:
@@ -327,6 +341,7 @@ def load_dir(dirpath: str) -> dict:
         "watches": watches,
         "history": history,
         "tenants": tenants,
+        "keytables": keytables,
     }
 
 

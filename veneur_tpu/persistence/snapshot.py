@@ -60,7 +60,8 @@ def build_snapshot(spec, table: KeyTable, result: Dict[str, np.ndarray],
                    forward_meta: Optional[dict] = None,
                    watches: Optional[dict] = None,
                    history: Optional[dict] = None,
-                   tenants: Optional[dict] = None) -> dict:
+                   tenants: Optional[dict] = None,
+                   keytables: Optional[dict] = None) -> dict:
     """`result`/`raw` are compute_flush's outputs for the interval being
     checkpointed (want_raw=True — both backends emit identical raw keys).
     `table` is the interval's detached KeyTable."""
@@ -126,4 +127,10 @@ def build_snapshot(spec, table: KeyTable, result: Dict[str, np.ndarray],
         # tenant quarantine table + exact demoted-row totals
         # (veneur_tpu/reliability/tenancy.py); None/absent = tier off
         "tenants": tenants,
+        # self-adjusting key tables (veneur_tpu/tables/): LIVE per-kind
+        # capacities + growth accounting. Deliberately OUTSIDE
+        # schema_hash (which covers spec field NAMES only) so
+        # cross-capacity restore keeps working both directions;
+        # None/absent = growth off
+        "keytables": keytables,
     }

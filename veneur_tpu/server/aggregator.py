@@ -43,6 +43,11 @@ _SYNC_EVERY = 64
 
 
 class Aggregator:
+    # optional tables.pressure.TablePressure shared across intervals;
+    # class attribute so every backend (ShardedAggregator skips this
+    # __init__) starts without one
+    _pressure = None
+
     def __init__(self, spec: TableSpec, bspec: BatchSpec = BatchSpec(),
                  n_shards: int = 1, compact_every: int = 8):
         self.spec = spec
@@ -111,6 +116,15 @@ class Aggregator:
     def extra_parse_errors(self) -> int:
         """Parse errors counted below the Python layer (native engine)."""
         return 0
+
+    def set_pressure(self, pressure) -> None:
+        """Install a tables.pressure.TablePressure: the live table and
+        every subsequent interval's fresh KeyTable (swap) get it
+        attached. Python key tables only — the native engine's C++ maps
+        keep exact counted drops instead (absorbed by the next grow)."""
+        self._pressure = pressure
+        if pressure is not None:
+            pressure.attach(self.table)
 
     # -- degraded aggregation (shared by the sharded backend) ---------------
     def _histo_admit(self, sample_rate: float):
@@ -405,6 +419,8 @@ class Aggregator:
         state, table = self.state, self.table
         self.state = empty_state_compiled(self.spec)
         self.table = KeyTable(self.spec, self.n_shards)
+        if self._pressure is not None:
+            self._pressure.attach(self.table)
         self._steps = 0
         self._latch_degrade()
         return state, table

@@ -149,6 +149,12 @@ class NativeAggregator(Aggregator):
             else NativeIngest(spec, bspec, n_shards)
         self.table = NativeKeyTable(spec, self.eng, n_shards)
         self._alloc_packed_buffers()
+        if engine is not None and self.eng.n_rings:
+            # engine reuse across a live reshard / table grow with the
+            # multi-ring readers still running: rings_start (which
+            # normally allocates the per-ring arenas) will not run again
+            # on the rebuilt backend, so allocate them here
+            self._alloc_ring_arenas(self.eng.n_rings)
 
     def _alloc_ring_arenas(self, n_rings: int):
         """Per-ring staging plan: two (rings, words) i32 arenas — one row

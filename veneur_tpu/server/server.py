@@ -599,6 +599,29 @@ class Server:
             from veneur_tpu.reshard import ReshardCoordinator
             self.reshard = ReshardCoordinator(self)
 
+        # -- self-adjusting key tables (veneur_tpu/tables/) ---------------
+        # Off by default: no manager, no pressure ladder, and the flush
+        # path's grow gate is a single `is not None` check. Growth
+        # composes with the collective tier only through config
+        # capacities (the tier does not resize live), so the manager is
+        # not armed there either.
+        self.tables = None
+        if cfg.table_grow_enabled and not cfg.collective_enabled:
+            from veneur_tpu.tables import TableManager, TablePressure
+            self.tables = TableManager(
+                self.aggregator.spec,
+                n_shards=getattr(self.aggregator, "n_shards", 1),
+                max_capacity=cfg.table_max_capacity,
+                idle_ttl_s=cfg.table_idle_ttl_s)
+            if not self._native:
+                # pressure ladder rides the Python key tables; the C++
+                # engine keeps exact counted drops (absorbed by the
+                # next grow) instead
+                pressure = TablePressure(
+                    salsa_enabled=cfg.table_salsa_enabled)
+                self.tables.pressure = pressure
+                self.aggregator.set_pressure(pressure)
+
         # -- TCP statsd hardening -----------------------------------------
         # live-connection accounting for tcp_max_connections; the idle
         # deadline lives in _tcp_conn
@@ -707,17 +730,19 @@ class Server:
         # last: every attribute a collector closes over now exists
         self._register_collectors()
 
-    def _make_aggregator(self, n_shards: int, engine=None):
+    def _make_aggregator(self, n_shards: int, engine=None, spec=None):
         """Build the single-process backend for `n_shards` from the
         current config. Returns (aggregator, is_native). Used at startup
         and by the reshard coordinator's drain phase — which passes the
         OLD aggregator's C++ engine so reader rings/sockets keep feeding
         the same handle across the rebuild (the staged shard map was
-        applied inside the drain swap). The collective tier has its own
-        construction path in __init__ and does not resize live."""
+        applied inside the drain swap). tables/growth.py additionally
+        passes `spec` (grown per-kind capacities) at its swap-boundary
+        rebuild. The collective tier has its own construction path in
+        __init__ and does not resize live."""
         cfg = self.cfg
         agg_args = dict(
-            spec=spec_from_config(cfg),
+            spec=spec if spec is not None else spec_from_config(cfg),
             bspec=BatchSpec(counter=cfg.tpu_batch_counter,
                             gauge=cfg.tpu_batch_gauge,
                             status=cfg.tpu_batch_status,
@@ -982,6 +1007,41 @@ class Server:
                    kind="counter", labelnames=("tenant",),
                    help="rows collapsed onto per-tenant rollup keys "
                         "while quarantined (exact)")
+        # self-adjusting key tables — [] while growth is disabled keeps
+        # the labeled families out of the exposition entirely
+        M.callback("veneur.table.grows_total",
+                   lambda: (self.tables.grows_snapshot()
+                            if self.tables is not None else []),
+                   kind="counter", labelnames=("kind",),
+                   help="capacity grow swaps executed at the flush "
+                        "boundary, by table kind")
+        M.callback("veneur.table.capacity",
+                   lambda: (self.tables.capacity_snapshot(
+                            self.aggregator.spec)
+                            if self.tables is not None else []),
+                   labelnames=("kind",),
+                   help="current per-kind key-table capacity (rows)")
+        M.callback("veneur.table.evicted_total",
+                   lambda: (self.tables.evicted_snapshot()
+                            if self.tables is not None else []),
+                   kind="counter", labelnames=("kind",),
+                   help="keys reclaimed by the idle-TTL census "
+                        "(table_idle_ttl_s), exact")
+        M.callback("veneur.table.merged_cells_total",
+                   lambda: (self.tables.pressure.merged_snapshot()
+                            if self.tables is not None
+                            and self.tables.pressure is not None else []),
+                   kind="counter", labelnames=("kind",),
+                   help="distinct long-tail keys redirected into SALSA "
+                        "merge cells under table pressure (exact; "
+                        "additive error bounded by the cell total)")
+        M.callback("veneur.table.demoted_rows_total",
+                   lambda: (self.tables.pressure.demoted_snapshot()
+                            if self.tables is not None
+                            and self.tables.pressure is not None else []),
+                   kind="counter", labelnames=("kind",),
+                   help="tag variants collapsed onto per-key-family "
+                        "rollup rows by the explosion detector (exact)")
 
     # -- registry collector helpers -----------------------------------------
     def _ring_stats(self) -> dict:
@@ -1406,18 +1466,42 @@ class Server:
                 float(self.cfg.reshard_transfer_timeout_s))
         now = time.time()
         self.last_flush = now
+        # self-adjusting key tables: a due capacity change executes AT
+        # this swap boundary (tables/growth.py — the one sanctioned grow
+        # site), so the grow pause IS the swap pause. Serialized against
+        # resharding: while a reshard owns the swap boundary, planning
+        # is deferred to the next flush (trigger_table_grow rejects with
+        # 409 instead).
+        grow_targets = None
+        if self.tables is not None and not self.reshard_active:
+            try:
+                grow_targets = self.tables.plan(self.aggregator)
+            except Exception:
+                log.exception("table grow planning failed; interval "
+                              "flushes at current capacities")
+        # the interval's OWNING aggregator rides the flush job: after a
+        # grow the detached interval's flush math must run against the
+        # OLD spec's backend, not the freshly installed one
+        agg = self.aggregator
         # the ingest-drain phase: how long the interval's device state
         # takes to detach from the hot path (the only flush work that
         # blocks ingest) — timed here, surfaced as the flush trace's
         # first child span and the phase=ingest_drain timer
         swap_t0 = time.perf_counter_ns()
         try:
-            state, table = self.aggregator.swap()
+            if grow_targets:
+                from veneur_tpu.tables import grow_swap, grown_spec
+                state, table, agg = grow_swap(
+                    self, grown_spec(agg.spec, grow_targets))
+            else:
+                state, table = self.aggregator.swap()
         except Exception as e:
             log.exception("flush swap failed")
             req.finish(False, f"swap failed: {e}")
             return
         swap_ns = time.perf_counter_ns() - swap_t0
+        if grow_targets:
+            self.tables.note_grow(grow_targets, swap_ns)
         self._t_flush_phase.observe(swap_ns, phase="ingest_drain")
         # snapshot pipeline-owned counters here: the native engine's
         # stats call isn't safe to interleave with feed()
@@ -1444,7 +1528,7 @@ class Server:
             # set estimates by 2^shift to undo the member subsampling
             "set_shift": getattr(self.aggregator, "last_set_shift", 0),
         }
-        self._flush_jobs.put_nowait((state, table, stats, now, req))
+        self._flush_jobs.put_nowait((agg, state, table, stats, now, req))
 
     # -- listeners ----------------------------------------------------------
     def _bind_unix(self, sock: socket.socket, path: str) -> None:
@@ -2239,10 +2323,32 @@ class Server:
         return self.reshard.resize(new_n_shards, wait=wait,
                                    timeout_s=timeout)
 
-    def _checkpoint_interval(self, flush_arrays, table, raw, ts) -> None:
+    def trigger_table_grow(self, targets: dict, wait: bool = True,
+                           timeout: Optional[float] = None):
+        """Force a per-kind key-table capacity change at the next flush
+        boundary (tables/growth.py executes it inside the swap quiesce
+        — there is no other grow site, by lint). Raises GrowConflict
+        (.status == 409) while a reshard owns the swap boundary:
+        capacity changes serialize behind mesh moves, never interleave.
+        With wait=True returns the flush result like trigger_flush."""
+        from veneur_tpu.tables.growth import GrowConflict
+        if self.tables is None:
+            raise RuntimeError("table growth is disabled "
+                               "(table_grow_enabled: false)")
+        if self.reshard_active:
+            raise GrowConflict("grow rejected: reshard in progress "
+                               "owns the swap boundary (retry after)")
+        self.tables.force(targets)
+        return self.trigger_flush(wait=wait, timeout=timeout)
+
+    def _checkpoint_interval(self, agg, flush_arrays, table, raw,
+                             ts) -> None:
         """Assemble this interval's snapshot from the flush outputs and
-        hand it to the async writer. Containment: a checkpoint that
-        cannot be built degrades durability, never the flush."""
+        hand it to the async writer. `agg` owns the detached interval
+        (its spec sizes the snapshot arrays — across a grow boundary
+        that is the OLD spec, not self.aggregator's). Containment: a
+        checkpoint that cannot be built degrades durability, never the
+        flush."""
         ck_t0 = time.perf_counter_ns()
         try:
             from veneur_tpu.persistence import build_snapshot
@@ -2250,9 +2356,9 @@ class Server:
             if self.forward_spill is not None:
                 spill_bytes = self.forward_spill.to_bytes()
                 spill_n = len(self.forward_spill)
-            n_shards = getattr(self.aggregator, "n_shards", 1)
+            n_shards = getattr(agg, "n_shards", 1)
             snap = build_snapshot(
-                self.aggregator.spec, table, flush_arrays, raw,
+                agg.spec, table, flush_arrays, raw,
                 agg_kind="sharded" if n_shards > 1 else "single",
                 n_shards=n_shards, interval_ts=ts,
                 hostname=self.hostname, spill=spill_bytes,
@@ -2260,7 +2366,8 @@ class Server:
                 forward_meta=self._forward_meta_snapshot(),
                 watches=self._watch_snapshot(),
                 history=self._history_snapshot(),
-                tenants=self._tenant_snapshot())
+                tenants=self._tenant_snapshot(),
+                keytables=self._tables_snapshot())
             self._ckpt_writer.submit(snap)
         except Exception:
             log.exception("checkpoint snapshot build failed; interval "
@@ -2275,6 +2382,15 @@ class Server:
         if self.watch_engine is None:
             return None
         return self.watch_engine.snapshot()
+
+    def _tables_snapshot(self) -> Optional[dict]:
+        """Key-table growth state (LIVE per-kind capacities + exact
+        accounting) for the checkpoint's "keytables" sidecar chunk — a
+        restore re-grows to these capacities BEFORE folding rows. None
+        (chunk omitted) when growth is off."""
+        if self.tables is None:
+            return None
+        return self.tables.snapshot_state(self.aggregator.spec)
 
     def _tenant_snapshot(self) -> Optional[dict]:
         """Tenant quarantine state (engine table mirror + exact
@@ -2346,6 +2462,21 @@ class Server:
                          self.cfg.checkpoint_dir)
                 return
             snap, path = found
+            if snap.get("keytables") and self.tables is not None:
+                # re-grow to the checkpoint's per-kind capacities BEFORE
+                # folding (startup: the pipeline is not running, so the
+                # swap boundary is trivially quiescent). fold_snapshot
+                # is capacity-independent either way — adopting first
+                # just restores the headroom the process had.
+                from veneur_tpu.tables import adopt_capacities
+                kt = snap["keytables"]
+                try:
+                    adopt_capacities(self, dict(kt.get("capacities")
+                                                or {}))
+                    self.tables.restore_state(kt)
+                except Exception:
+                    log.exception("keytables sidecar not adopted; "
+                                  "restoring at config capacities")
             fwd_meta = snap.get("forward") or None
             # skip re-folding forward-ONLY rows iff their payloads travel
             # via the spill replay instead: the snapshot was written by
@@ -2394,10 +2525,10 @@ class Server:
             job = self._flush_jobs.get()
             if job is _STOP:
                 return
-            state, table, stats, swapped_at, req = job
+            agg, state, table, stats, swapped_at, req = job
             ok, detail = True, ""
             try:
-                self._do_flush(state, table, stats, swapped_at)
+                self._do_flush(agg, state, table, stats, swapped_at)
             except Exception as e:
                 # a failed flush must never kill the flush thread; state
                 # was already swapped, next interval starts clean
@@ -2408,7 +2539,10 @@ class Server:
                 self._c_flush_count.inc()
                 req.finish(ok, detail)
 
-    def _do_flush(self, state, table, stats, swapped_at):
+    def _do_flush(self, agg, state, table, stats, swapped_at):
+        # `agg` is the backend that OWNED the detached interval — it is
+        # self.aggregator except for the interval detached by a table
+        # grow swap, whose flush math must run at the old spec
         # chaos hook: a fault here exercises the failed-flush containment
         # in _flush_worker (state already swapped; next interval clean)
         FAULTS.inject(FLUSH_WORKER)
@@ -2461,12 +2595,20 @@ class Server:
                     >= max(1, self.cfg.checkpoint_interval_flushes))
         if (self._forward_client is not None or ckpt_due
                 or self.cfg.collective_attach):
-            flush_arrays, table, raw = self.aggregator.compute_flush(
+            flush_arrays, table, raw = agg.compute_flush(
                 state, table, self.cfg.percentiles, want_raw=True,
                 history=self.history)
         else:
-            flush_arrays, table = self.aggregator.compute_flush(
+            flush_arrays, table = agg.compute_flush(
                 state, table, self.cfg.percentiles, history=self.history)
+        if self.tables is not None:
+            try:
+                # idle census over the detached (immutable) table: exact
+                # evicted_total + the shrink demand signal
+                self.tables.census_flush(table, swapped_at)
+            except Exception:
+                log.exception("table census failed; eviction accounting "
+                              "skipped this interval")
         self._t_flush_phase.observe(time.perf_counter_ns() - dev_t0,
                                     phase="device_update")
         if trace:
@@ -2533,7 +2675,7 @@ class Server:
                 # the receiver's dedup window suppresses the re-fold;
                 # without a window the replay is at-least-once for the
                 # additive kinds (forward/envelope.py).
-                self._checkpoint_interval(flush_arrays, table, raw, ts)
+                self._checkpoint_interval(agg, flush_arrays, table, raw, ts)
                 self._flushes_since_ckpt = 0
             else:
                 self._flushes_since_ckpt += 1
@@ -3480,7 +3622,8 @@ class Server:
                         forward_meta=self._forward_meta_snapshot(),
                         watches=self._watch_snapshot(),
                         history=self._history_snapshot(),
-                        tenants=self._tenant_snapshot()))
+                        tenants=self._tenant_snapshot(),
+                        keytables=self._tables_snapshot()))
                 except Exception:
                     log.exception("final checkpoint failed; last periodic "
                                   "checkpoint remains newest")

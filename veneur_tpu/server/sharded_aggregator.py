@@ -355,6 +355,8 @@ class ShardedAggregator(Aggregator):
         state, table = self.state, self.table
         self.state = self._empty()
         self.table = KeyTable(self.spec, self.n_shards)
+        if self._pressure is not None:
+            self._pressure.attach(self.table)
         self.batchers = self._make_batchers()
         self._steps = 0
         self._latch_degrade()

@@ -1,0 +1,32 @@
+"""Self-adjusting key tables (ISSUE 20).
+
+Live, per-kind key-table growth and pressure management: the system
+absorbs cardinality explosions (10M live names) without a restart and
+without unaccounted loss.
+
+Three pieces, three failure ladders:
+
+- growth.py — the ONE sanctioned grow site. Per-kind capacity changes
+  execute at the pipeline-thread swap boundary, reusing the staged-
+  then-applied-at-reset discipline of reshard/quiesce.py (the vtlint
+  `table-grow-quiesce` pass makes any other mutation site a finding).
+  Growth only re-sizes *within* a shard: `route_digest % n_shards`
+  shard assignment is capacity-independent, so the C++ preshard emit
+  path stays byte-identical across a grow (fuzz-pinned).
+- pressure.py — the ladder below hard capacity for Python key tables:
+  SALSA-style merge cells for long-tail counters (arXiv:2102.12531,
+  pinned additive error bound), tag-explosion demotion to aggregate-
+  only rollup rows (per-key-family generalization of the per-tenant
+  quarantine, arXiv:2004.10332), and exact counted drops as the last
+  rung. Every non-admitted row is accounted.
+- manager.py — occupancy census, grow/shrink planning, TTL eviction
+  accounting, and the snapshot sidecar state ("keytables" chunk) that
+  lets a checkpoint restore re-grow before folding rows.
+"""
+
+from veneur_tpu.tables.growth import adopt_capacities, grow_swap, grown_spec
+from veneur_tpu.tables.manager import TableManager
+from veneur_tpu.tables.pressure import TablePressure
+
+__all__ = ["TableManager", "TablePressure", "adopt_capacities",
+           "grow_swap", "grown_spec"]
